@@ -1,0 +1,36 @@
+"""deeplearning4j_tpu: a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capability surface of Deeplearning4j
+(reference: jcastaldoFoodEssentials/deeplearning4j, surveyed in SURVEY.md)
+designed idiomatically for TPU hardware on JAX/XLA/Pallas/pjit:
+
+- builder-configured networks (sequential stacks + computation graphs)
+  lowered to pure ``init``/``apply`` functions over parameter pytrees,
+- a single jitted train step per (model, shape) pair instead of
+  per-op JNI dispatch,
+- data/tensor parallelism via ``jax.sharding.Mesh`` + XLA collectives
+  instead of Spark parameter averaging / ParallelWrapper threads,
+- zip checkpoints (config JSON + params + updater state) mirroring
+  ModelSerializer's layout,
+- embeddings (Word2Vec/GloVe/ParagraphVectors), graph embeddings
+  (DeepWalk), evaluation, early stopping, Keras import and training
+  observability.
+
+The tensor substrate (the reference's nd4j/libnd4j, SURVEY.md L0) is
+jax.numpy/lax; accelerated kernels (the reference's deeplearning4j-cuda
+cuDNN helpers) are XLA lowerings plus Pallas kernels for fusion wins.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+
+try:  # graph engine lands with the ComputationGraph milestone
+    from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401
+except ImportError:  # pragma: no cover
+    ComputationGraph = None  # type: ignore[assignment]

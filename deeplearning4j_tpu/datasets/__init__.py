@@ -1,0 +1,9 @@
+"""Datasets & iterators (reference: ``deeplearning4j-core`` datasets)."""
+
+from deeplearning4j_tpu.datasets.api import (  # noqa: F401
+    DataSet,
+    DataSetIterator,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+    MultiDataSet,
+)

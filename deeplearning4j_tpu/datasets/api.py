@@ -1,0 +1,156 @@
+"""DataSet containers and iterator SPI (reference: nd4j ``DataSet`` /
+``MultiDataSet`` and ``datasets/iterator/DataSetIterator`` SPI,
+SURVEY.md §2.1 datasets/iterator).
+
+Host-side containers are numpy; conversion to device arrays happens
+once, inside the jitted step's argument transfer (and under pjit the
+transfer is sharded per device)."""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    """features/labels (+ optional masks) minibatch container."""
+
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        return (
+            DataSet(
+                self.features[:n_train], self.labels[:n_train],
+                None if self.features_mask is None else self.features_mask[:n_train],
+                None if self.labels_mask is None else self.labels_mask[:n_train],
+            ),
+            DataSet(
+                self.features[n_train:], self.labels[n_train:],
+                None if self.features_mask is None else self.features_mask[n_train:],
+                None if self.labels_mask is None else self.labels_mask[n_train:],
+            ),
+        )
+
+    def shuffle(self, seed: int = 0) -> "DataSet":
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(self.num_examples())
+        return DataSet(
+            self.features[idx], self.labels[idx],
+            None if self.features_mask is None else self.features_mask[idx],
+            None if self.labels_mask is None else self.labels_mask[idx],
+        )
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        n = self.num_examples()
+        for i in range(0, n, batch_size):
+            out.append(DataSet(
+                self.features[i:i + batch_size],
+                self.labels[i:i + batch_size],
+                None if self.features_mask is None
+                else self.features_mask[i:i + batch_size],
+                None if self.labels_mask is None
+                else self.labels_mask[i:i + batch_size],
+            ))
+        return out
+
+
+@dataclass
+class MultiDataSet:
+    """Multi-input/multi-output container (reference nd4j MultiDataSet,
+    consumed by ComputationGraph)."""
+
+    features: Sequence[np.ndarray]
+    labels: Sequence[np.ndarray]
+    features_masks: Optional[Sequence[Optional[np.ndarray]]] = None
+    labels_masks: Optional[Sequence[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+
+class DataSetIterator:
+    """Iterator SPI (reference ``DataSetIterator``). Subclasses
+    implement ``__next__``/``has_next``/``reset``; iteration protocol
+    provided for pythonic loops."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def total_examples(self) -> int:
+        return -1
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a pre-built list of minibatches (reference
+    ``ListDataSetIterator``)."""
+
+    def __init__(self, batches: Sequence[DataSet]):
+        self._batches = list(batches)
+        self._pos = 0
+
+    def next(self) -> DataSet:
+        ds = self._batches[self._pos]
+        self._pos += 1
+        return ds
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._batches)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self._batches[0].num_examples() if self._batches else 0
+
+    def total_examples(self) -> int:
+        return sum(b.num_examples() for b in self._batches)
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap any iterable of DataSets (reference
+    ``ExistingDataSetIterator``)."""
+
+    def __init__(self, iterable):
+        self._iterable = iterable
+        self._it = None
+
+    def __iter__(self):
+        self._it = iter(self._iterable)
+        return self
+
+    def __next__(self):
+        if self._it is None:
+            self._it = iter(self._iterable)
+        return next(self._it)
+
+    def reset(self):
+        self._it = None

@@ -1,0 +1,7 @@
+"""Evaluation (reference ``deeplearning4j-nn/.../eval``)."""
+
+from deeplearning4j_tpu.eval.evaluation import (  # noqa: F401
+    ConfusionMatrix,
+    Evaluation,
+    RegressionEvaluation,
+)

@@ -1,0 +1,1 @@
+"""Neural-network core (reference module: ``deeplearning4j-nn``)."""

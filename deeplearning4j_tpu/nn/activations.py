@@ -1,0 +1,75 @@
+"""Activation functions, keyed by the reference's string vocabulary.
+
+The reference configures activations as strings on layer configs
+(``NeuralNetConfiguration.Builder#activation(String)``,
+reference ``nn/conf/NeuralNetConfiguration.java``) and dispatches to
+libnd4j transform ops via ``Nd4j.getExecutioner()``. Here each name maps
+to a jax-traceable function; XLA fuses them into the surrounding matmul
+or conv, which replaces the reference's per-op native dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jax.Array], jax.Array]
+
+_EPS = 1e-12
+
+
+def _softmax(x: jax.Array) -> jax.Array:
+    # Softmax over the feature axis. The reference applies softmax
+    # row-wise on [batch, nOut] (2-d) and per-timestep on RNN output;
+    # our convention: the feature axis is axis 1 for 2-d/CNN/RNN
+    # ([b, size] / [b, c, h, w] / [b, size, t]).
+    return jax.nn.softmax(x, axis=1)
+
+
+_REGISTRY: dict[str, Activation] = {
+    "identity": lambda x: x,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    "softmax": _softmax,
+    "softsign": jax.nn.soft_sign,
+    "softplus": jax.nn.softplus,
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "cube": lambda x: x * x * x,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.swish,
+    "rationaltanh": lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0),
+    "rectifiedtanh": lambda x: jnp.maximum(0.0, jnp.tanh(x)),
+    "sin": jnp.sin,
+    "step": lambda x: (x > 0).astype(x.dtype),
+    "sign": jnp.sign,
+    "abs": jnp.abs,
+    "sqrt": lambda x: jnp.sqrt(jnp.maximum(x, 0.0)),
+    "exp": jnp.exp,
+}
+
+
+def get(name: str) -> Activation:
+    """Resolve an activation by its reference-vocabulary name."""
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def register(name: str, fn: Activation) -> None:
+    """Register a custom activation (reference analog: custom
+    activation classes registered on the nd4j transform registry)."""
+    _REGISTRY[name.lower()] = fn
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)

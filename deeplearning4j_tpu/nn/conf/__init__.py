@@ -1,0 +1,32 @@
+"""Configuration package (reference ``nn/conf``)."""
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
+from deeplearning4j_tpu.nn.conf.multi_layer import (  # noqa: F401
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (  # noqa: F401
+    CnnToFeedForwardPreProcessor,
+    CnnToRnnPreProcessor,
+    ComposableInputPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    FeedForwardToRnnPreProcessor,
+    InputPreProcessor,
+    ReshapePreProcessor,
+    RnnToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor,
+    UnitVarianceProcessor,
+    ZeroMeanPrePreProcessor,
+)
+
+# Populate the layer registry on conf import
+import deeplearning4j_tpu.nn.layers  # noqa: E402,F401
+
+# Graph configuration arrives with the ComputationGraph milestone; kept
+# as a late import to avoid a hard dependency cycle.
+try:
+    from deeplearning4j_tpu.nn.conf.graph_conf import (  # noqa: F401
+        ComputationGraphConfiguration,
+    )
+except ImportError:  # pragma: no cover - before graph milestone
+    ComputationGraphConfiguration = None  # type: ignore[assignment]

@@ -1,0 +1,70 @@
+"""Input types for shape inference (reference:
+``nn/conf/inputs/InputType.java`` — drives ``setInputType`` auto-config
+of nIn and automatic preprocessor insertion).
+
+Shape conventions follow the reference's data layout so iterators and
+checkpoints are drop-in compatible:
+- feed-forward activations: ``[batch, size]``
+- convolutional activations: ``[batch, channels, height, width]`` (NCHW)
+- recurrent activations: ``[batch, size, time]``
+
+XLA's TPU layout assignment re-tiles these internally; NCHW at the API
+boundary costs nothing after the first fusion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, asdict
+
+
+@dataclass(frozen=True)
+class InputType:
+    kind: str  # "feedforward" | "recurrent" | "convolutional" | "convolutionalFlat"
+    size: int = 0  # feedforward / recurrent feature size
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    timeseries_length: int = -1  # -1: unknown/variable
+
+    # -- factories (reference InputType.feedForward etc.) ------------------
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="feedforward", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timeseries_length: int = -1) -> "InputType":
+        return InputType(
+            kind="recurrent", size=int(size),
+            timeseries_length=int(timeseries_length),
+        )
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(
+            kind="convolutional", height=int(height), width=int(width),
+            channels=int(channels),
+        )
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        """Flattened image rows, e.g. MNIST 784 (reference
+        InputType.convolutionalFlat)."""
+        return InputType(
+            kind="convolutionalFlat", height=int(height), width=int(width),
+            channels=int(channels), size=int(height * width * channels),
+        )
+
+    # -- helpers -----------------------------------------------------------
+
+    def flat_size(self) -> int:
+        if self.kind in ("feedforward", "recurrent", "convolutionalFlat"):
+            return self.size if self.size else self.height * self.width * self.channels
+        return self.channels * self.height * self.width
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "InputType":
+        return InputType(**d)

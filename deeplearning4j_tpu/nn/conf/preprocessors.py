@@ -1,0 +1,214 @@
+"""Input preprocessors — shape adapters between layer families
+(reference: ``nn/conf/preprocessor/*.java``, 13 classes).
+
+Forward-only: backprop through a reshape/transpose is automatic under
+``jax.grad`` (the reference hand-writes a ``backprop`` twin per
+preprocessor). All are zero-cost under XLA — reshapes/transposes fuse
+into neighboring ops.
+
+A ``ShapeContext`` carries the minibatch size and time-series length so
+2-d -> 3-d adapters (FeedForwardToRnn) know the time axis; the
+reference recovers these from stored ``currentInput`` shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, Type
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+PREPROCESSOR_REGISTRY: Dict[str, Type["InputPreProcessor"]] = {}
+
+
+def register_preprocessor(cls):
+    PREPROCESSOR_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclass(frozen=True)
+class ShapeContext:
+    batch: int = 0
+    time: int = -1
+
+
+@dataclass(frozen=True)
+class InputPreProcessor:
+    def preprocess(self, x, ctx: ShapeContext):
+        return x
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def to_json(self) -> dict:
+        d = {"@class": type(self).__name__}
+        for f in dataclasses.fields(self):
+            d[f.name] = getattr(self, f.name)
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "InputPreProcessor":
+        d = dict(d)
+        cls = PREPROCESSOR_REGISTRY[d.pop("@class")]
+        if cls.from_json is not InputPreProcessor.from_json:
+            return cls.from_json({"@class": cls.__name__, **d})
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{
+            k: (tuple(v) if isinstance(v, list) else v)
+            for k, v in d.items() if k in names
+        })
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b, c, h, w] -> [b, c*h*w] (reference
+    ``CnnToFeedForwardPreProcessor.java``)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x, ctx):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(it.channels * it.height * it.width)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[b, c*h*w] -> [b, c, h, w]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def preprocess(self, x, ctx):
+        return x.reshape(x.shape[0], self.channels, self.height, self.width)
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b, size, t] -> [b*t, size] (dense layers see one row per
+    timestep, reference ``RnnToFeedForwardPreProcessor.java``)."""
+
+    def preprocess(self, x, ctx):
+        return jnp.transpose(x, (0, 2, 1)).reshape(-1, x.shape[1])
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(it.size)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[b*t, size] -> [b, size, t]."""
+
+    def preprocess(self, x, ctx):
+        t = ctx.time
+        return jnp.transpose(
+            x.reshape(-1, t, x.shape[-1]), (0, 2, 1)
+        )
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.size)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[b, c, h, w] (stacked time along batch) -> [b, c*h*w, t]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x, ctx):
+        t = ctx.time
+        flat = x.reshape(x.shape[0], -1)  # [b*t, chw]
+        return jnp.transpose(flat.reshape(-1, t, flat.shape[-1]), (0, 2, 1))
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.channels * it.height * it.width)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[b, c*h*w, t] -> [b*t, c, h, w]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x, ctx):
+        rows = jnp.transpose(x, (0, 2, 1)).reshape(-1, x.shape[1])
+        return rows.reshape(-1, self.channels, self.height, self.width)
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class ReshapePreProcessor(InputPreProcessor):
+    """Free-form reshape keeping the batch axis (reference
+    ``ReshapePreProcessor.java``)."""
+
+    shape: tuple = ()
+
+    def preprocess(self, x, ctx):
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class ZeroMeanPrePreProcessor(InputPreProcessor):
+    def preprocess(self, x, ctx):
+        return x - jnp.mean(x, axis=0, keepdims=True)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class UnitVarianceProcessor(InputPreProcessor):
+    def preprocess(self, x, ctx):
+        return x / (jnp.std(x, axis=0, keepdims=True) + 1e-8)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class ComposableInputPreProcessor(InputPreProcessor):
+    processors: tuple = ()
+
+    def preprocess(self, x, ctx):
+        for p in self.processors:
+            x = p.preprocess(x, ctx)
+        return x
+
+    def output_type(self, it: InputType) -> InputType:
+        for p in self.processors:
+            it = p.output_type(it)
+        return it
+
+    def to_json(self) -> dict:
+        return {
+            "@class": type(self).__name__,
+            "processors": [p.to_json() for p in self.processors],
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "ComposableInputPreProcessor":
+        return ComposableInputPreProcessor(
+            processors=tuple(
+                InputPreProcessor.from_json(p) for p in d["processors"]
+            )
+        )

@@ -1,0 +1,20 @@
+"""Layer implementations. Importing this package populates the JSON
+subtype registry (reference analog: Jackson subtype scan)."""
+
+from deeplearning4j_tpu.nn.layers.base import (  # noqa: F401
+    LAYER_REGISTRY,
+    FeedForwardLayerSpec,
+    LayerSpec,
+    layer_from_json,
+    layer_to_json,
+    register_layer,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import (  # noqa: F401
+    ActivationLayer,
+    BaseOutputLayerSpec,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    LossLayer,
+    OutputLayer,
+)

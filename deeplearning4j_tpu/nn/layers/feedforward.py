@@ -1,0 +1,144 @@
+"""Feed-forward layers: Dense, Output, Loss, Activation, Dropout,
+Embedding (reference: ``nn/layers/feedforward/**``, ``nn/layers/
+OutputLayer.java``, ``BaseLayer.java`` preOutput = x·W + b).
+
+The reference's BaseLayer does ``input.mmul(W).addiRowVector(b)`` as
+two native calls; here it is one traced expression the XLA fuser turns
+into a single MXU matmul with fused bias + activation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import losses as losses_mod
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import (
+    FeedForwardLayerSpec,
+    LayerSpec,
+    register_layer,
+)
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+@register_layer
+@dataclass(frozen=True)
+class DenseLayer(FeedForwardLayerSpec):
+    """Fully connected layer (reference ``nn/conf/layers/DenseLayer`` +
+    ``nn/layers/feedforward/dense/DenseLayer.java``)."""
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        w = init_weights(
+            key, (self.n_in, self.n_out), self.weight_init,
+            fan_in=self.n_in, fan_out=self.n_out,
+            distribution=self.dist, dtype=dtype,
+        )
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": w, "b": b}
+
+    def pre_output(self, params, x):
+        return x @ params["W"] + params["b"]
+
+    def apply(self, params, x, state, *, train=False, rng=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        return self.activate_fn()(self.pre_output(params, x)), state
+
+
+@dataclass(frozen=True)
+class BaseOutputLayerSpec(DenseLayer):
+    """Base for output layers carrying a loss function (reference
+    ``nn/conf/layers/BaseOutputLayer.java``)."""
+
+    loss: str = "MCXENT"
+
+    def has_loss(self) -> bool:
+        return True
+
+    def compute_score(self, params, x, labels, mask=None, average=True):
+        pre = self.pre_output(params, x)
+        return losses_mod.score(
+            self.loss, labels, pre, self.activation, mask, average
+        )
+
+
+@register_layer
+@dataclass(frozen=True)
+class OutputLayer(BaseOutputLayerSpec):
+    """Standard classification/regression head (reference
+    ``nn/layers/OutputLayer.java``). Default softmax+MCXENT."""
+
+    activation: str = "softmax"
+
+
+@register_layer
+@dataclass(frozen=True)
+class LossLayer(LayerSpec):
+    """Loss without params: applies activation + loss to its input
+    (reference ``nn/conf/layers/LossLayer.java``)."""
+
+    loss: str = "MCXENT"
+    activation: str = "identity"
+
+    def has_loss(self) -> bool:
+        return True
+
+    def pre_output(self, params, x):
+        return x
+
+    def apply(self, params, x, state, *, train=False, rng=None):
+        return self.activate_fn()(x), state
+
+    def compute_score(self, params, x, labels, mask=None, average=True):
+        return losses_mod.score(self.loss, labels, x, self.activation, mask, average)
+
+
+@register_layer
+@dataclass(frozen=True)
+class ActivationLayer(LayerSpec):
+    """Pure activation (reference ``nn/conf/layers/ActivationLayer``)."""
+
+    def apply(self, params, x, state, *, train=False, rng=None):
+        return self.activate_fn()(x), state
+
+
+@register_layer
+@dataclass(frozen=True)
+class DropoutLayer(LayerSpec):
+    """Standalone dropout. The reference has no DropoutLayer at this
+    version (dropout is a per-layer flag applied in BaseLayer,
+    SURVEY.md §2.1); provided for config convenience and Keras import."""
+
+    activation: str = "identity"
+
+    def apply(self, params, x, state, *, train=False, rng=None):
+        return self.maybe_dropout(x, train=train, rng=rng), state
+
+
+@register_layer
+@dataclass(frozen=True)
+class EmbeddingLayer(FeedForwardLayerSpec):
+    """Index -> row lookup (reference
+    ``nn/layers/feedforward/embedding/EmbeddingLayer.java:41`` — input
+    is a column of integer indices; forward is a row select, backward a
+    scatter-add, both native XLA gather/scatter on TPU)."""
+
+    activation: str = "identity"
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        w = init_weights(
+            key, (self.n_in, self.n_out), self.weight_init,
+            fan_in=self.n_in, fan_out=self.n_out,
+            distribution=self.dist, dtype=dtype,
+        )
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": w, "b": b}
+
+    def apply(self, params, x, state, *, train=False, rng=None):
+        # x: [batch, 1] or [batch] of integer indices
+        idx = x.reshape(-1).astype(jnp.int32)
+        out = params["W"][idx] + params["b"]
+        return self.activate_fn()(out), state

@@ -1,0 +1,463 @@
+"""MultiLayerNetwork — the sequential-stack model (reference:
+``nn/multilayer/MultiLayerNetwork.java``, 2,534 LoC).
+
+TPU-first redesign of the reference's imperative engine:
+
+- The reference's ``fit`` path crosses JVM->JNI->libnd4j per op
+  (SURVEY.md §3.1); here the ENTIRE minibatch step — forward, loss,
+  backward (``jax.grad``), gradient normalization, updater, parameter
+  step — is one jitted XLA program per input shape, compiled once and
+  cached. Parameters/updater-state buffers are donated so the step
+  updates in place in HBM.
+- The reference flattens params into one 1-D view array
+  (``init():367``); the idiomatic equivalent is a pytree
+  ``{layer: {name: array}}`` (shards naturally under pjit). A flat view
+  is still offered for serializer/tooling parity
+  (``params_flat``/``set_params_flat``).
+- Backprop (``calcBackpropGradients:1134``) does not exist as code:
+  ``jax.grad`` differentiates the same forward used for inference.
+- TBPTT (``doTruncatedBPTT:1210``) arrives with the recurrent stack:
+  the time axis is chunked host-side and RNN carry state is threaded
+  through the jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.preprocessors import ShapeContext
+from deeplearning4j_tpu.nn.updaters import MultiLayerUpdaterDef
+
+
+def _dtype_of(conf: MultiLayerConfiguration):
+    return jnp.dtype(conf.dtype)
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layer_names: List[str] = [
+            conf.layer_name(i) for i in range(len(conf.layers))
+        ]
+        if len(set(self.layer_names)) != len(self.layer_names):
+            raise ValueError("Duplicate layer names in configuration")
+        self.params: Optional[Dict[str, Dict[str, jax.Array]]] = None
+        self.state: Dict[str, dict] = {}
+        self.updater_def = MultiLayerUpdaterDef({
+            name: layer.updater_settings()
+            for name, layer in zip(self.layer_names, conf.layers)
+        })
+        self.updater_state = None
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.score_value = float("nan")
+        self.listeners: List[Any] = []
+        self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep state
+        self._tbptt_state: Dict[str, Any] = {}
+        self._jit_step = None
+        self._jit_output = None
+        self._base_key = jax.random.PRNGKey(conf.seed)
+
+    # ------------------------------------------------------------------
+    # init (reference MultiLayerNetwork.init():367)
+    # ------------------------------------------------------------------
+
+    def init(self, params: Optional[dict] = None) -> "MultiLayerNetwork":
+        dtype = _dtype_of(self.conf)
+        if params is not None:
+            self.params = params
+        else:
+            keys = jax.random.split(
+                self._base_key, max(len(self.conf.layers), 1)
+            )
+            self.params = {
+                name: layer.init_params(k, dtype)
+                for name, layer, k in zip(
+                    self.layer_names, self.conf.layers, keys
+                )
+            }
+        self.state = {
+            name: layer.init_state(dtype)
+            for name, layer in zip(self.layer_names, self.conf.layers)
+        }
+        self.updater_state = self.updater_def.init(self.params)
+        return self
+
+    # ------------------------------------------------------------------
+    # pure forward builders (these close over conf only — safe to jit)
+    # ------------------------------------------------------------------
+
+    def _ctx_for(self, x) -> ShapeContext:
+        t = x.shape[2] if x.ndim == 3 else -1
+        return ShapeContext(batch=x.shape[0], time=t)
+
+    def _forward_pure(
+        self, params, state, x, *, train: bool, rng, upto: Optional[int] = None,
+        collect: bool = False,
+    ):
+        """Forward through layers [0, upto]; returns (activation, preout
+        of last executed layer, new_state, [activations])."""
+        conf = self.conf
+        ctx = self._ctx_for(x)
+        n = len(conf.layers) if upto is None else upto + 1
+        new_state = dict(state)
+        acts = []
+        preout = None
+        for i in range(n):
+            name = self.layer_names[i]
+            layer = conf.layers[i]
+            if i in conf.preprocessors:
+                x = conf.preprocessors[i].preprocess(x, ctx)
+            lrng = None
+            if rng is not None:
+                lrng = jax.random.fold_in(rng, i)
+            if i == n - 1 and hasattr(layer, "pre_output") and layer.has_loss():
+                xin = layer.maybe_dropout(x, train=train, rng=lrng)
+                preout = layer.pre_output(params[name], xin)
+            x, st = layer.apply(
+                params[name], x, state.get(name, {}), train=train, rng=lrng
+            )
+            new_state[name] = st
+            if collect:
+                acts.append(x)
+        return x, preout, new_state, acts
+
+    def _score_pure(self, params, state, x, labels, mask, rng, *, train: bool):
+        """Loss score incl. L1/L2 penalty (reference computeGradientAndScore
+        adds calcL1/calcL2 to the loss)."""
+        out, preout, new_state, _ = self._forward_pure(
+            params, state, x, train=train, rng=rng
+        )
+        last = self.conf.layers[-1]
+        if not last.has_loss():
+            raise ValueError(
+                "Last layer has no loss function; use an OutputLayer/LossLayer"
+            )
+        name = self.layer_names[-1]
+        if preout is None:
+            preout = out
+        from deeplearning4j_tpu.nn import losses as losses_mod
+
+        score = losses_mod.score(
+            last.loss, labels, preout, last.activation, mask, True
+        )
+        reg = 0.0
+        for lname, layer in zip(self.layer_names, self.conf.layers):
+            if layer.l1 > 0.0 or layer.l2 > 0.0:
+                for pn in layer.regularizable_params():
+                    if pn in params[lname]:
+                        w = params[lname][pn]
+                        if layer.l2 > 0.0:
+                            reg = reg + 0.5 * layer.l2 * jnp.sum(w * w)
+                        if layer.l1 > 0.0:
+                            reg = reg + layer.l1 * jnp.sum(jnp.abs(w))
+        return score + reg, new_state
+
+    # ------------------------------------------------------------------
+    # jitted train step
+    # ------------------------------------------------------------------
+
+    def _build_step(self) -> Callable:
+        updater = self.updater_def
+
+        def step(params, upd_state, state, x, labels, mask, lrs, t, rng):
+            def loss_fn(p):
+                s, new_state = self._score_pure(
+                    p, state, x, labels, mask, rng, train=True
+                )
+                return s, new_state
+
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            new_params, new_upd = updater.update(
+                grads, upd_state, params, lrs, t
+            )
+            return new_params, new_upd, new_state, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    # public API (reference fit/output/score)
+    # ------------------------------------------------------------------
+
+    def fit(self, data, labels=None, *, epochs: int = 1) -> None:
+        """fit(DataSetIterator) / fit(x, y) (reference ``fit:1048``).
+
+        ``data`` may be a DataSetIterator-style iterable of objects with
+        ``.features``/``.labels`` (and optional ``.labels_mask``), a
+        single such object, or a raw (x, y) pair.
+        """
+        from deeplearning4j_tpu.datasets.api import DataSet
+
+        if labels is not None:
+            batches: Any = [DataSet(features=data, labels=labels)]
+            self._fit_batches(batches, epochs)
+            return
+        if hasattr(data, "features"):
+            self._fit_batches([data], epochs)
+            return
+        self._fit_batches(data, epochs)
+
+    def _fit_batches(self, iterator, epochs: int) -> None:
+        if self.params is None:
+            self.init()
+        for epoch in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            it = iter(iterator)
+            n_batches = 0
+            for ds in it:
+                self.fit_minibatch(ds)
+                n_batches += 1
+            if epoch > 0 and n_batches == 0:
+                raise ValueError(
+                    "Iterator yielded no batches after the first epoch — "
+                    "a plain generator cannot be re-iterated; pass a list, "
+                    "a DataSetIterator with reset(), or epochs=1"
+                )
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+            self.epoch_count += 1
+
+    def fit_minibatch(self, ds) -> float:
+        """One minibatch through ``conf.iterations`` optimizer steps
+        (reference Solver/StochasticGradientDescent.optimize)."""
+        if self.params is None:
+            self.init()
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
+        dtype = _dtype_of(self.conf)
+        x = jnp.asarray(ds.features, dtype)
+        y = jnp.asarray(ds.labels, dtype)
+        mask = getattr(ds, "labels_mask", None)
+        if (
+            self.conf.backprop_type == "TruncatedBPTT"
+            and x.ndim == 3
+            and x.shape[2] > self.conf.tbptt_fwd_length
+        ):
+            return self._fit_tbptt(x, y, mask)
+        if mask is not None:
+            mask = jnp.asarray(mask)
+        score = None
+        for _ in range(self.conf.iterations):
+            lrs = self.updater_def.scheduled_lrs(self.iteration_count)
+            t = jnp.asarray(self.iteration_count + 1, jnp.float32)
+            rng = jax.random.fold_in(self._base_key, self.iteration_count)
+            (
+                self.params, self.updater_state, self.state, score,
+            ) = self._jit_step(
+                self.params, self.updater_state, self.state,
+                x, y, mask,
+                {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
+                t, rng,
+            )
+            self.iteration_count += 1
+            self.score_value = float(score)
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration_count)
+        return float(score)
+
+    def _fit_tbptt(self, x, y, mask) -> float:
+        """Truncated BPTT: slice the time axis into fwdLen chunks and
+        carry RNN state between chunks (reference
+        ``doTruncatedBPTT:1210``, state carry ``:1259-1276``)."""
+        fwd = self.conf.tbptt_fwd_length
+        t_total = x.shape[2]
+        self.clear_tbptt_state()
+        score = 0.0
+        n_chunks = 0
+        for start in range(0, t_total, fwd):
+            end = min(start + fwd, t_total)
+            xs = x[:, :, start:end]
+            ys = y[:, :, start:end] if y.ndim == 3 else y
+            ms = mask[:, start:end] if mask is not None else None
+            score = self._fit_chunk_with_carry(xs, ys, ms)
+            n_chunks += 1
+        return score
+
+    def _fit_chunk_with_carry(self, xs, ys, ms) -> float:
+        # Recurrent layers read/write self._tbptt_state through the
+        # step function; wired up in the recurrent-stack milestone.
+        dtype = _dtype_of(self.conf)
+        xs = jnp.asarray(xs, dtype)
+        ys = jnp.asarray(ys, dtype)
+        if ms is not None:
+            ms = jnp.asarray(ms, dtype)
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
+        lrs = self.updater_def.scheduled_lrs(self.iteration_count)
+        t = jnp.asarray(self.iteration_count + 1, jnp.float32)
+        rng = jax.random.fold_in(self._base_key, self.iteration_count)
+        (
+            self.params, self.updater_state, self.state, score,
+        ) = self._jit_step(
+            self.params, self.updater_state, self.state, xs, ys, ms,
+            {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
+            t, rng,
+        )
+        self.iteration_count += 1
+        self.score_value = float(score)
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration_count)
+        return float(score)
+
+    def clear_tbptt_state(self) -> None:
+        self._tbptt_state = {}
+
+    # -- inference -----------------------------------------------------
+
+    def output(self, x, train: bool = False):
+        """Activated network output (reference ``output:1638``)."""
+        if self.params is None:
+            self.init()
+        if self._jit_output is None:
+            def out_fn(params, state, x):
+                out, _, _, _ = self._forward_pure(
+                    params, state, x, train=False, rng=None
+                )
+                return out
+            self._jit_output = jax.jit(out_fn)
+        return self._jit_output(
+            self.params, self.state, jnp.asarray(x, _dtype_of(self.conf))
+        )
+
+    def feed_forward(self, x, train: bool = False) -> List[jax.Array]:
+        """All per-layer activations (reference ``feedForward``)."""
+        if self.params is None:
+            self.init()
+        rng = self._base_key if train else None
+        _, _, _, acts = self._forward_pure(
+            self.params, self.state, jnp.asarray(x), train=train, rng=rng,
+            collect=True,
+        )
+        return acts
+
+    def feed_forward_to_layer(self, layer_idx: int, x, train: bool = False):
+        _, _, _, acts = self._forward_pure(
+            self.params, self.state, jnp.asarray(x), train=train,
+            rng=self._base_key if train else None, upto=layer_idx,
+            collect=True,
+        )
+        return acts
+
+    def score(self, ds=None, x=None, labels=None) -> float:
+        """Loss on a dataset (reference ``score(DataSet)``)."""
+        if ds is not None:
+            x, labels = ds.features, ds.labels
+            mask = getattr(ds, "labels_mask", None)
+        else:
+            mask = None
+        dtype = _dtype_of(self.conf)
+        s, _ = self._score_pure(
+            self.params, self.state, jnp.asarray(x, dtype),
+            jnp.asarray(labels, dtype),
+            jnp.asarray(mask, dtype) if mask is not None else None,
+            None, train=False,
+        )
+        return float(s)
+
+    def predict(self, x) -> np.ndarray:
+        """Argmax class predictions (reference ``predict``)."""
+        return np.asarray(jnp.argmax(self.output(x), axis=1))
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        e = Evaluation()
+        for ds in iterator:
+            out = self.output(ds.features)
+            e.eval(np.asarray(ds.labels), np.asarray(out),
+                   mask=np.asarray(ds.labels_mask)
+                   if getattr(ds, "labels_mask", None) is not None else None)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return e
+
+    # -- listeners ------------------------------------------------------
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    # -- parameter plumbing (flat-view parity) --------------------------
+
+    def num_params(self) -> int:
+        return sum(
+            int(np.prod(p.shape))
+            for lp in self.params.values()
+            for p in lp.values()
+        )
+
+    def _flat_order(self) -> List[Tuple[str, str]]:
+        order = []
+        for name, layer in zip(self.layer_names, self.conf.layers):
+            pnames = list(self.params[name].keys())
+            preferred = [p for p in ("W", "b") if p in pnames]
+            rest = [p for p in pnames if p not in ("W", "b")]
+            for pn in preferred + sorted(rest):
+                order.append((name, pn))
+        return order
+
+    def params_flat(self) -> np.ndarray:
+        """1-D concatenated view (reference flat params array)."""
+        chunks = [
+            np.asarray(self.params[ln][pn]).ravel()
+            for ln, pn in self._flat_order()
+        ]
+        return np.concatenate(chunks) if chunks else np.zeros((0,))
+
+    def set_params_flat(self, vec) -> None:
+        vec = np.asarray(vec)
+        off = 0
+        for ln, pn in self._flat_order():
+            p = self.params[ln][pn]
+            n = int(np.prod(p.shape))
+            self.params[ln][pn] = jnp.asarray(
+                vec[off:off + n].reshape(p.shape), p.dtype
+            )
+            off += n
+        if off != vec.size:
+            raise ValueError(
+                f"Param vector length {vec.size} != model params {off}"
+            )
+
+    def copy(self) -> "MultiLayerNetwork":
+        # Deep-copy device buffers: the jitted step donates
+        # params/updater-state/state, so sharing arrays between two
+        # networks would let one fit() invalidate the other's buffers
+        # on TPU ("Array has been deleted").
+        clone = lambda a: jnp.array(a, copy=True)
+        m = MultiLayerNetwork(self.conf)
+        m.init(params=jax.tree_util.tree_map(clone, self.params))
+        m.updater_state = jax.tree_util.tree_map(clone, self.updater_state)
+        m.state = jax.tree_util.tree_map(clone, self.state)
+        return m
+
+    def summary(self) -> str:
+        lines = ["=" * 70]
+        lines.append(f"{'idx/name':<16}{'type':<28}{'params':>10}")
+        lines.append("-" * 70)
+        total = 0
+        for name, layer in zip(self.layer_names, self.conf.layers):
+            n = sum(
+                int(np.prod(p.shape)) for p in self.params[name].values()
+            ) if self.params else 0
+            total += n
+            lines.append(f"{name:<16}{type(layer).__name__:<28}{n:>10}")
+        lines.append("-" * 70)
+        lines.append(f"Total params: {total}")
+        lines.append("=" * 70)
+        return "\n".join(lines)

@@ -1,0 +1,280 @@
+"""Per-layer optimizer machinery (reference: ``nn/updater/LayerUpdater``
++ nd4j ``GradientUpdater`` impls, ``LayerUpdater.java:243-266``, and
+``MultiLayerUpdater`` aggregating per-layer state).
+
+Design: the whole update is a pure function living *inside* the jitted
+train step — gradient normalization, L1/L2 regularization, the updater
+rule, and the parameter step fuse into one XLA program instead of the
+reference's sequence of separate native op launches. Updater state is a
+pytree shaped like the params pytree (the reference keeps one flat state
+view array; a pytree is the idiomatic equivalent and shards the same
+way params do under pjit).
+
+Learning-rate policies (``LearningRatePolicy`` enum in the reference,
+applied at ``LayerUpdater.applyLrDecayPolicy``) are computed host-side
+per iteration and passed into the step as a traced scalar, so schedule
+changes never trigger recompilation.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# Per-layer updater settings (extracted from layer configs by the network)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class UpdaterSettings:
+    """Everything LayerUpdater needs for one layer."""
+
+    updater: str = "SGD"
+    learning_rate: float = 0.1
+    bias_learning_rate: float | None = None
+    bias_params: tuple = ("b",)
+    momentum: float = 0.9  # NESTEROVS
+    adam_mean_decay: float = 0.9
+    adam_var_decay: float = 0.999
+    rho: float = 0.95  # ADADELTA
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+    l1: float = 0.0
+    l2: float = 0.0
+    gradient_normalization: str = "None"
+    gradient_normalization_threshold: float = 1.0
+    # LR policy (host-side schedule)
+    lr_policy: str = "None"
+    lr_policy_decay_rate: float = 0.0
+    lr_policy_steps: float = 1.0
+    lr_policy_power: float = 1.0
+    lr_score_decay: float = 0.0
+    max_num_iterations: int = 100000
+    lr_schedule: dict | None = None  # {iteration: lr}
+    regularizable: tuple = ("W",)  # param names subject to l1/l2
+
+
+def scheduled_lr(s: UpdaterSettings, iteration: int) -> float:
+    """Host-side LR schedule (reference ``LearningRatePolicy``)."""
+    lr = s.learning_rate
+    p = s.lr_policy
+    if p in ("None", "Score", None):
+        return lr
+    if p == "Exponential":
+        return lr * (s.lr_policy_decay_rate ** iteration)
+    if p == "Inverse":
+        return lr / ((1.0 + s.lr_policy_decay_rate * iteration) ** s.lr_policy_power)
+    if p == "Poly":
+        frac = min(iteration / max(s.max_num_iterations, 1), 1.0)
+        return lr * ((1.0 - frac) ** s.lr_policy_power)
+    if p == "Sigmoid":
+        return lr / (
+            1.0 + math.exp(-s.lr_policy_decay_rate * (iteration - s.lr_policy_steps))
+        )
+    if p == "Step":
+        return lr * (s.lr_policy_decay_rate ** math.floor(iteration / s.lr_policy_steps))
+    if p == "TorchStep":
+        # Reference persists each decay multiplicatively
+        # (LayerUpdater.java:142): every iteration i in [2, iteration]
+        # with steps % i == 0 compounds one decay factor.
+        n_decays = sum(
+            1 for i in range(2, iteration + 1)
+            if s.lr_policy_steps % i == 0
+        )
+        return lr * (s.lr_policy_decay_rate ** n_decays)
+    if p == "Schedule":
+        if s.lr_schedule:
+            best = None
+            for k, v in s.lr_schedule.items():
+                if int(k) <= iteration and (best is None or int(k) > best[0]):
+                    best = (int(k), v)
+            if best is not None:
+                return best[1]
+        return lr
+    raise ValueError(f"Unknown LR policy '{p}'")
+
+
+# ---------------------------------------------------------------------------
+# Updater rules: state init + pure update
+# ---------------------------------------------------------------------------
+
+
+def _init_like(p, n):
+    return tuple(jnp.zeros_like(p) for _ in range(n))
+
+
+def init_param_state(s: UpdaterSettings, param: jax.Array) -> tuple:
+    u = s.updater.upper()
+    if u in ("SGD", "NONE"):
+        return ()
+    if u in ("NESTEROVS", "ADAGRAD", "RMSPROP"):
+        return _init_like(param, 1)
+    if u == "ADAM":
+        return _init_like(param, 2)
+    if u == "ADADELTA":
+        return _init_like(param, 2)
+    raise ValueError(f"Unknown updater '{s.updater}'")
+
+
+def apply_updater(
+    s: UpdaterSettings,
+    grad: jax.Array,
+    state: tuple,
+    lr: jax.Array,
+    t: jax.Array,
+) -> tuple[jax.Array, tuple]:
+    """Return (step, new_state); caller applies ``param -= step``.
+
+    ``t`` is the 1-based iteration count (for Adam bias correction),
+    traced so it never recompiles.
+    """
+    u = s.updater.upper()
+    if u == "SGD":
+        return lr * grad, ()
+    if u == "NONE":
+        return grad, ()
+    if u == "NESTEROVS":
+        (v,) = state
+        v_new = s.momentum * v - lr * grad
+        # reference Nesterovs: ret = -(mu * v_prev - (1 + mu) * v_new)
+        step = s.momentum * v - (1.0 + s.momentum) * v_new
+        return step, (v_new,)
+    if u == "ADAGRAD":
+        (h,) = state
+        h_new = h + grad * grad
+        return lr * grad / (jnp.sqrt(h_new) + s.epsilon), (h_new,)
+    if u == "RMSPROP":
+        (h,) = state
+        h_new = s.rms_decay * h + (1.0 - s.rms_decay) * grad * grad
+        return lr * grad / jnp.sqrt(h_new + s.epsilon), (h_new,)
+    if u == "ADAM":
+        m, v = state
+        b1, b2 = s.adam_mean_decay, s.adam_var_decay
+        m_new = b1 * m + (1.0 - b1) * grad
+        v_new = b2 * v + (1.0 - b2) * grad * grad
+        t_f = t.astype(m_new.dtype) if hasattr(t, "astype") else jnp.asarray(
+            t, m_new.dtype
+        )
+        m_hat = m_new / (1.0 - b1 ** t_f)
+        v_hat = v_new / (1.0 - b2 ** t_f)
+        return lr * m_hat / (jnp.sqrt(v_hat) + s.epsilon), (m_new, v_new)
+    if u == "ADADELTA":
+        eg, ex = state
+        rho = s.rho
+        eg_new = rho * eg + (1.0 - rho) * grad * grad
+        dx = grad * jnp.sqrt(ex + s.epsilon) / jnp.sqrt(eg_new + s.epsilon)
+        ex_new = rho * ex + (1.0 - rho) * dx * dx
+        return dx, (eg_new, ex_new)
+    raise ValueError(f"Unknown updater '{s.updater}'")
+
+
+# ---------------------------------------------------------------------------
+# Gradient normalization (reference GradientNormalization enum,
+# applied in LayerUpdater.preApply)
+# ---------------------------------------------------------------------------
+
+
+def normalize_layer_grads(
+    s: UpdaterSettings, grads: dict[str, jax.Array]
+) -> dict[str, jax.Array]:
+    gn = s.gradient_normalization
+    if gn in ("None", None):
+        return grads
+    thr = s.gradient_normalization_threshold
+    if gn == "RenormalizeL2PerLayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in grads.values()) + 1e-12)
+        return {k: g / norm for k, g in grads.items()}
+    if gn == "RenormalizeL2PerParamType":
+        return {
+            k: g / jnp.sqrt(jnp.sum(g * g) + 1e-12) for k, g in grads.items()
+        }
+    if gn == "ClipElementWiseAbsoluteValue":
+        return {k: jnp.clip(g, -thr, thr) for k, g in grads.items()}
+    if gn == "ClipL2PerLayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in grads.values()) + 1e-12)
+        scale = jnp.minimum(1.0, thr / norm)
+        return {k: g * scale for k, g in grads.items()}
+    if gn == "ClipL2PerParamType":
+        out = {}
+        for k, g in grads.items():
+            norm = jnp.sqrt(jnp.sum(g * g) + 1e-12)
+            out[k] = g * jnp.minimum(1.0, thr / norm)
+        return out
+    raise ValueError(f"Unknown gradient normalization '{gn}'")
+
+
+# ---------------------------------------------------------------------------
+# Multi-layer aggregation (reference MultiLayerUpdater)
+# ---------------------------------------------------------------------------
+
+
+class MultiLayerUpdaterDef:
+    """Holds per-layer UpdaterSettings; provides pure init/update over
+    the whole network's params pytree ``{layer_name: {param: array}}``."""
+
+    def __init__(self, settings: dict[str, UpdaterSettings]):
+        self.settings = settings
+
+    def init(self, params: dict[str, dict[str, jax.Array]]):
+        return {
+            ln: {
+                pn: init_param_state(self.settings[ln], p)
+                for pn, p in lp.items()
+            }
+            for ln, lp in params.items()
+        }
+
+    def scheduled_lrs(self, iteration: int) -> dict[str, float]:
+        return {
+            ln: scheduled_lr(s, iteration) for ln, s in self.settings.items()
+        }
+
+    def update(
+        self,
+        grads: dict[str, dict[str, jax.Array]],
+        state: dict,
+        params: dict[str, dict[str, jax.Array]],
+        lrs: dict[str, jax.Array],
+        t: jax.Array,
+    ):
+        """Pure: returns (new_params, new_state). Runs inside jit.
+
+        L1/L2 regularization is NOT added here: the penalty lives in
+        the network's score function, so ``jax.grad`` already includes
+        ``l2*W + l1*sign(W)`` exactly once (the reference adds it in
+        ``postApply`` because its loss gradient excludes the penalty;
+        adding it here too would double-apply it). Consequence vs the
+        reference: gradient normalization acts on the penalty-inclusive
+        gradient.
+
+        Biases (param names in ``s.bias_params``) use
+        ``bias_learning_rate`` when configured (reference
+        ``biasLearningRate``).
+        """
+        new_params: dict[str, Any] = {}
+        new_state: dict[str, Any] = {}
+        for ln, lgrads in grads.items():
+            s = self.settings[ln]
+            lgrads = normalize_layer_grads(s, lgrads)
+            lr = lrs[ln]
+            bias_scale = (
+                s.bias_learning_rate / s.learning_rate
+                if (s.bias_learning_rate is not None and s.learning_rate != 0)
+                else 1.0
+            )
+            np_, ns_ = {}, {}
+            for pn, g in lgrads.items():
+                p = params[ln][pn]
+                p_lr = lr * bias_scale if pn in s.bias_params else lr
+                step, st = apply_updater(s, g, state[ln][pn], p_lr, t)
+                np_[pn] = p - step
+                ns_[pn] = st
+            new_params[ln] = np_
+            new_state[ln] = ns_
+        return new_params, new_state

@@ -1,0 +1,114 @@
+"""Weight initialization (reference: ``nn/weights/WeightInit.java`` +
+``WeightInitUtil.java``).
+
+The reference computes fan-in/fan-out from the param shape and fills an
+INDArray via nd4j RNG; here each scheme is a pure function of a jax PRNG
+key, so initialization is reproducible from the config ``seed`` alone
+and identical across hosts (important for multi-host init: every host
+materializes identical params from the same key, no broadcast needed).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Distribution:
+    """Config bean for WeightInit.DISTRIBUTION (reference
+    ``nn/conf/distribution/*.java``)."""
+
+    kind: str = "normal"  # normal | uniform | binomial
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    n_trials: int = 1
+    prob: float = 0.5
+
+    def sample(self, key: jax.Array, shape: Sequence[int], dtype) -> jax.Array:
+        if self.kind == "normal":
+            return self.mean + self.std * jax.random.normal(key, shape, dtype)
+        if self.kind == "uniform":
+            return jax.random.uniform(
+                key, shape, dtype, minval=self.lower, maxval=self.upper
+            )
+        if self.kind == "binomial":
+            return jax.random.binomial(
+                key, self.n_trials, self.prob, shape=shape, dtype=dtype
+            )
+        raise ValueError(f"Unknown distribution kind '{self.kind}'")
+
+    def to_json(self) -> dict:
+        return {
+            "kind": self.kind, "mean": self.mean, "std": self.std,
+            "lower": self.lower, "upper": self.upper,
+            "n_trials": self.n_trials, "prob": self.prob,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "Distribution":
+        return Distribution(**d)
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    weight_init: str,
+    *,
+    fan_in: float,
+    fan_out: float,
+    distribution: Distribution | None = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Initialize a weight array per the named scheme.
+
+    ``fan_in``/``fan_out`` are passed explicitly because for conv
+    kernels they are receptive-field products, not raw dims (reference
+    ``ConvolutionParamInitializer``).
+    """
+    shape = tuple(int(s) for s in shape)
+    wi = weight_init.upper()
+    if wi == "ZERO":
+        return jnp.zeros(shape, dtype)
+    if wi == "ONES":
+        return jnp.ones(shape, dtype)
+    if wi == "IDENTITY":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2-d shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if wi == "DISTRIBUTION":
+        dist = distribution or Distribution()
+        return dist.sample(key, shape, dtype)
+    if wi == "NORMAL":  # N(0, 1/sqrt(fan_in)) — reference "NORMALIZED"-era
+        return jax.random.normal(key, shape, dtype) / math.sqrt(max(fan_in, 1.0))
+    if wi == "LECUN_NORMAL":
+        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / max(fan_in, 1.0))
+    if wi == "XAVIER":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return jax.random.normal(key, shape, dtype) * std
+    if wi == "XAVIER_UNIFORM":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if wi == "XAVIER_FAN_IN":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(max(fan_in, 1.0))
+    if wi == "RELU":  # He init
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / max(fan_in, 1.0))
+    if wi == "RELU_UNIFORM":
+        a = math.sqrt(6.0 / max(fan_in, 1.0))
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if wi == "SIGMOID_UNIFORM":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if wi == "UNIFORM":
+        a = 1.0 / math.sqrt(max(fan_in, 1.0))
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if wi == "VI":  # legacy "variance init" from the reference era
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    raise ValueError(f"Unknown weight init '{weight_init}'")

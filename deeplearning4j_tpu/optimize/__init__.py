@@ -1,0 +1,10 @@
+"""Optimization & listeners (reference ``optimize/**``)."""
+
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    CollectScoresIterationListener,
+    ComposableIterationListener,
+    IterationListener,
+    ParamAndGradientIterationListener,
+    PerformanceListener,
+    ScoreIterationListener,
+)

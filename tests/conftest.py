@@ -1,0 +1,26 @@
+"""Test harness config.
+
+Mirrors the reference's two-profile test strategy (SURVEY.md §4: the
+same suite runs under -P test-nd4j-native and -P test-nd4j-cuda-8.0):
+tests run on the jax CPU backend with 8 virtual devices so multi-chip
+sharding paths (pjit over a Mesh) are exercised without TPU hardware;
+the same suite runs unchanged on a real TPU by unsetting JAX_PLATFORMS.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(12345)

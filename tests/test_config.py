@@ -1,0 +1,102 @@
+"""Config DSL + JSON round-trip tests (reference analog:
+``TestJsonYaml``, ``MultiLayerTest`` config sections)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    DenseLayer,
+    LayerSpec,
+    OutputLayer,
+    register_layer,
+)
+
+
+def build_mlp_conf():
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(42)
+        .learning_rate(0.05)
+        .updater("ADAM")
+        .activation("relu")
+        .weight_init("XAVIER")
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8))
+        .layer(DenseLayer(n_out=6))
+        .layer(OutputLayer(n_out=3, loss="MCXENT"))
+        .build()
+    )
+
+
+def test_builder_global_defaults_flow_into_layers():
+    conf = build_mlp_conf()
+    assert conf.layers[0].activation == "relu"
+    assert conf.layers[0].updater == "ADAM"
+    assert conf.layers[0].learning_rate == 0.05
+    # OutputLayer declares softmax explicitly -> not overridden
+    assert conf.layers[2].activation == "softmax"
+
+
+def test_nin_chaining_without_input_type():
+    conf = build_mlp_conf()
+    assert conf.layers[1].n_in == 8
+    assert conf.layers[2].n_in == 6
+
+
+def test_json_round_trip():
+    conf = build_mlp_conf()
+    s = conf.to_json()
+    back = MultiLayerConfiguration.from_json(s)
+    assert back == conf
+
+
+def test_yaml_round_trip():
+    pytest.importorskip("yaml")
+    conf = build_mlp_conf()
+    back = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+    assert back == conf
+
+
+def test_input_type_feedforward_inference():
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .list()
+        .layer(DenseLayer(n_out=10))
+        .layer(OutputLayer(n_out=2))
+        .set_input_type(InputType.feed_forward(20))
+        .build()
+    )
+    assert conf.layers[0].n_in == 20
+    assert conf.layers[1].n_in == 10
+
+
+def test_custom_layer_registration_round_trip():
+    from dataclasses import dataclass
+
+    @register_layer
+    @dataclass(frozen=True)
+    class MyCustomLayer(DenseLayer):
+        custom_knob: float = 2.5
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .list()
+        .layer(MyCustomLayer(n_in=3, n_out=4, custom_knob=7.0))
+        .layer(OutputLayer(n_in=4, n_out=2))
+        .build()
+    )
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.layers[0].custom_knob == 7.0
+    assert type(back.layers[0]).__name__ == "MyCustomLayer"
+
+
+def test_unknown_builder_option_raises():
+    b = NeuralNetConfiguration.Builder()
+    with pytest.raises(AttributeError):
+        b.not_a_real_option(1)

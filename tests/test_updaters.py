@@ -1,0 +1,94 @@
+"""Updater semantics (reference analog: ``TestUpdaters``)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.nn.updaters import (
+    MultiLayerUpdaterDef,
+    UpdaterSettings,
+    apply_updater,
+    init_param_state,
+    normalize_layer_grads,
+    scheduled_lr,
+)
+
+
+def run_updater(name, lr=0.1, steps=3, **kw):
+    s = UpdaterSettings(updater=name, learning_rate=lr, **kw)
+    p = jnp.asarray(np.ones(4, np.float32))
+    g = jnp.asarray(np.full(4, 0.5, np.float32))
+    st = init_param_state(s, p)
+    for t in range(1, steps + 1):
+        step, st = apply_updater(s, g, st, jnp.asarray(lr), jnp.asarray(float(t)))
+        p = p - step
+    return np.asarray(p)
+
+
+def test_sgd_exact():
+    p = run_updater("SGD", lr=0.1, steps=1)
+    np.testing.assert_allclose(p, 1.0 - 0.1 * 0.5, rtol=1e-6)
+
+
+def test_none_passes_raw_gradient():
+    p = run_updater("NONE", lr=0.1, steps=1)
+    np.testing.assert_allclose(p, 1.0 - 0.5, rtol=1e-6)
+
+
+def test_adam_first_step_is_lr_sized():
+    # bias-corrected first Adam step ~= lr * sign(grad)
+    p = run_updater("ADAM", lr=0.1, steps=1)
+    np.testing.assert_allclose(p, 1.0 - 0.1, rtol=1e-3)
+
+
+@pytest.mark.parametrize("name", [
+    "SGD", "ADAM", "NESTEROVS", "ADAGRAD", "RMSPROP", "ADADELTA", "NONE",
+])
+def test_all_updaters_step_downhill(name):
+    p = run_updater(name, steps=5)
+    assert np.all(p < 1.0)
+
+
+def test_lr_policies():
+    s = UpdaterSettings(learning_rate=1.0, lr_policy="Step",
+                        lr_policy_decay_rate=0.5, lr_policy_steps=10)
+    assert scheduled_lr(s, 0) == 1.0
+    assert scheduled_lr(s, 10) == 0.5
+    assert scheduled_lr(s, 25) == 0.25
+    s2 = UpdaterSettings(learning_rate=1.0, lr_policy="Exponential",
+                         lr_policy_decay_rate=0.9)
+    assert abs(scheduled_lr(s2, 2) - 0.81) < 1e-9
+    s3 = UpdaterSettings(learning_rate=1.0, lr_policy="Schedule",
+                         lr_schedule={0: 1.0, 5: 0.1, 20: 0.01})
+    assert scheduled_lr(s3, 4) == 1.0
+    assert scheduled_lr(s3, 7) == 0.1
+    assert scheduled_lr(s3, 30) == 0.01
+
+
+def test_gradient_clipping_elementwise():
+    s = UpdaterSettings(gradient_normalization="ClipElementWiseAbsoluteValue",
+                        gradient_normalization_threshold=0.2)
+    g = {"W": jnp.asarray(np.array([1.0, -1.0, 0.1], np.float32))}
+    out = normalize_layer_grads(s, g)
+    np.testing.assert_allclose(np.asarray(out["W"]), [0.2, -0.2, 0.1],
+                               rtol=1e-6)
+
+
+def test_clip_l2_per_layer():
+    s = UpdaterSettings(gradient_normalization="ClipL2PerLayer",
+                        gradient_normalization_threshold=1.0)
+    g = {"W": jnp.asarray(np.full(4, 10.0, np.float32))}
+    out = normalize_layer_grads(s, g)
+    norm = np.linalg.norm(np.asarray(out["W"]))
+    assert abs(norm - 1.0) < 1e-4
+
+
+def test_multilayer_updater_state_shapes():
+    params = {"0": {"W": jnp.zeros((3, 4)), "b": jnp.zeros((4,))}}
+    d = MultiLayerUpdaterDef({"0": UpdaterSettings(updater="ADAM")})
+    st = d.init(params)
+    assert len(st["0"]["W"]) == 2
+    grads = {"0": {"W": jnp.ones((3, 4)), "b": jnp.ones((4,))}}
+    newp, newst = d.update(grads, st, params,
+                           {"0": jnp.asarray(0.1)}, jnp.asarray(1.0))
+    assert newp["0"]["W"].shape == (3, 4)
