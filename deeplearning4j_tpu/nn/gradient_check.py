@@ -35,6 +35,8 @@ def check_gradients(
     max_per_param: Optional[int] = None,
     print_results: bool = False,
     seed: int = 0,
+    train: bool = False,
+    features_mask: Optional[np.ndarray] = None,
 ) -> bool:
     """Returns True if all checked parameters pass.
 
@@ -54,9 +56,15 @@ def check_gradients(
     x64 = jnp.asarray(np.asarray(x), jnp.float64)
     y64 = jnp.asarray(np.asarray(labels), jnp.float64)
     m64 = jnp.asarray(np.asarray(mask), jnp.float64) if mask is not None else None
+    fm64 = (
+        jnp.asarray(np.asarray(features_mask), jnp.float64)
+        if features_mask is not None else None
+    )
 
     def score_fn(p):
-        s, _ = model._score_pure(p, state, x64, y64, m64, None, train=False)
+        s, _ = model._score_pure(
+            p, state, x64, y64, m64, None, train=train, fmask=fm64
+        )
         return s
 
     score_jit = jax.jit(score_fn)

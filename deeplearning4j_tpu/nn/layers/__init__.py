@@ -18,3 +18,14 @@ from deeplearning4j_tpu.nn.layers.feedforward import (  # noqa: F401
     LossLayer,
     OutputLayer,
 )
+from deeplearning4j_tpu.nn.layers.convolution import (  # noqa: F401
+    BatchNormalization,
+    ConvolutionLayer,
+    LocalResponseNormalization,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    RnnOutputLayer,
+)

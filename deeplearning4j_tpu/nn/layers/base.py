@@ -153,8 +153,21 @@ class LayerSpec:
 
     # -- forward -----------------------------------------------------------
 
-    def apply(self, params, x, state, *, train: bool = False, rng=None):
+    def apply(self, params, x, state, *, train: bool = False, rng=None,
+              mask=None):
+        """``mask``: optional [batch, time] features mask, consumed by
+        recurrent layers; others ignore it."""
         raise NotImplementedError
+
+    def is_recurrent(self) -> bool:
+        """True for layers with streaming/TBPTT carry state (reference
+        ``RecurrentLayer`` interface)."""
+        return False
+
+    def can_stream(self) -> bool:
+        """False for layers that need the whole sequence (bidirectional
+        RNNs) and therefore cannot be used with rnn_time_step."""
+        return True
 
     # -- helpers -----------------------------------------------------------
 

@@ -43,7 +43,7 @@ class DenseLayer(FeedForwardLayerSpec):
     def pre_output(self, params, x):
         return x @ params["W"] + params["b"]
 
-    def apply(self, params, x, state, *, train=False, rng=None):
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
         return self.activate_fn()(self.pre_output(params, x)), state
 
@@ -89,7 +89,7 @@ class LossLayer(LayerSpec):
     def pre_output(self, params, x):
         return x
 
-    def apply(self, params, x, state, *, train=False, rng=None):
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         return self.activate_fn()(x), state
 
     def compute_score(self, params, x, labels, mask=None, average=True):
@@ -101,7 +101,7 @@ class LossLayer(LayerSpec):
 class ActivationLayer(LayerSpec):
     """Pure activation (reference ``nn/conf/layers/ActivationLayer``)."""
 
-    def apply(self, params, x, state, *, train=False, rng=None):
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         return self.activate_fn()(x), state
 
 
@@ -114,7 +114,7 @@ class DropoutLayer(LayerSpec):
 
     activation: str = "identity"
 
-    def apply(self, params, x, state, *, train=False, rng=None):
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         return self.maybe_dropout(x, train=train, rng=rng), state
 
 
@@ -137,7 +137,7 @@ class EmbeddingLayer(FeedForwardLayerSpec):
         b = jnp.full((self.n_out,), self.bias_init, dtype)
         return {"W": w, "b": b}
 
-    def apply(self, params, x, state, *, train=False, rng=None):
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         # x: [batch, 1] or [batch] of integer indices
         idx = x.reshape(-1).astype(jnp.int32)
         out = params["W"][idx] + params["b"]

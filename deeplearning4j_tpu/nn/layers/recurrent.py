@@ -1,0 +1,265 @@
+"""Recurrent stack (reference: ``nn/layers/recurrent/GravesLSTM.java``,
+``GravesBidirectionalLSTM.java``, shared math in ``LSTMHelpers.java:56``
+(activateHelper, per-timestep loop with fused ifog gate mmul at
+``:159``) and ``RnnOutputLayer``).
+
+TPU-first design:
+- The reference loops over timesteps in Java, launching a fused-gate
+  mmul per step. Here the input projection ``x·W`` for ALL timesteps is
+  ONE big MXU matmul (``[t*b, nIn]·[nIn, 4n]``) hoisted out of the
+  recurrence; only the sequential ``h·RW`` stays inside ``lax.scan``,
+  which XLA compiles to a single fused while-loop — no per-step
+  dispatch.
+- State (h, c) is carried functionally: standard training resets it
+  per minibatch, TBPTT threads it across chunks, ``rnnTimeStep``
+  streams it across calls (reference ``stateMap``/``tBpttStateMap``).
+- Variable-length sequences use a [batch, time] mask: masked steps
+  pass state through unchanged and output zeros (reference masking
+  exercised by ``GradientCheckTestsMasking``).
+
+Gate packing is ifog (input, forget, output, block-input) like the
+reference; peephole weights (Graves-style) are separate named params
+``pI``/``pF``/``pO`` rather than packed into RW's trailing columns —
+documented divergence for a cleaner pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn import activations as act_mod
+from deeplearning4j_tpu.nn import losses as losses_mod
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import LayerSpec, register_layer
+from deeplearning4j_tpu.nn.layers.feedforward import BaseOutputLayerSpec
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+def _lstm_params(key, n_in, n_out, weight_init, dist, forget_bias, dtype,
+                 peephole: bool):
+    k1, k2, k3 = jax.random.split(key, 3)
+    p = {
+        "W": init_weights(k1, (n_in, 4 * n_out), weight_init,
+                          fan_in=n_in, fan_out=n_out,
+                          distribution=dist, dtype=dtype),
+        "RW": init_weights(k2, (n_out, 4 * n_out), weight_init,
+                           fan_in=n_out, fan_out=n_out,
+                           distribution=dist, dtype=dtype),
+        "b": jnp.concatenate([
+            jnp.zeros((n_out,), dtype),                    # i
+            jnp.full((n_out,), forget_bias, dtype),        # f
+            jnp.zeros((2 * n_out,), dtype),                # o, g
+        ]),
+    }
+    if peephole:
+        p["pI"] = jnp.zeros((n_out,), dtype)
+        p["pF"] = jnp.zeros((n_out,), dtype)
+        p["pO"] = jnp.zeros((n_out,), dtype)
+    return p
+
+
+def _lstm_scan(p, x_bnt, h0, c0, mask_bt, gate_fn, act_fn, peephole,
+               reverse: bool = False):
+    """Run the LSTM over [b, nIn, t] input; returns ([b, n, t] outputs,
+    (hT, cT))."""
+    n = h0.shape[-1]
+    # [b, nIn, t] -> [t, b, nIn]
+    x_tbi = jnp.transpose(x_bnt, (2, 0, 1))
+    if reverse:
+        x_tbi = jnp.flip(x_tbi, axis=0)
+    # fused ifog input projection for all timesteps: one MXU matmul
+    xin = x_tbi @ p["W"] + p["b"]  # [t, b, 4n]
+    if mask_bt is not None:
+        m_tb = jnp.transpose(mask_bt, (1, 0))[:, :, None]  # [t, b, 1]
+        if reverse:
+            m_tb = jnp.flip(m_tb, axis=0)
+    else:
+        m_tb = None
+
+    def cell(carry, inp):
+        h, c = carry
+        if m_tb is None:
+            xproj = inp
+            m = None
+        else:
+            xproj, m = inp
+        z = xproj + h @ p["RW"]
+        zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+        if peephole:
+            zi = zi + c * p["pI"]
+            zf = zf + c * p["pF"]
+        i = gate_fn(zi)
+        f = gate_fn(zf)
+        g = act_fn(zg)
+        c_new = f * c + i * g
+        if peephole:
+            zo = zo + c_new * p["pO"]
+        o = gate_fn(zo)
+        h_new = o * act_fn(c_new)
+        if m is not None:
+            h_new = m * h_new + (1.0 - m) * h
+            c_new = m * c_new + (1.0 - m) * c
+            out = m * h_new
+        else:
+            out = h_new
+        return (h_new, c_new), out
+
+    xs = xin if m_tb is None else (xin, m_tb)
+    (hT, cT), outs = lax.scan(cell, (h0, c0), xs)
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    # [t, b, n] -> [b, n, t]
+    return jnp.transpose(outs, (1, 2, 0)), (hT, cT)
+
+
+@register_layer
+@dataclass(frozen=True)
+class GravesLSTM(LayerSpec):
+    """Graves-style LSTM with peepholes (reference ``GravesLSTM.java:40``
+    + ``LSTMHelpers``)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    activation: str = "tanh"
+    gate_activation: str = "sigmoid"
+    forget_gate_bias_init: float = 1.0
+    peephole: bool = True
+
+    def input_kind(self) -> str:
+        return "recurrent"
+
+    def is_recurrent(self) -> bool:
+        return True
+
+    def with_input_type(self, it: InputType) -> "GravesLSTM":
+        if self.n_in == 0:
+            return dataclasses.replace(self, n_in=it.size or it.flat_size())
+        return self
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def regularizable_params(self) -> tuple:
+        return ("W", "RW")
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        return _lstm_params(
+            key, self.n_in, self.n_out, self.weight_init, self.dist,
+            self.forget_gate_bias_init, dtype, self.peephole,
+        )
+
+    def _carry_init(self, batch, dtype):
+        z = jnp.zeros((batch, self.n_out), dtype)
+        return z, z
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        if "h" in state:
+            h0, c0 = state["h"], state["c"]
+        else:
+            h0, c0 = self._carry_init(x.shape[0], x.dtype)
+        outs, (hT, cT) = _lstm_scan(
+            params, x, h0, c0, mask,
+            act_mod.get(self.gate_activation), act_mod.get(self.activation),
+            self.peephole,
+        )
+        return outs, {"h": hT, "c": cT}
+
+
+@register_layer
+@dataclass(frozen=True)
+class GravesBidirectionalLSTM(GravesLSTM):
+    """Bidirectional Graves LSTM (reference
+    ``GravesBidirectionalLSTM.java``): forward + backward passes over
+    the sequence, combined by ``mode`` (reference combines by add)."""
+
+    mode: str = "add"  # add | concat | average | mul
+
+    def output_type(self, it: InputType) -> InputType:
+        n = 2 * self.n_out if self.mode == "concat" else self.n_out
+        return InputType.recurrent(n, it.timeseries_length)
+
+    def regularizable_params(self) -> tuple:
+        return ("WF", "RWF", "WB", "RWB")
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        kf, kb = jax.random.split(key)
+        fwd = _lstm_params(kf, self.n_in, self.n_out, self.weight_init,
+                           self.dist, self.forget_gate_bias_init, dtype,
+                           self.peephole)
+        bwd = _lstm_params(kb, self.n_in, self.n_out, self.weight_init,
+                           self.dist, self.forget_gate_bias_init, dtype,
+                           self.peephole)
+        out = {k + "F": v for k, v in fwd.items()}
+        out.update({k + "B": v for k, v in bwd.items()})
+        return out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        h0, c0 = self._carry_init(x.shape[0], x.dtype)
+        gate_fn = act_mod.get(self.gate_activation)
+        act_fn = act_mod.get(self.activation)
+        pf = {k[:-1]: v for k, v in params.items() if k.endswith("F")}
+        pb = {k[:-1]: v for k, v in params.items() if k.endswith("B")}
+        of, _ = _lstm_scan(pf, x, h0, c0, mask, gate_fn, act_fn,
+                           self.peephole)
+        ob, _ = _lstm_scan(pb, x, h0, c0, mask, gate_fn, act_fn,
+                           self.peephole, reverse=True)
+        if self.mode == "add":
+            y = of + ob
+        elif self.mode == "average":
+            y = 0.5 * (of + ob)
+        elif self.mode == "mul":
+            y = of * ob
+        elif self.mode == "concat":
+            y = jnp.concatenate([of, ob], axis=1)
+        else:
+            raise ValueError(f"Unknown bidirectional mode '{self.mode}'")
+        # Bidirectional layers have no streaming carry (the backward
+        # pass needs the full sequence) — reference behaves the same.
+        return y, state
+
+    def is_recurrent(self) -> bool:
+        return False  # no streaming carry
+
+    def can_stream(self) -> bool:
+        return False  # backward pass needs the full sequence
+
+
+@register_layer
+@dataclass(frozen=True)
+class RnnOutputLayer(BaseOutputLayerSpec):
+    """Per-timestep dense + loss on [b, n, t] activations (reference
+    ``nn/layers/recurrent/RnnOutputLayer.java``)."""
+
+    activation: str = "softmax"
+
+    def input_kind(self) -> str:
+        return "recurrent"
+
+    def with_input_type(self, it: InputType) -> "RnnOutputLayer":
+        if self.n_in == 0:
+            return dataclasses.replace(self, n_in=it.size or it.flat_size())
+        return self
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def pre_output(self, params, x):
+        # [b, nIn, t] x [nIn, nOut] -> [b, nOut, t]
+        return jnp.einsum("bit,io->bot", x, params["W"]) + \
+            params["b"][None, :, None]
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        pre = self.pre_output(params, x)
+        if self.activation == "softmax":
+            y = jax.nn.softmax(pre, axis=1)  # class axis
+        else:
+            y = self.activate_fn()(pre)
+        return y, state

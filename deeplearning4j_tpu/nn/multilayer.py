@@ -59,9 +59,9 @@ class MultiLayerNetwork:
         self.score_value = float("nan")
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep state
-        self._tbptt_state: Dict[str, Any] = {}
         self._jit_step = None
         self._jit_output = None
+        self._jit_rnn_step = None
         self._base_key = jax.random.PRNGKey(conf.seed)
 
     # ------------------------------------------------------------------
@@ -99,10 +99,13 @@ class MultiLayerNetwork:
 
     def _forward_pure(
         self, params, state, x, *, train: bool, rng, upto: Optional[int] = None,
-        collect: bool = False,
+        collect: bool = False, fmask=None,
     ):
         """Forward through layers [0, upto]; returns (activation, preout
-        of last executed layer, new_state, [activations])."""
+        of last executed layer, new_state, [activations]).
+
+        ``fmask``: [batch, time] features mask threaded to recurrent
+        layers (reference ``setLayerMaskArrays``)."""
         conf = self.conf
         ctx = self._ctx_for(x)
         n = len(conf.layers) if upto is None else upto + 1
@@ -121,18 +124,22 @@ class MultiLayerNetwork:
                 xin = layer.maybe_dropout(x, train=train, rng=lrng)
                 preout = layer.pre_output(params[name], xin)
             x, st = layer.apply(
-                params[name], x, state.get(name, {}), train=train, rng=lrng
+                params[name], x, state.get(name, {}), train=train, rng=lrng,
+                mask=fmask,
             )
             new_state[name] = st
             if collect:
                 acts.append(x)
         return x, preout, new_state, acts
 
-    def _score_pure(self, params, state, x, labels, mask, rng, *, train: bool):
+    def _score_pure(self, params, state, x, labels, mask, rng, *,
+                    train: bool, fmask=None):
         """Loss score incl. L1/L2 penalty (reference computeGradientAndScore
-        adds calcL1/calcL2 to the loss)."""
+        adds calcL1/calcL2 to the loss). ``mask`` is the labels mask
+        (falls back to ``fmask`` for 3-d labels, like the reference's
+        output-layer masking)."""
         out, preout, new_state, _ = self._forward_pure(
-            params, state, x, train=train, rng=rng
+            params, state, x, train=train, rng=rng, fmask=fmask,
         )
         last = self.conf.layers[-1]
         if not last.has_loss():
@@ -144,8 +151,11 @@ class MultiLayerNetwork:
             preout = out
         from deeplearning4j_tpu.nn import losses as losses_mod
 
+        loss_mask = mask
+        if loss_mask is None and labels.ndim == 3:
+            loss_mask = fmask
         score = losses_mod.score(
-            last.loss, labels, preout, last.activation, mask, True
+            last.loss, labels, preout, last.activation, loss_mask, True
         )
         reg = 0.0
         for lname, layer in zip(self.layer_names, self.conf.layers):
@@ -166,10 +176,11 @@ class MultiLayerNetwork:
     def _build_step(self) -> Callable:
         updater = self.updater_def
 
-        def step(params, upd_state, state, x, labels, mask, lrs, t, rng):
+        def step(params, upd_state, state, x, labels, mask, fmask, lrs, t,
+                 rng):
             def loss_fn(p):
                 s, new_state = self._score_pure(
-                    p, state, x, labels, mask, rng, train=True
+                    p, state, x, labels, mask, rng, train=True, fmask=fmask
                 )
                 return s, new_state
 
@@ -241,14 +252,17 @@ class MultiLayerNetwork:
         x = jnp.asarray(ds.features, dtype)
         y = jnp.asarray(ds.labels, dtype)
         mask = getattr(ds, "labels_mask", None)
+        fmask = getattr(ds, "features_mask", None)
         if (
             self.conf.backprop_type == "TruncatedBPTT"
             and x.ndim == 3
             and x.shape[2] > self.conf.tbptt_fwd_length
         ):
-            return self._fit_tbptt(x, y, mask)
+            return self._fit_tbptt(x, y, mask, fmask)
         if mask is not None:
-            mask = jnp.asarray(mask)
+            mask = jnp.asarray(mask, dtype)
+        if fmask is not None:
+            fmask = jnp.asarray(fmask, dtype)
         score = None
         for _ in range(self.conf.iterations):
             lrs = self.updater_def.scheduled_lrs(self.iteration_count)
@@ -258,7 +272,7 @@ class MultiLayerNetwork:
                 self.params, self.updater_state, self.state, score,
             ) = self._jit_step(
                 self.params, self.updater_state, self.state,
-                x, y, mask,
+                x, y, mask, fmask,
                 {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
                 t, rng,
             )
@@ -266,34 +280,46 @@ class MultiLayerNetwork:
             self.score_value = float(score)
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count)
+            # Reset per optimizer iteration: each pass over the same
+            # minibatch starts from zero recurrent carry (also keeps
+            # the step's state pytree structure stable -> no recompile)
+            self._reset_recurrent_state()
         return float(score)
 
-    def _fit_tbptt(self, x, y, mask) -> float:
+    def _reset_recurrent_state(self) -> None:
+        """Standard-backprop mode: recurrent carry does not persist
+        across minibatches (reference resets per fit call)."""
+        for name, layer in zip(self.layer_names, self.conf.layers):
+            if layer.is_recurrent():
+                self.state[name] = {}
+
+    def _fit_tbptt(self, x, y, mask, fmask=None) -> float:
         """Truncated BPTT: slice the time axis into fwdLen chunks and
         carry RNN state between chunks (reference
-        ``doTruncatedBPTT:1210``, state carry ``:1259-1276``)."""
+        ``doTruncatedBPTT:1210``, state carry ``:1259-1276``). The
+        carry rides the layer-state pytree through the jitted step."""
         fwd = self.conf.tbptt_fwd_length
         t_total = x.shape[2]
-        self.clear_tbptt_state()
+        self._reset_recurrent_state()
         score = 0.0
-        n_chunks = 0
         for start in range(0, t_total, fwd):
             end = min(start + fwd, t_total)
             xs = x[:, :, start:end]
             ys = y[:, :, start:end] if y.ndim == 3 else y
             ms = mask[:, start:end] if mask is not None else None
-            score = self._fit_chunk_with_carry(xs, ys, ms)
-            n_chunks += 1
+            fs = fmask[:, start:end] if fmask is not None else None
+            score = self._fit_chunk_with_carry(xs, ys, ms, fs)
+        self._reset_recurrent_state()
         return score
 
-    def _fit_chunk_with_carry(self, xs, ys, ms) -> float:
-        # Recurrent layers read/write self._tbptt_state through the
-        # step function; wired up in the recurrent-stack milestone.
+    def _fit_chunk_with_carry(self, xs, ys, ms, fs=None) -> float:
         dtype = _dtype_of(self.conf)
         xs = jnp.asarray(xs, dtype)
         ys = jnp.asarray(ys, dtype)
         if ms is not None:
             ms = jnp.asarray(ms, dtype)
+        if fs is not None:
+            fs = jnp.asarray(fs, dtype)
         if self._jit_step is None:
             self._jit_step = self._build_step()
         lrs = self.updater_def.scheduled_lrs(self.iteration_count)
@@ -302,7 +328,7 @@ class MultiLayerNetwork:
         (
             self.params, self.updater_state, self.state, score,
         ) = self._jit_step(
-            self.params, self.updater_state, self.state, xs, ys, ms,
+            self.params, self.updater_state, self.state, xs, ys, ms, fs,
             {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
             t, rng,
         )
@@ -311,9 +337,6 @@ class MultiLayerNetwork:
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
         return float(score)
-
-    def clear_tbptt_state(self) -> None:
-        self._tbptt_state = {}
 
     # -- inference -----------------------------------------------------
 
@@ -356,16 +379,61 @@ class MultiLayerNetwork:
         if ds is not None:
             x, labels = ds.features, ds.labels
             mask = getattr(ds, "labels_mask", None)
+            fmask = getattr(ds, "features_mask", None)
         else:
-            mask = None
+            mask = fmask = None
         dtype = _dtype_of(self.conf)
         s, _ = self._score_pure(
             self.params, self.state, jnp.asarray(x, dtype),
             jnp.asarray(labels, dtype),
             jnp.asarray(mask, dtype) if mask is not None else None,
             None, train=False,
+            fmask=jnp.asarray(fmask, dtype) if fmask is not None else None,
         )
         return float(s)
+
+    # -- streaming RNN inference (reference rnnTimeStep:2290) -----------
+
+    def rnn_time_step(self, x):
+        """Feed one (or a few) timesteps, carrying recurrent state
+        across calls (reference ``rnnTimeStep``; state in
+        ``stateMap``). Input [b, size] or [b, size, t]."""
+        if self.params is None:
+            self.init()
+        for name, layer in zip(self.layer_names, self.conf.layers):
+            if not layer.can_stream():
+                raise ValueError(
+                    f"Layer '{name}' ({type(layer).__name__}) cannot be "
+                    "used with rnn_time_step — it needs the full sequence "
+                    "(reference throws UnsupportedOperationException)"
+                )
+        dtype = _dtype_of(self.conf)
+        x = jnp.asarray(x, dtype)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, :, None]
+        merged = dict(self.state)
+        for name, carry in self._rnn_state.items():
+            merged[name] = {**merged.get(name, {}), **carry}
+        if self._jit_rnn_step is None:
+            def rnn_step(params, state, x):
+                out, _, new_state, _ = self._forward_pure(
+                    params, state, x, train=False, rng=None
+                )
+                return out, new_state
+            self._jit_rnn_step = jax.jit(rnn_step)
+        out, new_state = self._jit_rnn_step(self.params, merged, x)
+        for name, layer in zip(self.layer_names, self.conf.layers):
+            if layer.is_recurrent():
+                self._rnn_state[name] = {
+                    k: new_state[name][k] for k in ("h", "c")
+                    if k in new_state[name]
+                }
+        return out[:, :, 0] if squeeze else out
+
+    def rnn_clear_previous_state(self) -> None:
+        """Reference ``rnnClearPreviousState``."""
+        self._rnn_state = {}
 
     def predict(self, x) -> np.ndarray:
         """Argmax class predictions (reference ``predict``)."""
@@ -377,9 +445,11 @@ class MultiLayerNetwork:
         e = Evaluation()
         for ds in iterator:
             out = self.output(ds.features)
+            m = getattr(ds, "labels_mask", None)
+            if m is None:
+                m = getattr(ds, "features_mask", None)
             e.eval(np.asarray(ds.labels), np.asarray(out),
-                   mask=np.asarray(ds.labels_mask)
-                   if getattr(ds, "labels_mask", None) is not None else None)
+                   mask=np.asarray(m) if m is not None else None)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return e

@@ -4,18 +4,32 @@ Mirrors the reference's two-profile test strategy (SURVEY.md §4: the
 same suite runs under -P test-nd4j-native and -P test-nd4j-cuda-8.0):
 tests run on the jax CPU backend with 8 virtual devices so multi-chip
 sharding paths (pjit over a Mesh) are exercised without TPU hardware;
-the same suite runs unchanged on a real TPU by unsetting JAX_PLATFORMS.
+set DL4J_TPU_TEST_PLATFORM=tpu to run the same suite on real hardware.
 """
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The environment's sitecustomize may import jax at interpreter start
+# (the axon real-TPU tunnel does), so setting env vars here is too late
+# on its own — we also reset jax's backend registry below.
+_platform = os.environ.get("DL4J_TPU_TEST_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+if _platform == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+    assert jax.devices()[0].platform == "cpu", (
+        "Test suite must run on the CPU backend; got "
+        f"{jax.devices()[0].platform}"
+    )
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,182 @@
+"""Conv stack tests (reference analog: ``ConvolutionLayerTest``,
+``CNNGradientCheckTest``, ``BNGradientCheckTest``,
+``LRNGradientCheckTests``, cuDNN-vs-builtin ``TestConvolution``)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.gradient_check import check_gradients
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    LocalResponseNormalization,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def lenet_conf(seed=7):
+    """LeNet-5-style MNIST config — BASELINE.md config #1."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(0.01)
+        .updater("ADAM")
+        .list()
+        .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                activation="relu"))
+        .layer(SubsamplingLayer(pooling_type="MAX"))
+        .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                activation="relu"))
+        .layer(SubsamplingLayer(pooling_type="MAX"))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=10, loss="MCXENT"))
+        .set_input_type(InputType.convolutional_flat(28, 28, 1))
+        .build()
+    )
+
+
+def test_lenet_shape_inference():
+    conf = lenet_conf()
+    # conv1: 28->24, pool: 12, conv2: 12->8, pool: 4 => dense in 50*4*4
+    assert conf.layers[0].n_in == 1
+    assert conf.layers[2].n_in == 20
+    assert conf.layers[4].n_in == 50 * 4 * 4
+    assert conf.layers[5].n_in == 500
+    # preprocessors: flat->cnn at 0, cnn->ff at dense
+    assert 0 in conf.preprocessors
+    assert 4 in conf.preprocessors
+
+
+def test_lenet_json_round_trip():
+    conf = lenet_conf()
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back == conf
+
+
+def test_lenet_forward_and_train(rng):
+    conf = lenet_conf()
+    net = MultiLayerNetwork(conf).init()
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+    out = net.output(x)
+    assert out.shape == (8, 10)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+    s0 = net.score(x=x, labels=y)
+    for _ in range(10):
+        net.fit(x, y)
+    assert net.score(x=x, labels=y) < s0
+
+
+def small_cnn(pool="MAX", with_bn=False, with_lrn=False, seed=12345):
+    lb = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .list()
+        .layer(ConvolutionLayer(n_out=3, kernel_size=(2, 2),
+                                activation="tanh"))
+    )
+    if with_bn:
+        lb = lb.layer(BatchNormalization())
+    if with_lrn:
+        lb = lb.layer(LocalResponseNormalization())
+    conf = (
+        lb
+        .layer(SubsamplingLayer(pooling_type=pool, kernel_size=(2, 2),
+                                stride=(1, 1)))
+        .layer(OutputLayer(n_out=2, loss="MCXENT"))
+        .set_input_type(InputType.convolutional(5, 5, 2))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def cnn_data(rng, n=4):
+    x = rng.randn(n, 2, 5, 5)
+    y = np.zeros((n, 2))
+    y[np.arange(n), rng.randint(0, 2, n)] = 1.0
+    return x, y
+
+
+@pytest.mark.parametrize("pool", ["MAX", "AVG", "SUM"])
+def test_cnn_gradients(rng, pool):
+    net = small_cnn(pool)
+    x, y = cnn_data(rng)
+    assert check_gradients(net, x, y, print_results=True, max_per_param=30)
+
+
+def test_cnn_bn_gradients(rng):
+    net = small_cnn(with_bn=True)
+    x, y = cnn_data(rng)
+    # train=True exercises the batch-statistics branch (reference
+    # BNGradientCheckTest)
+    assert check_gradients(net, x, y, print_results=True, train=True,
+                           max_per_param=30)
+
+
+def test_cnn_lrn_gradients(rng):
+    net = small_cnn(with_lrn=True)
+    x, y = cnn_data(rng)
+    assert check_gradients(net, x, y, print_results=True, max_per_param=30)
+
+
+def test_batchnorm_running_stats_update(rng):
+    net = small_cnn(with_bn=True)
+    x, y = cnn_data(rng, n=16)
+    m0 = np.asarray(net.state["1"]["mean"]).copy()
+    net.fit(x.astype(np.float32), y.astype(np.float32))
+    m1 = np.asarray(net.state["1"]["mean"])
+    assert not np.allclose(m0, m1)
+
+
+def test_batchnorm_dense_2d(rng):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(1)
+        .learning_rate(0.1)
+        .list()
+        .layer(DenseLayer(n_in=6, n_out=8, activation="relu"))
+        .layer(BatchNormalization())
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    assert conf.layers[1].n_out == 8
+    x = rng.randn(12, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)]
+    net.fit(x, y, epochs=3)
+    assert np.isfinite(net.score_value)
+
+
+def test_pooling_known_values():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .list()
+        .layer(SubsamplingLayer(pooling_type="MAX", kernel_size=(2, 2),
+                                stride=(2, 2)))
+        .layer(OutputLayer(n_in=4, n_out=2))
+        .set_input_type(InputType.convolutional(4, 4, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    pooled = net.feed_forward_to_layer(0, x)[0]
+    np.testing.assert_allclose(
+        np.asarray(pooled).reshape(2, 2), [[5, 7], [13, 15]]
+    )
+
+
+def test_invalid_geometry_raises():
+    with pytest.raises(ValueError, match="Invalid conv"):
+        (
+            NeuralNetConfiguration.Builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(9, 9)))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(5, 5, 1))
+            .build()
+        )

@@ -1,0 +1,145 @@
+"""Recurrent stack tests (reference analog: ``MultiLayerTestRNN``,
+``GravesLSTMTest``, ``GradientCheckTestsMasking``,
+``TestVariableLengthTS``)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.gradient_check import check_gradients
+from deeplearning4j_tpu.nn.layers import (
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def rnn_net(n_in=3, n_hidden=5, n_out=2, bidirectional=False, seed=12345,
+            tbptt=None, mode="add"):
+    lstm = (
+        GravesBidirectionalLSTM(n_in=n_in, n_out=n_hidden, mode=mode)
+        if bidirectional else GravesLSTM(n_in=n_in, n_out=n_hidden)
+    )
+    lb = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(0.05)
+        .updater("ADAM")
+        .list()
+        .layer(lstm)
+        .layer(RnnOutputLayer(n_out=n_out, loss="MCXENT"))
+    )
+    if tbptt:
+        lb = (lb.backprop_type("TruncatedBPTT")
+              .t_bptt_forward_length(tbptt)
+              .t_bptt_backward_length(tbptt))
+    conf = lb.set_input_type(InputType.recurrent(n_in)).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def seq_data(rng, b=4, n_in=3, n_out=2, t=7):
+    x = rng.randn(b, n_in, t)
+    y = np.zeros((b, n_out, t))
+    y[np.arange(b)[:, None], rng.randint(0, n_out, (b, t)),
+      np.arange(t)[None, :]] = 1.0
+    return x, y
+
+
+def test_rnn_shapes_and_train(rng):
+    net = rnn_net()
+    x, y = seq_data(rng)
+    out = net.output(x)
+    assert out.shape == (4, 2, 7)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+    s0 = net.score(x=x, labels=y)
+    for _ in range(20):
+        net.fit(x.astype(np.float32), y.astype(np.float32))
+    assert net.score(x=x, labels=y) < s0
+
+
+def test_lstm_gradients(rng):
+    net = rnn_net()
+    x, y = seq_data(rng)
+    assert check_gradients(net, x, y, print_results=True, max_per_param=25)
+
+
+def test_bidirectional_gradients(rng):
+    net = rnn_net(bidirectional=True)
+    x, y = seq_data(rng)
+    assert check_gradients(net, x, y, print_results=True, max_per_param=15)
+
+
+def test_bidirectional_concat_shapes(rng):
+    net = rnn_net(bidirectional=True, mode="concat")
+    # concat mode doubles the RnnOutputLayer nIn
+    assert net.conf.layers[1].n_in == 10
+    x, y = seq_data(rng)
+    assert net.output(x).shape == (4, 2, 7)
+
+
+def test_masked_gradients(rng):
+    """Masked timesteps must contribute zero gradient (reference
+    GradientCheckTestsMasking)."""
+    net = rnn_net()
+    x, y = seq_data(rng)
+    fmask = np.ones((4, 7))
+    fmask[0, 4:] = 0.0
+    fmask[2, 2:] = 0.0
+    assert check_gradients(net, x, y, mask=fmask, features_mask=fmask,
+                           print_results=True, max_per_param=25)
+
+
+def test_masked_steps_do_not_affect_loss(rng):
+    """Changing input at masked timesteps must not change the masked
+    score (reference TestVariableLengthTS)."""
+    net = rnn_net()
+    x, y = seq_data(rng)
+    fmask = np.ones((4, 7), np.float32)
+    fmask[:, 5:] = 0.0
+    ds1 = DataSet(features=x.astype(np.float32), labels=y.astype(np.float32),
+                  features_mask=fmask, labels_mask=fmask)
+    x2 = x.copy()
+    x2[:, :, 5:] = 999.0
+    ds2 = DataSet(features=x2.astype(np.float32), labels=y.astype(np.float32),
+                  features_mask=fmask, labels_mask=fmask)
+    assert abs(net.score(ds1) - net.score(ds2)) < 1e-5
+
+
+def test_tbptt_runs_and_learns(rng):
+    net = rnn_net(tbptt=5)
+    x, y = seq_data(rng, t=16)
+    s0 = net.score(x=x, labels=y)
+    for _ in range(10):
+        net.fit(DataSet(features=x.astype(np.float32),
+                        labels=y.astype(np.float32)))
+    assert net.score(x=x, labels=y) < s0
+    # 16 timesteps / fwd 5 -> 4 chunks per fit call
+    assert net.iteration_count == 40
+
+
+def test_rnn_time_step_matches_full_forward(rng):
+    """Streaming one step at a time == full-sequence forward
+    (reference rnnTimeStep contract)."""
+    net = rnn_net()
+    x, _ = seq_data(rng)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    outs = []
+    for t in range(x.shape[2]):
+        outs.append(np.asarray(net.rnn_time_step(x[:, :, t])))
+    stepped = np.stack(outs, axis=2)
+    np.testing.assert_allclose(full, stepped, rtol=1e-4, atol=1e-5)
+    # clearing state changes the continuation
+    more = np.asarray(net.rnn_time_step(x[:, :, 0]))
+    net.rnn_clear_previous_state()
+    fresh = np.asarray(net.rnn_time_step(x[:, :, 0]))
+    assert not np.allclose(more, fresh)
+
+
+def test_rnn_json_round_trip():
+    net = rnn_net(bidirectional=True)
+    back = MultiLayerConfiguration.from_json(net.conf.to_json())
+    assert back == net.conf
