@@ -1,0 +1,19 @@
+"""Early stopping (reference ``deeplearning4j-nn/.../earlystopping``:
+``EarlyStoppingConfiguration`` + termination conditions + score
+calculators + savers + ``EarlyStoppingTrainer``)."""
+
+from deeplearning4j_tpu.earlystopping.core import (  # noqa: F401
+    BestScoreEpochTerminationCondition,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)

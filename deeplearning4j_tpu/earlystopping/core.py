@@ -1,0 +1,308 @@
+"""Early stopping engine (reference: ``earlystopping/*`` — 20 files;
+the trainer loop mirrors ``earlystopping/trainer/
+BaseEarlyStoppingTrainer.java``: per-epoch fit, score on a holdout,
+track best model, stop on epoch/iteration termination conditions,
+persist via a model saver)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, List
+
+
+# -- termination conditions (reference earlystopping/termination/*) -----
+
+
+class EpochTerminationCondition:
+    def initialize(self) -> None:
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self) -> None:
+        pass
+
+    def terminate(self, last_score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after N epochs with no improvement (reference class of the
+    same name)."""
+
+    def __init__(self, max_epochs_without_improvement: int,
+                 min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+        self.best = math.inf
+        self.since = 0
+
+    def initialize(self) -> None:
+        self.best = math.inf
+        self.since = 0
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        if score < self.best - self.min_improvement:
+            self.best = score
+            self.since = 0
+            return False
+        self.since += 1
+        # Reference terminates once exactly `patience` evaluations pass
+        # with no improvement (ScoreImprovementEpochTerminationCondition
+        # .java:66: epochNum >= bestEpoch + maxEpochsWithNoImprovement)
+        return self.since >= self.patience
+
+
+class BestScoreEpochTerminationCondition(EpochTerminationCondition):
+    """Stop once the score is at/below a target."""
+
+    def __init__(self, best_expected_score: float):
+        self.target = best_expected_score
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        return score <= self.target
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start = None
+
+    def initialize(self) -> None:
+        self._start = time.time()
+
+    def terminate(self, last_score: float) -> bool:
+        return (time.time() - self._start) > self.max_seconds
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Abort if the score explodes past a bound."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, last_score: float) -> bool:
+        return last_score > self.max_score
+
+
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    def terminate(self, last_score: float) -> bool:
+        return math.isnan(last_score) or math.isinf(last_score)
+
+
+# -- score calculators (reference earlystopping/scorecalc) --------------
+
+
+class DataSetLossCalculator:
+    """Average loss over a DataSetIterator (reference
+    ``DataSetLossCalculator``). Works for both model types."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, model) -> float:
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            # weight each batch by its example count (reference
+            # DataSetLossCalculator.java:36-41: lossSum += score*nEx)
+            if hasattr(ds, "num_examples"):
+                n_ex = ds.num_examples()
+            else:
+                n_ex = int(np.asarray(ds.features).shape[0])
+            total += model.score(ds) * n_ex
+            n += n_ex
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        if n == 0:
+            return float("nan")
+        return total / n if self.average else total
+
+
+# -- model savers (reference earlystopping/saver) -----------------------
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, model, score: float) -> None:
+        self._best = model.copy() if hasattr(model, "copy") else model
+
+    def save_latest_model(self, model, score: float) -> None:
+        self._latest = model.copy() if hasattr(model, "copy") else model
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver:
+    """Zip checkpoints in a directory (reference ``LocalFileModelSaver``
+    writes bestModel.bin / latestModel.bin)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def best_path(self) -> str:
+        return os.path.join(self.directory, "bestModel.zip")
+
+    @property
+    def latest_path(self) -> str:
+        return os.path.join(self.directory, "latestModel.zip")
+
+    def save_best_model(self, model, score: float) -> None:
+        from deeplearning4j_tpu.util import write_model
+
+        write_model(model, self.best_path)
+
+    def save_latest_model(self, model, score: float) -> None:
+        from deeplearning4j_tpu.util import write_model
+
+        write_model(model, self.latest_path)
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.util import restore_model
+
+        return restore_model(self.best_path)
+
+    def get_latest_model(self):
+        from deeplearning4j_tpu.util import restore_model
+
+        return restore_model(self.latest_path)
+
+
+# -- configuration + result (reference EarlyStoppingConfiguration) ------
+
+
+@dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: Any
+    epoch_terminations: List[EpochTerminationCondition] = field(
+        default_factory=list
+    )
+    iteration_terminations: List[IterationTerminationCondition] = field(
+        default_factory=list
+    )
+    model_saver: Any = None
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+    def __post_init__(self):
+        if self.model_saver is None:
+            self.model_saver = InMemoryModelSaver()
+
+
+@dataclass
+class EarlyStoppingResult:
+    termination_reason: str  # EpochTerminationCondition name etc.
+    termination_details: str
+    total_epochs: int
+    best_model_epoch: int
+    best_model_score: float
+    score_vs_epoch: dict
+    best_model: Any
+
+
+# -- trainer (reference earlystopping/trainer) --------------------------
+
+
+class EarlyStoppingTrainer:
+    """Reference ``EarlyStoppingTrainer`` (MultiLayerNetwork flavor);
+    ``EarlyStoppingGraphTrainer`` below for graphs — the loop is
+    identical."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_iterator):
+        self.config = config
+        self.model = model
+        self.train_iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_terminations:
+            c.initialize()
+        for c in cfg.iteration_terminations:
+            c.initialize()
+        best_score = math.inf
+        best_epoch = -1
+        scores: dict = {}
+        epoch = 0
+        reason, details = "MaxEpochs", "exhausted"
+        while True:
+            stop_iter = None
+            for ds in self.train_iterator:
+                self.model.fit_minibatch(ds)
+                for c in cfg.iteration_terminations:
+                    if c.terminate(self.model.score_value):
+                        stop_iter = c
+                        break
+                if stop_iter is not None:
+                    break
+            if hasattr(self.train_iterator, "reset"):
+                self.train_iterator.reset()
+            if stop_iter is not None:
+                reason = "IterationTerminationCondition"
+                details = type(stop_iter).__name__
+                break
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(self.model)
+                scores[epoch] = score
+                if score < best_score:
+                    best_score = score
+                    best_epoch = epoch
+                    cfg.model_saver.save_best_model(self.model, score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.model, score)
+                stop_epoch = None
+                for c in cfg.epoch_terminations:
+                    if c.terminate(epoch, score):
+                        stop_epoch = c
+                        break
+                if stop_epoch is not None:
+                    reason = "EpochTerminationCondition"
+                    details = type(stop_epoch).__name__
+                    epoch += 1
+                    break
+            epoch += 1
+        # best_epoch == -1 means no evaluation ever saved a best model
+        # (e.g. NaN on the first minibatch) — don't ask the saver for a
+        # file that was never written.
+        best = (
+            cfg.model_saver.get_best_model() if best_epoch >= 0 else None
+        )
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            total_epochs=epoch,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score,
+            score_vs_epoch=scores,
+            best_model=best if best is not None else self.model,
+        )
+
+
+class EarlyStoppingGraphTrainer(EarlyStoppingTrainer):
+    """Reference ``EarlyStoppingGraphTrainer`` — same loop over a
+    ComputationGraph."""

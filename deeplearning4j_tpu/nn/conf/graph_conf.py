@@ -1,0 +1,604 @@
+"""ComputationGraph configuration — DAG of vertices (reference:
+``nn/conf/ComputationGraphConfiguration.java`` GraphBuilder at ``:398``
+(addLayer ``:517``, addInputs ``:553``, setOutputs ``:581``, addVertex
+``:597``) and the vertex impls under ``nn/graph/vertex/impl/``).
+
+Vertices are frozen dataclasses like layers; the graph is stored as
+``{name: (vertex, input_names)}`` plus input/output name lists, and a
+Kahn topological order is computed once at build time (reference
+``ComputationGraph.topologicalSortOrder():809``). Execution is a pure
+function: walk the topo order, feed a ``{name: array}`` value map —
+XLA sees one flat fused program, the DAG bookkeeping disappears at
+trace time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    InputPreProcessor,
+    ShapeContext,
+)
+from deeplearning4j_tpu.nn.layers.base import (
+    LayerSpec,
+    layer_from_json,
+    layer_to_json,
+)
+
+VERTEX_REGISTRY: Dict[str, type] = {}
+
+
+def register_vertex(cls):
+    VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclass(frozen=True)
+class GraphVertexSpec:
+    """Base vertex (reference ``nn/graph/vertex/GraphVertex.java``
+    doForward ``:117``; backward is jax.grad)."""
+
+    def apply(self, params, inputs: Sequence, state, *, train=False,
+              rng=None, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, input_types: Sequence[InputType]) -> InputType:
+        return input_types[0]
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        return {}
+
+    def init_state(self, dtype=jnp.float32) -> dict:
+        return {}
+
+    def layer(self) -> Optional[LayerSpec]:
+        return None
+
+    def to_json(self) -> dict:
+        d = {"@class": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, LayerSpec):
+                v = {"@layer": True, **layer_to_json(v)}
+            elif isinstance(v, InputPreProcessor):
+                v = {"@preproc": True, **v.to_json()}
+            elif isinstance(v, tuple):
+                v = list(v)
+            d[f.name] = v
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "GraphVertexSpec":
+        d = dict(d)
+        cls = VERTEX_REGISTRY[d.pop("@class")]
+        kwargs = {}
+        names = {f.name for f in dataclasses.fields(cls)}
+        for k, v in d.items():
+            if k not in names:
+                continue
+            if isinstance(v, dict) and v.get("@layer"):
+                v = layer_from_json({
+                    kk: vv for kk, vv in v.items() if kk != "@layer"
+                })
+            elif isinstance(v, dict) and v.get("@preproc"):
+                v = InputPreProcessor.from_json({
+                    kk: vv for kk, vv in v.items() if kk != "@preproc"
+                })
+            elif isinstance(v, list):
+                v = tuple(v)
+            kwargs[k] = v
+        return cls(**kwargs)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class LayerVertex(GraphVertexSpec):
+    """Wraps a layer (+ optional input preprocessor) — reference
+    ``nn/graph/vertex/impl/LayerVertex.java``."""
+
+    layer_conf: LayerSpec = None  # type: ignore[assignment]
+    preprocessor: Optional[InputPreProcessor] = None
+
+    def layer(self) -> Optional[LayerSpec]:
+        return self.layer_conf
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        return self.layer_conf.init_params(key, dtype)
+
+    def init_state(self, dtype=jnp.float32) -> dict:
+        return self.layer_conf.init_state(dtype)
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        if len(inputs) != 1:
+            raise ValueError("LayerVertex expects exactly one input")
+        x = inputs[0]
+        if self.preprocessor is not None:
+            t = x.shape[2] if x.ndim == 3 else -1
+            x = self.preprocessor.preprocess(
+                x, ShapeContext(batch=x.shape[0], time=t)
+            )
+        return self.layer_conf.apply(
+            params, x, state, train=train, rng=rng, mask=mask
+        )
+
+    def output_type(self, input_types):
+        it = input_types[0]
+        if self.preprocessor is not None:
+            it = self.preprocessor.output_type(it)
+        return self.layer_conf.output_type(it)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class MergeVertex(GraphVertexSpec):
+    """Concatenate along the feature axis (reference
+    ``MergeVertex.java``): 2-d [b,n], 3-d [b,n,t], 4-d [b,c,h,w] all
+    merge on axis 1."""
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        return jnp.concatenate(inputs, axis=1), state
+
+    def output_type(self, input_types):
+        it = input_types[0]
+        if it.kind == "convolutional":
+            return InputType.convolutional(
+                it.height, it.width,
+                sum(t.channels for t in input_types),
+            )
+        total = sum(t.size or t.flat_size() for t in input_types)
+        if it.kind == "recurrent":
+            return InputType.recurrent(total, it.timeseries_length)
+        return InputType.feed_forward(total)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class ElementWiseVertex(GraphVertexSpec):
+    """Add/Subtract/Product/Average/Max of same-shaped inputs
+    (reference ``ElementWiseVertex.java``)."""
+
+    op: str = "Add"
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        op = self.op.lower()
+        if op == "add":
+            out = sum(inputs)
+        elif op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("Subtract requires exactly 2 inputs")
+            out = inputs[0] - inputs[1]
+        elif op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+        elif op == "average":
+            out = sum(inputs) / len(inputs)
+        elif op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+        else:
+            raise ValueError(f"Unknown ElementWise op '{self.op}'")
+        return out, state
+
+
+@register_vertex
+@dataclass(frozen=True)
+class SubsetVertex(GraphVertexSpec):
+    """Feature range [from, to] inclusive (reference
+    ``SubsetVertex.java``)."""
+
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        return inputs[0][:, self.from_idx:self.to_idx + 1], state
+
+    def output_type(self, input_types):
+        n = self.to_idx - self.from_idx + 1
+        it = input_types[0]
+        if it.kind == "recurrent":
+            return InputType.recurrent(n, it.timeseries_length)
+        return InputType.feed_forward(n)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class L2Vertex(GraphVertexSpec):
+    """Pairwise L2 distance between two inputs -> [b, 1] (reference
+    ``L2Vertex.java``)."""
+
+    eps: float = 1e-8
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        a, b = inputs
+        d = (a - b).reshape(a.shape[0], -1)
+        return jnp.sqrt(jnp.sum(d * d, axis=1, keepdims=True) + self.eps), state
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(1)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class L2NormalizeVertex(GraphVertexSpec):
+    """Normalize rows to unit L2 norm (reference
+    ``L2NormalizeVertex.java``)."""
+
+    eps: float = 1e-8
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        x = inputs[0]
+        flat = x.reshape(x.shape[0], -1)
+        norm = jnp.sqrt(jnp.sum(flat * flat, axis=1) + self.eps)
+        return x / norm.reshape((-1,) + (1,) * (x.ndim - 1)), state
+
+
+@register_vertex
+@dataclass(frozen=True)
+class StackVertex(GraphVertexSpec):
+    """Stack along the batch axis (reference ``StackVertex.java``)."""
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        return jnp.concatenate(inputs, axis=0), state
+
+
+@register_vertex
+@dataclass(frozen=True)
+class UnstackVertex(GraphVertexSpec):
+    """Take slice ``from_idx`` of ``stack_size`` equal batch chunks
+    (reference ``UnstackVertex.java``)."""
+
+    from_idx: int = 0
+    stack_size: int = 1
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        x = inputs[0]
+        n = x.shape[0] // self.stack_size
+        return x[self.from_idx * n:(self.from_idx + 1) * n], state
+
+
+@register_vertex
+@dataclass(frozen=True)
+class PreprocessorVertex(GraphVertexSpec):
+    """Standalone preprocessor vertex (reference
+    ``PreprocessorVertex.java``)."""
+
+    preprocessor: InputPreProcessor = None  # type: ignore[assignment]
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        x = inputs[0]
+        t = x.shape[2] if x.ndim == 3 else -1
+        return self.preprocessor.preprocess(
+            x, ShapeContext(batch=x.shape[0], time=t)
+        ), state
+
+    def output_type(self, input_types):
+        return self.preprocessor.output_type(input_types[0])
+
+
+@register_vertex
+@dataclass(frozen=True)
+class ScaleVertex(GraphVertexSpec):
+    """Multiply by a fixed scalar (reference ``ScaleVertex.java``)."""
+
+    scale: float = 1.0
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        return inputs[0] * self.scale, state
+
+
+@register_vertex
+@dataclass(frozen=True)
+class ShiftVertex(GraphVertexSpec):
+    """Add a fixed scalar (reference ``ShiftVertex.java``)."""
+
+    shift: float = 0.0
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        return inputs[0] + self.shift, state
+
+
+@register_vertex
+@dataclass(frozen=True)
+class LastTimeStepVertex(GraphVertexSpec):
+    """[b, n, t] -> [b, n] taking the last unmasked timestep (reference
+    ``nn/graph/vertex/impl/rnn/LastTimeStepVertex.java``)."""
+
+    mask_input: str = ""
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None):
+        x = inputs[0]
+        if mask is None:
+            return x[:, :, -1], state
+        # index of last 1 in each row of the [b, t] mask
+        t = x.shape[2]
+        idx = (t - 1) - jnp.argmax(jnp.flip(mask, axis=1), axis=1)
+        return jnp.take_along_axis(
+            x, idx.astype(jnp.int32)[:, None, None], axis=2
+        )[:, :, 0], state
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(input_types[0].size)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class DuplicateToTimeSeriesVertex(GraphVertexSpec):
+    """[b, n] -> [b, n, t] broadcast over time, t taken from a
+    reference input (reference ``DuplicateToTimeSeriesVertex.java``)."""
+
+    reference_input: str = ""
+
+    def apply(self, params, inputs, state, *, train=False, rng=None,
+              mask=None, time: int = 1):
+        x = inputs[0]
+        return jnp.broadcast_to(
+            x[:, :, None], x.shape + (time,)
+        ), state
+
+    def output_type(self, input_types):
+        return InputType.recurrent(input_types[0].size or
+                                   input_types[0].flat_size())
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ComputationGraphConfiguration:
+    """Immutable DAG config (reference
+    ``ComputationGraphConfiguration.java``)."""
+
+    inputs: Tuple[str, ...]
+    outputs: Tuple[str, ...]
+    vertices: Dict[str, GraphVertexSpec]
+    vertex_inputs: Dict[str, Tuple[str, ...]]
+    seed: int = 12345
+    iterations: int = 1
+    dtype: str = "float32"
+    backprop: bool = True
+    pretrain: bool = False
+    backprop_type: str = "Standard"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    input_types: Optional[Tuple[InputType, ...]] = None
+
+    def topological_order(self) -> List[str]:
+        """Kahn ordering of vertex names (reference
+        ``topologicalSortOrder():809``)."""
+        indeg = {name: 0 for name in self.vertices}
+        children: Dict[str, List[str]] = {name: [] for name in self.vertices}
+        for name, ins in self.vertex_inputs.items():
+            for src in ins:
+                if src in self.vertices:
+                    indeg[name] += 1
+                    children[src].append(name)
+                elif src not in self.inputs:
+                    raise ValueError(
+                        f"Vertex '{name}' references unknown input '{src}'"
+                    )
+        queue = sorted(n for n, d in indeg.items() if d == 0)
+        order: List[str] = []
+        while queue:
+            n = queue.pop(0)
+            order.append(n)
+            for c in children[n]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    queue.append(c)
+        if len(order) != len(self.vertices):
+            cyc = set(self.vertices) - set(order)
+            raise ValueError(f"Graph has a cycle involving: {sorted(cyc)}")
+        return order
+
+    # -- serialization -----------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "format": "deeplearning4j_tpu.ComputationGraphConfiguration",
+            "inputs": list(self.inputs),
+            "outputs": list(self.outputs),
+            "vertices": {n: v.to_json() for n, v in self.vertices.items()},
+            "vertex_inputs": {
+                n: list(i) for n, i in self.vertex_inputs.items()
+            },
+            "seed": self.seed,
+            "iterations": self.iterations,
+            "dtype": self.dtype,
+            "backprop": self.backprop,
+            "pretrain": self.pretrain,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+            "input_types": (
+                [t.to_json() for t in self.input_types]
+                if self.input_types else None
+            ),
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d: dict) -> "ComputationGraphConfiguration":
+        return ComputationGraphConfiguration(
+            inputs=tuple(d["inputs"]),
+            outputs=tuple(d["outputs"]),
+            vertices={
+                n: GraphVertexSpec.from_json(v)
+                for n, v in d["vertices"].items()
+            },
+            vertex_inputs={
+                n: tuple(i) for n, i in d["vertex_inputs"].items()
+            },
+            seed=d.get("seed", 12345),
+            iterations=d.get("iterations", 1),
+            dtype=d.get("dtype", "float32"),
+            backprop=d.get("backprop", True),
+            pretrain=d.get("pretrain", False),
+            backprop_type=d.get("backprop_type", "Standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+            input_types=(
+                tuple(InputType.from_json(t) for t in d["input_types"])
+                if d.get("input_types") else None
+            ),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        return ComputationGraphConfiguration.from_dict(json.loads(s))
+
+
+class GraphBuilder:
+    """Reference ``ComputationGraphConfiguration.GraphBuilder``."""
+
+    def __init__(self, parent=None):
+        from deeplearning4j_tpu.nn.conf.multi_layer import (
+            NeuralNetConfiguration,
+        )
+
+        self._parent = parent or NeuralNetConfiguration.Builder()
+        self._inputs: List[str] = []
+        self._outputs: List[str] = []
+        self._vertices: Dict[str, GraphVertexSpec] = {}
+        self._vertex_inputs: Dict[str, Tuple[str, ...]] = {}
+        self._input_types: Optional[List[InputType]] = None
+        self._backprop = True
+        self._pretrain = False
+        self._backprop_type = "Standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        for n in names:
+            if n in self._inputs or n in self._vertices:
+                raise ValueError(f"Duplicate vertex/input name '{n}'")
+            self._inputs.append(n)
+        return self
+
+    def add_layer(self, name: str, layer: LayerSpec, *inputs: str,
+                  preprocessor: Optional[InputPreProcessor] = None
+                  ) -> "GraphBuilder":
+        self._check_name(name)
+        layer = self._parent._resolve_layer(layer)
+        self._vertices[name] = LayerVertex(
+            layer_conf=layer, preprocessor=preprocessor
+        )
+        self._vertex_inputs[name] = tuple(inputs)
+        return self
+
+    def add_vertex(self, name: str, vertex: GraphVertexSpec,
+                   *inputs: str) -> "GraphBuilder":
+        self._check_name(name)
+        self._vertices[name] = vertex
+        self._vertex_inputs[name] = tuple(inputs)
+        return self
+
+    def _check_name(self, name: str) -> None:
+        if name in self._vertices or name in self._inputs:
+            raise ValueError(f"Duplicate vertex/input name '{name}'")
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def set_input_types(self, *types: InputType) -> "GraphBuilder":
+        self._input_types = list(types)
+        return self
+
+    def backprop(self, b: bool) -> "GraphBuilder":
+        self._backprop = b
+        return self
+
+    def pretrain(self, p: bool) -> "GraphBuilder":
+        self._pretrain = p
+        return self
+
+    def backprop_type(self, t: str) -> "GraphBuilder":
+        self._backprop_type = t
+        return self
+
+    def t_bptt_forward_length(self, n: int) -> "GraphBuilder":
+        self._tbptt_fwd = n
+        return self
+
+    def t_bptt_backward_length(self, n: int) -> "GraphBuilder":
+        self._tbptt_back = n
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        if not self._inputs:
+            raise ValueError("Graph needs addInputs(...)")
+        if not self._outputs:
+            raise ValueError("Graph needs setOutputs(...)")
+        for out in self._outputs:
+            if out not in self._vertices:
+                raise ValueError(f"Output '{out}' is not a vertex")
+        conf = ComputationGraphConfiguration(
+            inputs=tuple(self._inputs),
+            outputs=tuple(self._outputs),
+            vertices=dict(self._vertices),
+            vertex_inputs=dict(self._vertex_inputs),
+            seed=self._parent._seed,
+            iterations=self._parent._iterations,
+            dtype=self._parent._dtype,
+            backprop=self._backprop,
+            pretrain=self._pretrain,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            input_types=(
+                tuple(self._input_types) if self._input_types else None
+            ),
+        )
+        if self._input_types is not None:
+            conf = _infer_shapes(conf)
+        conf.topological_order()  # validates acyclicity + references
+        return conf
+
+
+def _infer_shapes(
+    conf: ComputationGraphConfiguration,
+) -> ComputationGraphConfiguration:
+    """Propagate InputTypes through the topo order, filling each layer
+    vertex's nIn (reference ``GraphBuilder.setInputTypes`` +
+    ``addPreProcessors``)."""
+    types: Dict[str, InputType] = dict(
+        zip(conf.inputs, conf.input_types or ())
+    )
+    if len(types) != len(conf.inputs):
+        raise ValueError("setInputTypes must cover every graph input")
+    new_vertices = dict(conf.vertices)
+    for name in conf.topological_order():
+        v = new_vertices[name]
+        in_types = [types[i] for i in conf.vertex_inputs[name]]
+        if isinstance(v, LayerVertex):
+            it = in_types[0]
+            if v.preprocessor is not None:
+                it = v.preprocessor.output_type(it)
+            layer = v.layer_conf.with_input_type(it)
+            v = dataclasses.replace(v, layer_conf=layer)
+            new_vertices[name] = v
+        types[name] = v.output_type(in_types)
+    return dataclasses.replace(conf, vertices=new_vertices)

@@ -362,6 +362,12 @@ class NeuralNetConfiguration:
         def list(self) -> ListBuilder:
             return ListBuilder(self)
 
+        def graph_builder(self):
+            """Reference ``NeuralNetConfiguration.Builder.graphBuilder()``."""
+            from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+
+            return GraphBuilder(self)
+
         # -- resolution ----------------------------------------------------
 
         def _resolve_layer(self, layer: LayerSpec) -> LayerSpec:

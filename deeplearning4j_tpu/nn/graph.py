@@ -1,0 +1,415 @@
+"""ComputationGraph — the DAG engine (reference:
+``nn/graph/ComputationGraph.java``, 4.1k LoC; forward = topo-ordered
+``doForward`` per vertex, backward = reverse topo ``doBackward``).
+
+TPU-first: the topo walk happens at *trace* time — the whole DAG
+(all vertices, multi-input fan-in, multi-output losses) flattens into
+one XLA program per input shape, and the reverse-order backward pass
+is ``jax.grad`` of that program. Multi-output losses sum (reference
+sums output-layer scores).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertex,
+    LastTimeStepVertex,
+    LayerVertex,
+)
+from deeplearning4j_tpu.nn.updaters import MultiLayerUpdaterDef, UpdaterSettings
+
+
+def _as_list(x) -> list:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.topo: List[str] = conf.topological_order()
+        self.layer_vertex_names: List[str] = [
+            n for n in self.topo
+            if isinstance(conf.vertices[n], LayerVertex)
+        ]
+        settings: Dict[str, UpdaterSettings] = {}
+        for n in self.layer_vertex_names:
+            settings[n] = conf.vertices[n].layer_conf.updater_settings()
+        self.updater_def = MultiLayerUpdaterDef(settings)
+        self.params: Optional[dict] = None
+        self.state: Dict[str, dict] = {}
+        self.updater_state = None
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.score_value = float("nan")
+        self.listeners: List[Any] = []
+        self._jit_step = None
+        self._jit_output = None
+        self._base_key = jax.random.PRNGKey(conf.seed)
+
+    def _dtype(self):
+        return jnp.dtype(self.conf.dtype)
+
+    # ------------------------------------------------------------------
+
+    def init(self, params: Optional[dict] = None) -> "ComputationGraph":
+        dtype = self._dtype()
+        conf = self.conf
+        if params is not None:
+            self.params = params
+        else:
+            keys = jax.random.split(
+                self._base_key, max(len(self.layer_vertex_names), 1)
+            )
+            self.params = {
+                n: conf.vertices[n].init_params(k, dtype)
+                for n, k in zip(self.layer_vertex_names, keys)
+            }
+        self.state = {
+            n: conf.vertices[n].init_state(dtype)
+            for n in self.layer_vertex_names
+        }
+        self.updater_state = self.updater_def.init(self.params)
+        return self
+
+    # ------------------------------------------------------------------
+
+    def _forward_values(self, params, state, inputs: Sequence, *,
+                        train: bool, rng, fmasks=None):
+        """Walk the topo order; returns ({vertex: value}, preouts,
+        new_state). ``fmasks``: per-graph-input [b, t] masks."""
+        conf = self.conf
+        values: Dict[str, Any] = dict(zip(conf.inputs, inputs))
+        masks: Dict[str, Any] = {}
+        if fmasks is not None:
+            masks = {
+                name: m for name, m in zip(conf.inputs, fmasks)
+                if m is not None
+            }
+        new_state = dict(state)
+        preouts: Dict[str, Any] = {}
+        # Per-input masks follow the DAG: each vertex sees the mask
+        # propagated from whichever graph input feeds its branch
+        # (reference feedForwardMaskArrays). Time-collapsing vertices
+        # (LastTimeStep) clear the mask downstream.
+        vmask: Dict[str, Any] = dict(masks)
+        for i, name in enumerate(self.topo):
+            v = conf.vertices[name]
+            vin = [values[s] for s in conf.vertex_inputs[name]]
+            in_masks = [
+                vmask.get(s) for s in conf.vertex_inputs[name]
+            ]
+            mask = next((m for m in in_masks if m is not None), None)
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            vparams = params.get(name, {}) if isinstance(v, LayerVertex) else {}
+            vstate = state.get(name, {})
+            if isinstance(v, DuplicateToTimeSeriesVertex):
+                ref = values[v.reference_input]
+                out, st = v.apply(
+                    vparams, vin, vstate, train=train, rng=lrng,
+                    time=ref.shape[2],
+                )
+                vmask[name] = vmask.get(v.reference_input)
+            elif isinstance(v, LastTimeStepVertex):
+                m = masks.get(v.mask_input) if v.mask_input else mask
+                out, st = v.apply(vparams, vin, vstate, train=train,
+                                  rng=lrng, mask=m)
+                vmask[name] = None  # time axis collapsed
+            else:
+                out, st = v.apply(vparams, vin, vstate, train=train,
+                                  rng=lrng, mask=mask)
+                vmask[name] = mask
+            if isinstance(v, LayerVertex):
+                new_state[name] = st
+                layer = v.layer_conf
+                if name in conf.outputs and layer.has_loss():
+                    x = vin[0]
+                    if v.preprocessor is not None:
+                        from deeplearning4j_tpu.nn.conf.preprocessors import (
+                            ShapeContext,
+                        )
+                        t = x.shape[2] if x.ndim == 3 else -1
+                        x = v.preprocessor.preprocess(
+                            x, ShapeContext(batch=x.shape[0], time=t)
+                        )
+                    x = layer.maybe_dropout(x, train=train, rng=lrng)
+                    preouts[name] = layer.pre_output(params[name], x)
+            values[name] = out
+        return values, preouts, new_state
+
+    def _score_pure(self, params, state, inputs, labels, lmasks, rng, *,
+                    train: bool, fmasks=None):
+        from deeplearning4j_tpu.nn import losses as losses_mod
+
+        values, preouts, new_state = self._forward_values(
+            params, state, inputs, train=train, rng=rng, fmasks=fmasks
+        )
+        score = 0.0
+        for i, out_name in enumerate(self.conf.outputs):
+            v = self.conf.vertices[out_name]
+            layer = v.layer_conf if isinstance(v, LayerVertex) else None
+            if layer is None or not layer.has_loss():
+                raise ValueError(
+                    f"Output vertex '{out_name}' has no loss function"
+                )
+            y = labels[i]
+            m = lmasks[i] if lmasks is not None else None
+            score = score + losses_mod.score(
+                layer.loss, y, preouts[out_name], layer.activation, m, True
+            )
+        reg = 0.0
+        for n in self.layer_vertex_names:
+            layer = self.conf.vertices[n].layer_conf
+            if layer.l1 > 0.0 or layer.l2 > 0.0:
+                for pn in layer.regularizable_params():
+                    if pn in params[n]:
+                        w = params[n][pn]
+                        if layer.l2 > 0.0:
+                            reg = reg + 0.5 * layer.l2 * jnp.sum(w * w)
+                        if layer.l1 > 0.0:
+                            reg = reg + layer.l1 * jnp.sum(jnp.abs(w))
+        return score + reg, new_state
+
+    # ------------------------------------------------------------------
+
+    def _build_step(self):
+        updater = self.updater_def
+
+        def step(params, upd_state, state, inputs, labels, lmasks, fmasks,
+                 lrs, t, rng):
+            def loss_fn(p):
+                s, new_state = self._score_pure(
+                    p, state, inputs, labels, lmasks, rng, train=True,
+                    fmasks=fmasks,
+                )
+                return s, new_state
+
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            new_params, new_upd = updater.update(
+                grads, upd_state, params, lrs, t
+            )
+            return new_params, new_upd, new_state, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+
+    def fit(self, data, labels=None, *, epochs: int = 1) -> None:
+        """Accepts a MultiDataSet/DataSet, an iterator of either, or
+        (inputs, labels) lists (reference fit overloads
+        ``ComputationGraph.java:614-760``)."""
+        if labels is not None:
+            from deeplearning4j_tpu.datasets.api import MultiDataSet
+
+            mds = MultiDataSet(features=_as_list(data),
+                               labels=_as_list(labels))
+            self._fit_batches([mds], epochs)
+            return
+        if hasattr(data, "features"):
+            self._fit_batches([data], epochs)
+            return
+        self._fit_batches(data, epochs)
+
+    def _fit_batches(self, iterator, epochs: int) -> None:
+        if self.params is None:
+            self.init()
+        for epoch in range(epochs):
+            n = 0
+            for ds in iter(iterator):
+                self.fit_minibatch(ds)
+                n += 1
+            if epoch > 0 and n == 0:
+                raise ValueError(
+                    "Iterator yielded no batches after the first epoch — "
+                    "pass a list or an iterator with reset()"
+                )
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            self.epoch_count += 1
+
+    def fit_minibatch(self, ds) -> float:
+        if self.params is None:
+            self.init()
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
+        dtype = self._dtype()
+        features = _as_list(getattr(ds, "features"))
+        labels = _as_list(getattr(ds, "labels"))
+        fmasks = _as_list(getattr(ds, "features_masks", None)
+                          or getattr(ds, "features_mask", None))
+        lmasks = _as_list(getattr(ds, "labels_masks", None)
+                          or getattr(ds, "labels_mask", None))
+        inputs = [jnp.asarray(f, dtype) for f in features]
+        labels = [jnp.asarray(l, dtype) for l in labels]
+        fmasks = [
+            jnp.asarray(m, dtype) if m is not None else None for m in fmasks
+        ] or None
+        lmasks = [
+            jnp.asarray(m, dtype) if m is not None else None for m in lmasks
+        ] or None
+        score = None
+        for _ in range(self.conf.iterations):
+            lrs = self.updater_def.scheduled_lrs(self.iteration_count)
+            t = jnp.asarray(self.iteration_count + 1, jnp.float32)
+            rng = jax.random.fold_in(self._base_key, self.iteration_count)
+            (
+                self.params, self.updater_state, self.state, score,
+            ) = self._jit_step(
+                self.params, self.updater_state, self.state,
+                inputs, labels, lmasks, fmasks,
+                {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
+                t, rng,
+            )
+            self.iteration_count += 1
+            self.score_value = float(score)
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration_count)
+            self._reset_recurrent_state()
+        return float(score)
+
+    def _reset_recurrent_state(self) -> None:
+        for n in self.layer_vertex_names:
+            layer = self.conf.vertices[n].layer_conf
+            if layer.is_recurrent():
+                self.state[n] = {}
+
+    # ------------------------------------------------------------------
+
+    def output(self, *inputs) -> List[jax.Array]:
+        """Activated values of the output vertices (reference
+        ``ComputationGraph.output``)."""
+        if self.params is None:
+            self.init()
+        if self._jit_output is None:
+            def out_fn(params, state, inputs):
+                values, _, _ = self._forward_values(
+                    params, state, inputs, train=False, rng=None
+                )
+                return [values[n] for n in self.conf.outputs]
+            self._jit_output = jax.jit(out_fn)
+        dtype = self._dtype()
+        arr = [jnp.asarray(x, dtype) for x in inputs]
+        return self._jit_output(self.params, self.state, arr)
+
+    def score(self, ds) -> float:
+        dtype = self._dtype()
+        features = [jnp.asarray(f, dtype) for f in _as_list(ds.features)]
+        labels = [jnp.asarray(l, dtype) for l in _as_list(ds.labels)]
+        lmasks = _as_list(getattr(ds, "labels_masks", None)
+                          or getattr(ds, "labels_mask", None)) or None
+        fmasks = _as_list(getattr(ds, "features_masks", None)
+                          or getattr(ds, "features_mask", None)) or None
+        if lmasks:
+            lmasks = [
+                jnp.asarray(m, dtype) if m is not None else None
+                for m in lmasks
+            ]
+        if fmasks:
+            fmasks = [
+                jnp.asarray(m, dtype) if m is not None else None
+                for m in fmasks
+            ]
+        s, _ = self._score_pure(
+            self.params, self.state, features, labels, lmasks, None,
+            train=False, fmasks=fmasks,
+        )
+        return float(s)
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        e = Evaluation()
+        for ds in iterator:
+            out = self.output(*_as_list(ds.features))[0]
+            labels = _as_list(ds.labels)[0]
+            m = _as_list(getattr(ds, "labels_masks", None)
+                         or getattr(ds, "labels_mask", None))
+            e.eval(np.asarray(labels), np.asarray(out),
+                   mask=np.asarray(m[0]) if m and m[0] is not None else None)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return e
+
+    # ------------------------------------------------------------------
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def copy(self) -> "ComputationGraph":
+        # Deep-copy device buffers (the jitted step donates them).
+        clone = lambda a: jnp.array(a, copy=True)
+        g = ComputationGraph(self.conf)
+        g.init(params=jax.tree_util.tree_map(clone, self.params))
+        g.updater_state = jax.tree_util.tree_map(clone, self.updater_state)
+        g.state = jax.tree_util.tree_map(clone, self.state)
+        return g
+
+    def num_params(self) -> int:
+        return sum(
+            int(np.prod(p.shape))
+            for lp in self.params.values()
+            for p in lp.values()
+        )
+
+    def _flat_order(self) -> List[Tuple[str, str]]:
+        order = []
+        for name in self.layer_vertex_names:
+            pnames = list(self.params[name].keys())
+            preferred = [p for p in ("W", "b") if p in pnames]
+            rest = [p for p in pnames if p not in ("W", "b")]
+            for pn in preferred + sorted(rest):
+                order.append((name, pn))
+        return order
+
+    def params_flat(self) -> np.ndarray:
+        return np.concatenate([
+            np.asarray(self.params[ln][pn]).ravel()
+            for ln, pn in self._flat_order()
+        ]) if self.params else np.zeros((0,))
+
+    def set_params_flat(self, vec) -> None:
+        vec = np.asarray(vec)
+        off = 0
+        for ln, pn in self._flat_order():
+            p = self.params[ln][pn]
+            n = int(np.prod(p.shape))
+            self.params[ln][pn] = jnp.asarray(
+                vec[off:off + n].reshape(p.shape), p.dtype
+            )
+            off += n
+
+    def summary(self) -> str:
+        lines = ["=" * 72]
+        lines.append(f"{'vertex':<20}{'type':<30}{'params':>10}")
+        lines.append("-" * 72)
+        total = 0
+        for name in self.topo:
+            v = self.conf.vertices[name]
+            n = 0
+            if self.params and name in self.params:
+                n = sum(
+                    int(np.prod(p.shape))
+                    for p in self.params[name].values()
+                )
+            total += n
+            tname = (
+                type(v.layer_conf).__name__ if isinstance(v, LayerVertex)
+                else type(v).__name__
+            )
+            lines.append(f"{name:<20}{tname:<30}{n:>10}")
+        lines.append("-" * 72)
+        lines.append(f"Total params: {total}")
+        lines.append("=" * 72)
+        return "\n".join(lines)

@@ -1,0 +1,8 @@
+"""Utilities (reference ``deeplearning4j-nn/.../util``)."""
+
+from deeplearning4j_tpu.util.model_serializer import (  # noqa: F401
+    restore_computation_graph,
+    restore_model,
+    restore_multi_layer_network,
+    write_model,
+)

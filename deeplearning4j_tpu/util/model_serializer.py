@@ -1,0 +1,159 @@
+"""Checkpoint save/restore (reference: ``util/ModelSerializer.java:
+35,:47,:74-111`` — a zip holding ``configuration.json`` +
+``coefficients.bin`` + ``updaterState.bin``).
+
+Same three-part logical layout here (config JSON == the builder's JSON,
+params, updater state), with params stored as an npz of named arrays
+(``layer/param``) instead of one flat binary — the names make
+checkpoints self-describing and shard-assignable under pjit, while
+``params_flat`` remains available for flat-view parity. Layer state
+(batch-norm running stats, absent in the reference's format because
+its BN state lives inside params) is a fourth member.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import zipfile
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+CONFIG_NAME = "configuration.json"
+COEFFICIENTS_NAME = "coefficients.npz"
+UPDATER_NAME = "updaterState.npz"
+LAYER_STATE_NAME = "layerState.npz"
+
+
+def _flatten_params(params: dict) -> dict:
+    out = {}
+    for ln, lp in params.items():
+        for pn, arr in lp.items():
+            out[f"{ln}/{pn}"] = np.asarray(arr)
+    return out
+
+
+def _unflatten_params(d) -> dict:
+    out: dict = {}
+    for key in d.files:
+        # rsplit: layer/vertex names may contain '/', param names never do
+        ln, pn = key.rsplit("/", 1)
+        out.setdefault(ln, {})[pn] = jnp.asarray(d[key])
+    return out
+
+
+def _flatten_updater(state: dict) -> dict:
+    out = {}
+    for ln, lp in state.items():
+        for pn, tup in lp.items():
+            for i, arr in enumerate(tup):
+                out[f"{ln}/{pn}/{i}"] = np.asarray(arr)
+    return out
+
+
+def _unflatten_updater(d, template: dict) -> dict:
+    out: dict = {}
+    for ln, lp in template.items():
+        out[ln] = {}
+        for pn, tup in lp.items():
+            out[ln][pn] = tuple(
+                jnp.asarray(d[f"{ln}/{pn}/{i}"]) for i in range(len(tup))
+            )
+    return out
+
+
+def _write_npz(zf: zipfile.ZipFile, name: str, arrays: dict) -> None:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    zf.writestr(name, buf.getvalue())
+
+
+def _read_npz(zf: zipfile.ZipFile, name: str):
+    return np.load(io.BytesIO(zf.read(name)), allow_pickle=False)
+
+
+def write_model(model, path, save_updater: bool = True) -> None:
+    """Reference ``ModelSerializer.writeModel``."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    if isinstance(model, MultiLayerNetwork):
+        mtype = "MultiLayerNetwork"
+    elif isinstance(model, ComputationGraph):
+        mtype = "ComputationGraph"
+    else:
+        raise ValueError(f"Cannot serialize {type(model).__name__}")
+    conf_doc = {
+        "model_type": mtype,
+        "configuration": model.conf.to_dict(),
+        "iteration_count": model.iteration_count,
+        "epoch_count": model.epoch_count,
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(CONFIG_NAME, json.dumps(conf_doc, indent=2))
+        _write_npz(zf, COEFFICIENTS_NAME, _flatten_params(model.params))
+        layer_state = {
+            ln: st for ln, st in model.state.items() if st
+        }
+        if layer_state:
+            _write_npz(zf, LAYER_STATE_NAME, _flatten_params(layer_state))
+        if save_updater and model.updater_state is not None:
+            _write_npz(zf, UPDATER_NAME, _flatten_updater(model.updater_state))
+
+
+def restore_multi_layer_network(path, load_updater: bool = True):
+    """Reference ``ModelSerializer.restoreMultiLayerNetwork``."""
+    return _restore(path, load_updater, expect="MultiLayerNetwork")
+
+
+def restore_computation_graph(path, load_updater: bool = True):
+    """Reference ``ModelSerializer.restoreComputationGraph``."""
+    return _restore(path, load_updater, expect="ComputationGraph")
+
+
+def restore_model(path, load_updater: bool = True):
+    return _restore(path, load_updater, expect=None)
+
+
+def _restore(path, load_updater: bool, expect: Optional[str]):
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration,
+    )
+    from deeplearning4j_tpu.nn.conf.multi_layer import (
+        MultiLayerConfiguration,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path, "r") as zf:
+        doc = json.loads(zf.read(CONFIG_NAME))
+        mtype = doc["model_type"]
+        if expect is not None and mtype != expect:
+            raise ValueError(
+                f"Checkpoint holds a {mtype}, not a {expect}"
+            )
+        if mtype == "MultiLayerNetwork":
+            conf = MultiLayerConfiguration.from_dict(doc["configuration"])
+            model = MultiLayerNetwork(conf)
+        else:
+            conf = ComputationGraphConfiguration.from_dict(
+                doc["configuration"]
+            )
+            model = ComputationGraph(conf)
+        params = _unflatten_params(_read_npz(zf, COEFFICIENTS_NAME))
+        model.init(params=params)
+        names = set(zf.namelist())
+        if LAYER_STATE_NAME in names:
+            st = _unflatten_params(_read_npz(zf, LAYER_STATE_NAME))
+            for ln, s in st.items():
+                model.state[ln] = s
+        if load_updater and UPDATER_NAME in names:
+            model.updater_state = _unflatten_updater(
+                _read_npz(zf, UPDATER_NAME), model.updater_state
+            )
+        model.iteration_count = doc.get("iteration_count", 0)
+        model.epoch_count = doc.get("epoch_count", 0)
+    return model

@@ -1,0 +1,299 @@
+"""ComputationGraph tests (reference analog:
+``TestComputationGraphNetwork``, ``ComputationGraphTestRNN``,
+``TestCompGraphCNN``)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertex,
+    ElementWiseVertex,
+    L2NormalizeVertex,
+    L2Vertex,
+    LastTimeStepVertex,
+    MergeVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def test_linear_graph_matches_multilayer(rng):
+    """A chain graph must train identically to the equivalent
+    MultiLayerNetwork under the same seed."""
+    b = NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+    gconf = (
+        b.graph_builder()
+        .add_inputs("in")
+        .add_layer("d0", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+        .add_layer("out", OutputLayer(n_in=8, n_out=3), "d0")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(gconf).init()
+    x = rng.randn(10, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 10)]
+
+    mconf = (
+        NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_in=8, n_out=3))
+        .build()
+    )
+    import jax.numpy as jnp
+
+    m = MultiLayerNetwork(mconf).init()
+    # transplant identical initial params (copies: the jitted steps
+    # donate their buffers, so the two nets must not share arrays)
+    g.params["d0"] = {k: jnp.array(v, copy=True)
+                      for k, v in m.params["0"].items()}
+    g.params["out"] = {k: jnp.array(v, copy=True)
+                       for k, v in m.params["1"].items()}
+    g.updater_state = g.updater_def.init(g.params)
+
+    for _ in range(5):
+        m.fit(x, y)
+        g.fit(DataSet(features=x, labels=y))
+    np.testing.assert_allclose(
+        np.asarray(m.output(x)), np.asarray(g.output(x)[0]), rtol=1e-5
+    )
+
+
+def test_merge_and_elementwise(rng):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(5).learning_rate(0.1)
+        .graph_builder()
+        .add_inputs("a", "b")
+        .add_layer("da", DenseLayer(n_in=3, n_out=4, activation="relu"), "a")
+        .add_layer("db", DenseLayer(n_in=3, n_out=4, activation="relu"), "b")
+        .add_vertex("merge", MergeVertex(), "da", "db")
+        .add_vertex("sum", ElementWiseVertex(op="Add"), "da", "db")
+        .add_layer("h", DenseLayer(n_in=8, n_out=6), "merge")
+        .add_layer("out", OutputLayer(n_in=6, n_out=2), "h")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    xa = rng.randn(6, 3).astype(np.float32)
+    xb = rng.randn(6, 3).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 6)]
+    mds = MultiDataSet(features=[xa, xb], labels=[y])
+    s0 = g.score(mds)
+    for _ in range(20):
+        g.fit(mds)
+    assert g.score(mds) < s0
+    out = g.output(xa, xb)[0]
+    assert out.shape == (6, 2)
+
+
+def test_multi_output_training(rng):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(5).learning_rate(0.05)
+        .updater("ADAM")
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("shared", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                   "in")
+        .add_layer("out1", OutputLayer(n_in=8, n_out=2), "shared")
+        .add_layer("out2", OutputLayer(n_in=8, n_out=3, loss="MSE",
+                                       activation="identity"), "shared")
+        .set_outputs("out1", "out2")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    x = rng.randn(8, 4).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+    y2 = rng.randn(8, 3).astype(np.float32)
+    mds = MultiDataSet(features=[x], labels=[y1, y2])
+    s0 = g.score(mds)
+    for _ in range(30):
+        g.fit(mds)
+    assert g.score(mds) < s0
+    o1, o2 = g.output(x)
+    assert o1.shape == (8, 2) and o2.shape == (8, 3)
+
+
+def test_subset_l2_stack_unstack(rng):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(5)
+        .graph_builder()
+        .add_inputs("a", "b")
+        .add_vertex("sa", SubsetVertex(from_idx=0, to_idx=1), "a")
+        .add_vertex("sb", SubsetVertex(from_idx=2, to_idx=3), "b")
+        .add_vertex("stack", StackVertex(), "sa", "sb")
+        .add_vertex("un0", UnstackVertex(from_idx=0, stack_size=2), "stack")
+        .add_vertex("un1", UnstackVertex(from_idx=1, stack_size=2), "stack")
+        .add_vertex("dist", L2Vertex(), "un0", "un1")
+        .add_vertex("norm", L2NormalizeVertex(), "a")
+        .add_layer("out", OutputLayer(n_in=1, n_out=2), "dist")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    xa = rng.randn(5, 4).astype(np.float32)
+    xb = rng.randn(5, 4).astype(np.float32)
+    out = g.output(xa, xb)[0]
+    assert out.shape == (5, 2)
+    # check L2 vertex math through the values map
+    import jax.numpy as jnp
+    values, _, _ = g._forward_values(
+        g.params, g.state, [jnp.asarray(xa), jnp.asarray(xb)],
+        train=False, rng=None,
+    )
+    expect = np.linalg.norm(xa[:, 0:2] - xb[:, 2:4], axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(values["dist"]), expect, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(values["norm"]), axis=1), 1.0, rtol=1e-4
+    )
+
+
+def test_seq2seq_vertices(rng):
+    """Encoder LSTM -> LastTimeStep -> DuplicateToTimeSeries -> decoder
+    (reference rnn vertex tests)."""
+    conf = (
+        NeuralNetConfiguration.Builder().seed(5).learning_rate(0.05)
+        .updater("ADAM")
+        .graph_builder()
+        .add_inputs("seq_in")
+        .add_layer("enc", GravesLSTM(n_in=3, n_out=6), "seq_in")
+        .add_vertex("last", LastTimeStepVertex(mask_input="seq_in"), "enc")
+        .add_vertex("dup", DuplicateToTimeSeriesVertex(
+            reference_input="seq_in"), "last")
+        .add_layer("dec", GravesLSTM(n_in=6, n_out=6), "dup")
+        .add_layer("out", RnnOutputLayer(n_in=6, n_out=3), "dec")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    x = rng.randn(4, 3, 5).astype(np.float32)
+    y = np.zeros((4, 3, 5), np.float32)
+    y[:, 0, :] = 1.0
+    mds = MultiDataSet(features=[x], labels=[y])
+    s0 = g.score(mds)
+    for _ in range(10):
+        g.fit(mds)
+    assert g.score(mds) < s0
+    assert g.output(x)[0].shape == (4, 3, 5)
+
+
+def test_graph_shape_inference_with_input_types():
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("d0", DenseLayer(n_out=7), "in")
+        .add_layer("out", OutputLayer(n_out=2), "d0")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(13))
+        .build()
+    )
+    assert conf.vertices["d0"].layer_conf.n_in == 13
+    assert conf.vertices["out"].layer_conf.n_in == 7
+
+
+def test_graph_json_round_trip(rng):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(5)
+        .graph_builder()
+        .add_inputs("a", "b")
+        .add_layer("da", DenseLayer(n_in=3, n_out=4), "a")
+        .add_vertex("merge", MergeVertex(), "da", "b")
+        .add_layer("out", OutputLayer(n_in=7, n_out=2), "merge")
+        .set_outputs("out")
+        .build()
+    )
+    back = ComputationGraphConfiguration.from_json(conf.to_json())
+    assert back == conf
+
+
+def test_cycle_detection():
+    b = NeuralNetConfiguration.Builder().graph_builder()
+    b.add_inputs("in")
+    b.add_layer("a", DenseLayer(n_in=2, n_out=2), "b")
+    b.add_layer("b", DenseLayer(n_in=2, n_out=2), "a")
+    b.add_layer("out", OutputLayer(n_in=2, n_out=2), "b")
+    b.set_outputs("out")
+    with pytest.raises(ValueError, match="cycle"):
+        b.build()
+
+
+def test_unknown_input_reference():
+    b = NeuralNetConfiguration.Builder().graph_builder()
+    b.add_inputs("in")
+    b.add_layer("out", OutputLayer(n_in=2, n_out=2), "nope")
+    b.set_outputs("out")
+    with pytest.raises(ValueError, match="unknown input"):
+        b.build()
+
+
+def test_graph_gradients(rng):
+    """Numeric vs analytic gradients through merge + multi-output."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(12345)
+        .graph_builder()
+        .add_inputs("a", "b")
+        .add_layer("da", DenseLayer(n_in=3, n_out=4, activation="tanh"), "a")
+        .add_layer("db", DenseLayer(n_in=3, n_out=4, activation="sigmoid"),
+                   "b")
+        .add_vertex("merge", MergeVertex(), "da", "db")
+        .add_layer("out", OutputLayer(n_in=8, n_out=2), "merge")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    f64 = lambda t: jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a, jnp.float64), t
+    )
+    params = f64(g.params)
+    state = f64(g.state)
+    xa = jnp.asarray(rng.randn(5, 3))
+    xb = jnp.asarray(rng.randn(5, 3))
+    y = jnp.asarray(np.eye(2)[rng.randint(0, 2, 5)])
+
+    def score(p):
+        s, _ = g._score_pure(p, state, [xa, xb], [y], None, None,
+                             train=False)
+        return s
+
+    analytic = jax.grad(score)(params)
+    eps = 1e-6
+    checked = 0
+    for vn in ("da", "db", "out"):
+        for pn in ("W", "b"):
+            base = np.asarray(params[vn][pn], dtype=np.float64)
+            flat = base.ravel().copy()
+            a_grad = np.asarray(analytic[vn][pn]).ravel()
+            for i in rng.choice(flat.size, size=min(5, flat.size),
+                                replace=False):
+                orig = flat[i]
+                for sign, store in ((1, "plus"), (-1, "minus")):
+                    flat[i] = orig + sign * eps
+                    p2 = {k: dict(v) for k, v in params.items()}
+                    p2[vn][pn] = jnp.asarray(flat.reshape(base.shape))
+                    if sign == 1:
+                        s_plus = float(score(p2))
+                    else:
+                        s_minus = float(score(p2))
+                flat[i] = orig
+                numeric = (s_plus - s_minus) / (2 * eps)
+                assert abs(numeric - a_grad[i]) < 1e-3 * max(
+                    1.0, abs(numeric)
+                ), f"{vn}.{pn}[{i}]: {numeric} vs {a_grad[i]}"
+                checked += 1
+    assert checked > 0

@@ -1,0 +1,178 @@
+"""Checkpoint + ROC + early stopping tests (reference analog:
+``ModelSerializerTest``, ``ROCTest``, ``TestEarlyStopping``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.util import (
+    restore_multi_layer_network,
+    write_model,
+)
+
+
+def simple_net(seed=7, updater="ADAM"):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(0.05)
+        .updater(updater)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def blob_data(rng, n=60):
+    centers = rng.randn(3, 4) * 3
+    x = np.stack([centers[i % 3] + 0.3 * rng.randn(4) for i in range(n)])
+    y = np.eye(3)[np.arange(n) % 3]
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_checkpoint_round_trip(rng, tmp_path):
+    net = simple_net()
+    x, y = blob_data(rng)
+    net.fit(x, y, epochs=5)
+    path = os.path.join(tmp_path, "model.zip")
+    write_model(net, path)
+    restored = restore_multi_layer_network(path)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)), np.asarray(restored.output(x)), rtol=1e-6
+    )
+    assert restored.iteration_count == net.iteration_count
+    assert restored.conf == net.conf
+
+
+def test_checkpoint_resume_continues_identically(rng, tmp_path):
+    """Saving+restoring mid-training must continue bit-identically
+    (updater state restored — reference updaterState.bin)."""
+    x, y = blob_data(rng)
+    a = simple_net(seed=11)
+    a.fit(x, y, epochs=3)
+    path = os.path.join(tmp_path, "mid.zip")
+    write_model(a, path)
+    b = restore_multi_layer_network(path)
+    a.fit(x, y, epochs=3)
+    b.fit(x, y, epochs=3)
+    np.testing.assert_allclose(a.params_flat(), b.params_flat(), rtol=1e-6)
+
+
+def test_checkpoint_without_updater(rng, tmp_path):
+    net = simple_net()
+    x, y = blob_data(rng, n=12)
+    net.fit(x, y)
+    path = os.path.join(tmp_path, "nu.zip")
+    write_model(net, path, save_updater=False)
+    restored = restore_multi_layer_network(path, load_updater=False)
+    # fresh updater state: still trainable
+    restored.fit(x, y)
+    assert np.isfinite(restored.score_value)
+
+
+def test_checkpoint_rnn_with_state(rng, tmp_path):
+    from deeplearning4j_tpu.nn.conf import InputType
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(3).learning_rate(0.05)
+        .list()
+        .layer(GravesLSTM(n_out=6))
+        .layer(RnnOutputLayer(n_out=2))
+        .set_input_type(InputType.recurrent(3))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = rng.randn(2, 3, 5).astype(np.float32)
+    y = np.zeros((2, 2, 5), np.float32)
+    y[:, 0, :] = 1
+    net.fit(DataSet(features=x, labels=y))
+    path = os.path.join(tmp_path, "rnn.zip")
+    write_model(net, path)
+    restored = restore_multi_layer_network(path)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)), np.asarray(restored.output(x)), rtol=1e-5
+    )
+
+
+def test_roc_perfect_classifier():
+    roc = ROC(threshold_steps=50)
+    labels = np.array([0, 0, 0, 1, 1, 1])
+    probs = np.array([0.1, 0.2, 0.15, 0.9, 0.85, 0.95])
+    roc.eval(labels, probs)
+    assert roc.calculate_auc() > 0.99
+
+
+def test_roc_random_classifier(rng):
+    roc = ROC(threshold_steps=100)
+    labels = rng.randint(0, 2, 2000)
+    probs = rng.rand(2000)
+    roc.eval(labels, probs)
+    assert 0.45 < roc.calculate_auc() < 0.55
+
+
+def test_roc_one_hot_and_multiclass(rng):
+    roc = ROC()
+    labels = np.eye(2)[rng.randint(0, 2, 100)]
+    probs = np.clip(labels[:, 1] * 0.8 + 0.1 + 0.05 * rng.randn(100), 0, 1)
+    roc.eval(labels, np.stack([1 - probs, probs], axis=1))
+    assert roc.calculate_auc() > 0.9
+    m = ROCMultiClass()
+    lab3 = np.eye(3)[rng.randint(0, 3, 90)]
+    m.eval(lab3, lab3 * 0.9 + 0.05)
+    assert m.calculate_average_auc() > 0.99
+
+
+def test_early_stopping_max_epochs(rng, tmp_path):
+    x, y = blob_data(rng)
+    train = ListDataSetIterator(DataSet(features=x, labels=y).batch_by(20))
+    holdout = ListDataSetIterator([DataSet(features=x, labels=y)])
+    net = simple_net()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(holdout),
+        epoch_terminations=[MaxEpochsTerminationCondition(4)],
+        iteration_terminations=[InvalidScoreIterationTerminationCondition()],
+        model_saver=LocalFileModelSaver(str(tmp_path)),
+    )
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.total_epochs == 4
+    assert result.best_model is not None
+    assert os.path.exists(os.path.join(tmp_path, "bestModel.zip"))
+    # best model scores at least as well as the final
+    assert result.best_model_score <= net.score(x=x, labels=y) + 1e-6
+
+
+def test_early_stopping_score_improvement(rng):
+    x, y = blob_data(rng)
+    train = ListDataSetIterator(DataSet(features=x, labels=y).batch_by(20))
+    holdout = ListDataSetIterator([DataSet(features=x, labels=y)])
+    net = simple_net()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(holdout),
+        epoch_terminations=[
+            ScoreImprovementEpochTerminationCondition(
+                2, min_improvement=1e-3
+            ),
+            MaxEpochsTerminationCondition(200),
+        ],
+    )
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.total_epochs < 200
+    assert result.best_model_epoch >= 0
