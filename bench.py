@@ -1,0 +1,59 @@
+"""Benchmark: LeNet-5/MNIST training throughput (BASELINE.md config #1,
+the reference's primary metric — ``MultiLayerNetwork.fit()``
+examples/sec as measured by PerformanceListener,
+``optimize/listeners/PerformanceListener.java:71-86``).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); ``vs_baseline``
+divides by a documented estimate of the nd4j-cuda LeNet/MNIST
+throughput on a P100 (the north-star comparator): DL4J 0.6-era
+im2col+gemm/cuDNN at batch 64 sustains roughly 12k examples/sec on
+P100-class hardware. Replace with a measured number when one exists.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_EXAMPLES_PER_SEC = 12000.0  # estimated nd4j-cuda P100 LeNet
+BATCH = 256
+WARMUP_STEPS = 12
+MEASURE_STEPS = 60
+
+
+def main() -> None:
+    from __graft_entry__ import _lenet_conf
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(_lenet_conf()).init()
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(BATCH, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, BATCH)]
+    ds = DataSet(features=x, labels=y)
+    for _ in range(WARMUP_STEPS):
+        net.fit_minibatch(ds)
+    # force a sync so warmup work doesn't leak into the timed region
+    _ = float(net.score_value)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        net.fit_minibatch(ds)
+    _ = float(net.score_value)  # score read syncs every step already
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = MEASURE_STEPS * BATCH / dt
+    print(json.dumps({
+        "metric": "lenet_mnist_fit_examples_per_sec",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(examples_per_sec / BASELINE_EXAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,3 +7,16 @@ from deeplearning4j_tpu.datasets.api import (  # noqa: F401
     ListDataSetIterator,
     MultiDataSet,
 )
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    AsyncDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.record_reader import (  # noqa: F401
+    CSVRecordReader,
+    CollectionRecordReader,
+    RecordReader,
+    RecordReaderDataSetIterator,
+)

@@ -1,0 +1,201 @@
+"""Iterator wrappers (reference: ``datasets/iterator/*`` — notably
+``AsyncDataSetIterator.java:36`` with its background prefetch thread +
+blocking queue, ``MultipleEpochsIterator``, ``SamplingDataSetIterator``).
+
+On TPU the async prefetch overlaps host-side data preparation with
+device compute exactly like the reference overlaps ETL with training;
+device transfer itself happens inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+
+_SENTINEL = object()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (reference
+    ``AsyncDataSetIterator.java:36,:75-76,:256`` — IteratorRunnable
+    feeding a LinkedBlockingQueue of ``queue_size``)."""
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 2):
+        if queue_size < 1:
+            raise ValueError("queue_size must be >= 1")
+        self.base = base
+        self.queue_size = queue_size
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._exception: Optional[BaseException] = None
+        self._pending_exc: Optional[BaseException] = None
+        self._next_item = None
+        self._started = False
+
+    # -- internals -----------------------------------------------------
+
+    def _runner(self, q: "queue.Queue", stop: threading.Event) -> None:
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer cancels
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            for ds in self.base:
+                if not put(ds):
+                    return  # cancelled; no sentinel needed
+        except BaseException as e:  # surfaced on the consumer thread
+            self._exception = e
+        finally:
+            put(_SENTINEL)
+
+    def _start(self) -> None:
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._stop = threading.Event()
+        self._exception = None
+        self._thread = threading.Thread(
+            target=self._runner, args=(self._queue, self._stop), daemon=True
+        )
+        self._thread.start()
+        self._started = True
+        self._advance()
+
+    def _advance(self) -> None:
+        item = self._queue.get()
+        if item is _SENTINEL:
+            self._next_item = None
+            if self._exception is not None:
+                # deliver already-fetched batches first; raise on the
+                # call that would need the failed batch
+                self._pending_exc = self._exception
+                self._exception = None
+        else:
+            self._next_item = item
+
+    # -- DataSetIterator -----------------------------------------------
+
+    def has_next(self) -> bool:
+        if not self._started:
+            self._start()
+        return self._next_item is not None or self._pending_exc is not None
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        if self._next_item is None and self._pending_exc is not None:
+            exc, self._pending_exc = self._pending_exc, None
+            raise exc
+        ds = self._next_item
+        self._advance()
+        return ds
+
+    def reset(self) -> None:
+        self.shutdown()
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+        self._started = False
+        self._next_item = None
+
+    def shutdown(self) -> None:
+        """Cancel and join the worker (reference ``shutdown()``). Safe
+        to call mid-stream: the producer observes the stop flag instead
+        of blocking on a full queue."""
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            # unblock a producer stuck between puts
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5.0)
+            if self._thread.is_alive():  # pragma: no cover
+                raise RuntimeError("AsyncDataSetIterator worker leaked")
+        self._thread = None
+
+    def batch(self) -> int:
+        return self.base.batch()
+
+    def total_examples(self) -> int:
+        return self.base.total_examples()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Present N epochs of a base iterator as one pass (reference
+    ``MultipleEpochsIterator``)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = epochs
+        self.base = base
+        self._epoch = 0
+
+    def has_next(self) -> bool:
+        if self.base.has_next():
+            return True
+        if self._epoch + 1 < self.epochs:
+            self._epoch += 1
+            self.base.reset()
+            return self.base.has_next()
+        return False
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.base.next()
+
+    def reset(self) -> None:
+        self._epoch = 0
+        self.base.reset()
+
+    def batch(self) -> int:
+        return self.base.batch()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample minibatches with replacement from a full DataSet
+    (reference ``SamplingDataSetIterator``)."""
+
+    def __init__(self, full: DataSet, batch_size: int,
+                 total_batches: int, seed: int = 123):
+        self.full = full
+        self.batch_size = batch_size
+        self.total_batches = total_batches
+        self._rng = np.random.RandomState(seed)
+        self._seed = seed
+        self._count = 0
+
+    def has_next(self) -> bool:
+        return self._count < self.total_batches
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        self._count += 1
+        idx = self._rng.randint(0, self.full.num_examples(),
+                                self.batch_size)
+        return DataSet(
+            features=self.full.features[idx],
+            labels=self.full.labels[idx],
+            features_mask=(None if self.full.features_mask is None
+                           else self.full.features_mask[idx]),
+            labels_mask=(None if self.full.labels_mask is None
+                         else self.full.labels_mask[idx]),
+        )
+
+    def reset(self) -> None:
+        self._count = 0
+        self._rng = np.random.RandomState(self._seed)
+
+    def batch(self) -> int:
+        return self.batch_size

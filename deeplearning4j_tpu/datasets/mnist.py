@@ -1,0 +1,147 @@
+"""MNIST (reference: ``datasets/mnist/MnistManager.java`` IDX parsing +
+``MnistDataFetcher`` download/cache + ``MnistDataSetIterator``).
+
+The reference downloads MNIST at first use. This build environment has
+no egress, so resolution order is:
+1. ``DL4J_TPU_MNIST_DIR`` env var or ``data_dir`` argument pointing at
+   the four standard IDX files (gz or raw),
+2. ``~/.deeplearning4j_tpu/mnist/``,
+3. a deterministic synthetic fallback (class-conditional strokes) so
+   pipelines and benchmarks run without the real data — clearly flagged
+   via ``.synthetic``.
+
+IDX parsing matches MnistManager: big-endian magic 2051/2049.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+
+FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+_ALIASES = {
+    "test_images": ["t10k-images-idx3-ubyte", "t10k-idx3-ubyte"],
+}
+
+
+def _open_maybe_gz(path: str):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    return open(path, "rb")
+
+
+def read_idx_images(path: str) -> np.ndarray:
+    """Parse an IDX3 image file (reference MnistManager.readImage)."""
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"Bad IDX3 magic {magic} in {path}")
+        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows * cols)
+
+
+def read_idx_labels(path: str) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"Bad IDX1 magic {magic} in {path}")
+        return np.frombuffer(f.read(n), dtype=np.uint8)
+
+
+def _find_file(directory: str, stem: str) -> Optional[str]:
+    names = [FILES[stem]] + _ALIASES.get(stem, [])
+    for n in names:
+        p = os.path.join(directory, n)
+        if os.path.exists(p) or os.path.exists(p + ".gz"):
+            return p
+    return None
+
+
+def _synthetic_mnist(n: int, seed: int, train: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic class-conditional synthetic digits: each class c
+    is a distinct fixed blob pattern + noise. Linearly separable but
+    shaped/scaled exactly like MNIST (uint8 [n, 784], labels [n])."""
+    rng = np.random.RandomState(seed + (0 if train else 1))
+    proto_rng = np.random.RandomState(1234)
+    protos = (proto_rng.rand(10, 784) > 0.82).astype(np.float32) * 200.0
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    imgs = protos[labels] + rng.randn(n, 784) * 25.0
+    return np.clip(imgs, 0, 255).astype(np.uint8), labels
+
+
+class MnistDataSetIterator(DataSetIterator):
+    """Reference ``MnistDataSetIterator.java:30``: minibatches of
+    normalized [0,1] 784-feature rows + one-hot labels."""
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 num_examples: Optional[int] = None, seed: int = 123,
+                 data_dir: Optional[str] = None,
+                 binarize: bool = False, shuffle: bool = True):
+        self.batch_size = batch_size
+        self.synthetic = False
+        directory = (
+            data_dir
+            or os.environ.get("DL4J_TPU_MNIST_DIR")
+            or os.path.expanduser("~/.deeplearning4j_tpu/mnist")
+        )
+        img_stem = "train_images" if train else "test_images"
+        lab_stem = "train_labels" if train else "test_labels"
+        img_path = _find_file(directory, img_stem)
+        lab_path = _find_file(directory, lab_stem)
+        if img_path and lab_path:
+            images = read_idx_images(img_path)
+            labels = read_idx_labels(lab_path)
+        else:
+            n = num_examples or (60000 if train else 10000)
+            images, labels = _synthetic_mnist(n, seed, train)
+            self.synthetic = True
+        if num_examples is not None:
+            images = images[:num_examples]
+            labels = labels[:num_examples]
+        if shuffle:
+            idx = np.random.RandomState(seed).permutation(len(images))
+            images, labels = images[idx], labels[idx]
+        feats = images.astype(np.float32) / 255.0
+        if binarize:
+            feats = (feats > 0.5).astype(np.float32)
+        onehot = np.zeros((len(labels), 10), np.float32)
+        onehot[np.arange(len(labels)), labels] = 1.0
+        self._features = feats
+        self._labels = onehot
+        self._pos = 0
+
+    def next(self) -> DataSet:
+        i = self._pos
+        j = min(i + self.batch_size, len(self._features))
+        self._pos = j
+        return DataSet(features=self._features[i:j],
+                       labels=self._labels[i:j])
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._features)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_examples(self) -> int:
+        return len(self._features)
+
+    def input_columns(self) -> int:
+        return 784
+
+    def total_outcomes(self) -> int:
+        return 10

@@ -1,0 +1,113 @@
+"""RecordReader bridge (reference: the DataVec bridge
+``RecordReaderDataSetIterator`` / ``SequenceRecordReaderDataSetIterator``
+in ``datasets/datavec/``; DataVec itself is an external dependency of
+the reference — here a compact host-side equivalent).
+
+``RecordReader`` yields records (lists of values); the iterator turns
+them into featurized minibatches with optional one-hot label handling,
+mirroring the reference's (labelIndex, numPossibleLabels) contract.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+
+
+class RecordReader:
+    """SPI: iterable of records (list of str/float)."""
+
+    def records(self) -> Iterator[List]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class CSVRecordReader(RecordReader):
+    """Reference DataVec ``CSVRecordReader`` (skip lines, delimiter)."""
+
+    def __init__(self, path: str, skip_lines: int = 0,
+                 delimiter: str = ","):
+        self.path = path
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def records(self) -> Iterator[List]:
+        with open(self.path, newline="") as f:
+            reader = csv.reader(f, delimiter=self.delimiter)
+            for i, row in enumerate(reader):
+                if i < self.skip_lines or not row:
+                    continue
+                yield row
+
+
+class CollectionRecordReader(RecordReader):
+    def __init__(self, collection: Sequence[Sequence]):
+        self.collection = collection
+
+    def records(self) -> Iterator[List]:
+        return iter([list(r) for r in self.collection])
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Reference ``RecordReaderDataSetIterator``: featurize records,
+    optionally one-hot a label column."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = None,
+                 num_possible_labels: int = 0,
+                 regression: bool = False):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_possible_labels = num_possible_labels
+        self.regression = regression
+        self._it: Optional[Iterator[List]] = None
+        self._pending: Optional[List] = None
+
+    def _ensure(self) -> None:
+        if self._it is None:
+            self.reader.reset()
+            self._it = self.reader.records()
+            self._pending = next(self._it, None)
+
+    def has_next(self) -> bool:
+        self._ensure()
+        return self._pending is not None
+
+    def next(self) -> DataSet:
+        self._ensure()
+        feats, labels = [], []
+        while self._pending is not None and len(feats) < self.batch_size:
+            row = [float(v) for v in self._pending]
+            self._pending = next(self._it, None)
+            if self.label_index is None:
+                feats.append(row)
+                continue
+            label = row[self.label_index]
+            row = row[:self.label_index] + row[self.label_index + 1:]
+            feats.append(row)
+            if self.regression:
+                labels.append([label])
+            else:
+                onehot = [0.0] * self.num_possible_labels
+                onehot[int(label)] = 1.0
+                labels.append(onehot)
+        if not feats:
+            raise StopIteration
+        x = np.asarray(feats, np.float32)
+        y = (np.asarray(labels, np.float32) if labels else x)
+        return DataSet(features=x, labels=y)
+
+    def reset(self) -> None:
+        self._it = None
+        self._pending = None
+
+    def batch(self) -> int:
+        return self.batch_size

@@ -51,11 +51,20 @@ class ComputationGraph:
         self.updater_state = None
         self.iteration_count = 0
         self.epoch_count = 0
-        self.score_value = float("nan")
+        self._last_score = float("nan")
         self.listeners: List[Any] = []
         self._jit_step = None
         self._jit_output = None
         self._base_key = jax.random.PRNGKey(conf.seed)
+
+    @property
+    def score_value(self) -> float:
+        """Latest minibatch score (reading syncs with the device)."""
+        return float(self._last_score)
+
+    @score_value.setter
+    def score_value(self, v) -> None:
+        self._last_score = v
 
     def _dtype(self):
         return jnp.dtype(self.conf.dtype)
@@ -273,11 +282,11 @@ class ComputationGraph:
                 t, rng,
             )
             self.iteration_count += 1
-            self.score_value = float(score)
+            self._last_score = score  # device array; sync deferred
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count)
             self._reset_recurrent_state()
-        return float(score)
+        return score  # 0-d device array; float() to sync
 
     def _reset_recurrent_state(self) -> None:
         for n in self.layer_vertex_names:

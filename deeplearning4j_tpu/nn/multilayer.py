@@ -56,13 +56,25 @@ class MultiLayerNetwork:
         self.updater_state = None
         self.iteration_count = 0
         self.epoch_count = 0
-        self.score_value = float("nan")
+        self._last_score = float("nan")
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep state
         self._jit_step = None
         self._jit_output = None
         self._jit_rnn_step = None
         self._base_key = jax.random.PRNGKey(conf.seed)
+
+    @property
+    def score_value(self) -> float:
+        """Latest minibatch score. Reading this syncs with the device
+        (the jitted step returns the score as a device scalar and does
+        NOT block — throughput-critical loops should avoid reading it
+        every step; PerformanceListener doesn't)."""
+        return float(self._last_score)
+
+    @score_value.setter
+    def score_value(self, v) -> None:
+        self._last_score = v
 
     # ------------------------------------------------------------------
     # init (reference MultiLayerNetwork.init():367)
@@ -277,14 +289,14 @@ class MultiLayerNetwork:
                 t, rng,
             )
             self.iteration_count += 1
-            self.score_value = float(score)
+            self._last_score = score  # device array; sync deferred
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count)
             # Reset per optimizer iteration: each pass over the same
             # minibatch starts from zero recurrent carry (also keeps
             # the step's state pytree structure stable -> no recompile)
             self._reset_recurrent_state()
-        return float(score)
+        return score  # 0-d device array; float() to sync
 
     def _reset_recurrent_state(self) -> None:
         """Standard-backprop mode: recurrent carry does not persist
@@ -333,10 +345,10 @@ class MultiLayerNetwork:
             t, rng,
         )
         self.iteration_count += 1
-        self.score_value = float(score)
+        self._last_score = score  # device array; sync deferred
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
-        return float(score)
+        return score  # 0-d device array; float() to sync
 
     # -- inference -----------------------------------------------------
 

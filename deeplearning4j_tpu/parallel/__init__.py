@@ -1,0 +1,16 @@
+"""Parallel & distributed training (replaces reference
+``parallelism/ParallelWrapper`` and ``deeplearning4j-scaleout/spark``
+with jax.sharding + XLA collectives, SURVEY.md §2.4)."""
+
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    build_mesh,
+    init_distributed,
+    process_local_batch,
+    replicated,
+)
+from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
+    DistributedTrainer,
+    default_partition_rules,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401

@@ -1,0 +1,87 @@
+"""Device mesh utilities (TPU-native replacement for the reference's
+two distribution substrates: ``ParallelWrapper``'s device threads and
+Spark's executor topology, SURVEY.md §2.4).
+
+One component replaces both: a ``jax.sharding.Mesh`` over all chips
+(ICI within a slice, DCN across slices via ``jax.distributed``), with
+named axes — ``data`` for batch sharding (the Spark/ParallelWrapper
+analog), ``model`` for tensor parallelism (net-new capability). XLA
+inserts the collectives (psum over ICI) that the reference delegates
+to Spark RDD aggregation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build_mesh(
+    data: Optional[int] = None,
+    model: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Mesh with (data, model) axes. Defaults: all devices on the data
+    axis (pure DP, the reference's only mode)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        if n % model != 0:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model != n:
+        raise ValueError(
+            f"data({data}) x model({model}) != device count ({n})"
+        )
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, axis_names=("data", "model"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis over 'data'."""
+    return NamedSharding(mesh, P("data"))
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host initialization (replaces the reference's Spark
+    master/executor bootstrap; reference
+    ``SparkDl4jMultiLayer``/``TrainingMaster`` setup).
+
+    With no arguments, reads the standard env vars
+    (``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``, ``PROCESS_ID``) or
+    defers to the TPU pod runtime's automatic configuration.
+    """
+    kwargs = {}
+    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if addr:
+        kwargs["coordinator_address"] = addr
+    npr = num_processes or os.environ.get("NUM_PROCESSES")
+    if npr:
+        kwargs["num_processes"] = int(npr)
+    pid = process_id if process_id is not None else os.environ.get("PROCESS_ID")
+    if pid is not None:
+        kwargs["process_id"] = int(pid)
+    jax.distributed.initialize(**kwargs)
+
+
+def process_local_batch(global_batch: int, mesh: Mesh) -> int:
+    """Per-host share of a global batch (host-sharded input pipeline,
+    the AsyncDataSetIterator-per-executor analog), proportional to the
+    mesh devices this process owns."""
+    devices = list(mesh.devices.flat)
+    local = sum(
+        1 for d in devices if d.process_index == jax.process_index()
+    )
+    return global_batch * local // len(devices)
