@@ -29,3 +29,16 @@ from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
     GravesLSTM,
     RnnOutputLayer,
 )
+from deeplearning4j_tpu.nn.layers.pretrain import (  # noqa: F401
+    RBM,
+    AutoEncoder,
+)
+from deeplearning4j_tpu.nn.layers.variational import (  # noqa: F401
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution,
+    LossFunctionWrapper,
+    ReconstructionDistribution,
+    VariationalAutoencoder,
+)

@@ -58,6 +58,8 @@ def layer_to_json(layer: "LayerSpec") -> dict:
             v = {"@input_type": True, **v.to_json()}
         elif isinstance(v, LayerSpec):
             v = layer_to_json(v)
+        elif hasattr(v, "to_json") and hasattr(v, "neg_log_prob"):
+            v = v.to_json()  # ReconstructionDistribution (tagged @dist_class)
         elif isinstance(v, tuple):
             v = list(v)
         d[f.name] = v
@@ -87,6 +89,12 @@ def layer_from_json(d: dict) -> "LayerSpec":
             v = InputType.from_json({
                 kk: vv for kk, vv in v.items() if kk != "@input_type"
             })
+        elif isinstance(v, dict) and "@dist_class" in v:
+            from deeplearning4j_tpu.nn.layers.variational import (
+                ReconstructionDistribution,
+            )
+
+            v = ReconstructionDistribution.from_json(v)
         elif isinstance(v, dict) and "@class" in v:
             v = layer_from_json(v)
         elif isinstance(v, list):

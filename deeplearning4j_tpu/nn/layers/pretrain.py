@@ -1,0 +1,212 @@
+"""Layer-wise pretraining layers: denoising AutoEncoder and RBM
+(reference: ``nn/layers/feedforward/autoencoder/AutoEncoder.java``,
+``nn/layers/feedforward/rbm/RBM.java:101,:200`` contrastive divergence
++ Gibbs sampling; config beans ``nn/conf/layers/AutoEncoder.java``,
+``nn/conf/layers/RBM.java:83-86`` VisibleUnit/HiddenUnit enums).
+
+TPU-first notes:
+- The autoencoder's corrupt→encode→decode→loss is one traced
+  expression; tied decoder weights (W^T) stay a single MXU matmul.
+- The RBM's CD-k gradient (positive phase minus negative phase) is
+  expressed through the free-energy identity: grad of
+  ``mean(F(v_data) - F(v_model))`` with the Gibbs chain under
+  ``stop_gradient`` equals the classic CD update for binary units, so
+  ``jax.grad`` produces the reference's hand-derived update without a
+  second code path. The k Gibbs steps run in ``lax.fori_loop`` (static
+  trip count, PRNG threaded) — one compiled kernel, no host round
+  trips per step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn import losses as losses_mod
+from deeplearning4j_tpu.nn.layers.base import register_layer
+from deeplearning4j_tpu.nn.layers.feedforward import FeedForwardLayerSpec
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+@register_layer
+@dataclass(frozen=True)
+class AutoEncoder(FeedForwardLayerSpec):
+    """Denoising autoencoder with tied weights (reference
+    ``nn/layers/feedforward/autoencoder/AutoEncoder.java``: encode
+    sigmoid(xW+b), decode sigmoid(hW'+vb), masking-noise corruption
+    ``corruptionLevel``)."""
+
+    corruption_level: float = 0.3
+    loss: str = "XENT"
+
+    def is_pretrainable(self) -> bool:
+        return True
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        w = init_weights(
+            key, (self.n_in, self.n_out), self.weight_init,
+            fan_in=self.n_in, fan_out=self.n_out,
+            distribution=self.dist, dtype=dtype,
+        )
+        return {
+            "W": w,
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+            "vb": jnp.full((self.n_in,), self.bias_init, dtype),
+        }
+
+    def encode(self, params, x):
+        return self.activate_fn()(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return self.activate_fn()(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        return self.encode(params, x), state
+
+    def pretrain_loss(self, params, x, rng):
+        corrupted = x
+        if rng is not None and self.corruption_level > 0.0:
+            keep = jax.random.bernoulli(
+                rng, 1.0 - self.corruption_level, x.shape
+            )
+            corrupted = jnp.where(keep, x, 0.0)
+        h = self.encode(params, corrupted)
+        recon_pre = h @ params["W"].T + params["vb"]
+        return losses_mod.score(
+            self.loss, x, recon_pre, self.activation, None, True
+        )
+
+
+@register_layer
+@dataclass(frozen=True)
+class RBM(FeedForwardLayerSpec):
+    """Restricted Boltzmann machine trained by CD-k (reference
+    ``nn/layers/feedforward/rbm/RBM.java``: ``contrastiveDivergence``
+    at ``:101``, ``gibbhVh`` sampling chain at ``:200``).
+
+    ``visible_unit``: BINARY | GAUSSIAN; ``hidden_unit``: BINARY |
+    RECTIFIED (reference enums ``RBM.java:83-86`` also list SOFTMAX —
+    rarely used; unsupported here and rejected at init).
+    """
+
+    visible_unit: str = "BINARY"
+    hidden_unit: str = "BINARY"
+    k: int = 1
+
+    def is_pretrainable(self) -> bool:
+        return True
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        if self.visible_unit not in ("BINARY", "GAUSSIAN"):
+            raise ValueError(f"Unsupported visible_unit {self.visible_unit}")
+        if self.hidden_unit not in ("BINARY", "RECTIFIED"):
+            raise ValueError(f"Unsupported hidden_unit {self.hidden_unit}")
+        w = init_weights(
+            key, (self.n_in, self.n_out), self.weight_init,
+            fan_in=self.n_in, fan_out=self.n_out,
+            distribution=self.dist, dtype=dtype,
+        )
+        return {
+            "W": w,
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),   # hidden
+            "vb": jnp.full((self.n_in,), self.bias_init, dtype),   # visible
+        }
+
+    # -- conditionals -------------------------------------------------------
+
+    def _hidden_mean(self, params, v):
+        pre = v @ params["W"] + params["b"]
+        if self.hidden_unit == "RECTIFIED":
+            return jnp.maximum(pre, 0.0)
+        return jax.nn.sigmoid(pre)
+
+    def _sample_hidden(self, params, v, key):
+        pre = v @ params["W"] + params["b"]
+        if self.hidden_unit == "RECTIFIED":
+            # NReLU sampling: max(0, pre + N(0, sigmoid(pre))) (reference
+            # RBM.java RECTIFIED branch uses pre + gaussian noise)
+            noise = jax.random.normal(key, pre.shape, pre.dtype)
+            return jnp.maximum(
+                0.0, pre + noise * jnp.sqrt(jax.nn.sigmoid(pre))
+            )
+        p = jax.nn.sigmoid(pre)
+        return jax.random.bernoulli(key, p).astype(pre.dtype)
+
+    def _visible_mean(self, params, h):
+        pre = h @ params["W"].T + params["vb"]
+        if self.visible_unit == "GAUSSIAN":
+            return pre
+        return jax.nn.sigmoid(pre)
+
+    def _sample_visible(self, params, h, key):
+        mean = self._visible_mean(params, h)
+        if self.visible_unit == "GAUSSIAN":
+            return mean + jax.random.normal(key, mean.shape, mean.dtype)
+        return jax.random.bernoulli(key, mean).astype(mean.dtype)
+
+    def free_energy(self, params, v):
+        """F(v) for monitoring; binary hidden only: F = -v·vb -
+        Σ softplus(vW + b), Gaussian visible adds 0.5‖v−vb‖²."""
+        pre_h = v @ params["W"] + params["b"]
+        hidden_term = jnp.sum(jax.nn.softplus(pre_h), axis=-1)
+        if self.visible_unit == "GAUSSIAN":
+            vis_term = 0.5 * jnp.sum((v - params["vb"]) ** 2, axis=-1)
+            return vis_term - hidden_term
+        return -(v @ params["vb"]) - hidden_term
+
+    def _pseudo_energy(self, params, v):
+        """Energy with hidden statistics held constant
+        (stop-gradient): its gradient wrt (W, b, vb) is exactly the
+        per-phase CD statistic — -v^T·E[h|v], -E[h|v], -v — for
+        WHATEVER hidden mean the unit type defines (sigmoid for
+        BINARY, max(0,·) for RECTIFIED), matching the reference's CD
+        update which uses the unit's own conditional mean."""
+        h = lax.stop_gradient(self._hidden_mean(params, v))
+        pre_h = v @ params["W"] + params["b"]
+        hidden_term = jnp.sum(h * pre_h, axis=-1)
+        if self.visible_unit == "GAUSSIAN":
+            vis_term = 0.5 * jnp.sum((v - params["vb"]) ** 2, axis=-1)
+            return vis_term - hidden_term
+        return -(v @ params["vb"]) - hidden_term
+
+    # -- supervised forward: propUp -----------------------------------------
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        return self._hidden_mean(params, x), state
+
+    # -- CD-k ---------------------------------------------------------------
+
+    def gibbs_chain(self, params, v0, rng):
+        """k alternating Gibbs steps from v0; returns the negative-phase
+        visible sample (chain end)."""
+        def body(i, carry):
+            v, key = carry
+            key, kh, kv = jax.random.split(key, 3)
+            h = self._sample_hidden(params, v, kh)
+            v = self._sample_visible(params, h, kv)
+            return (v, key)
+
+        v_neg, _ = lax.fori_loop(0, self.k, body, (v0, rng))
+        return v_neg
+
+    def pretrain_loss(self, params, x, rng):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        v_neg = lax.stop_gradient(self.gibbs_chain(params, x, rng))
+        cd = jnp.mean(
+            self._pseudo_energy(params, x) - self._pseudo_energy(params, v_neg)
+        )
+        # Monitor term with zero gradient: reconstruction error, the
+        # quantity the reference reports as the RBM score.
+        recon = self._visible_mean(params, self._hidden_mean(params, x))
+        err = jnp.mean(jnp.sum((lax.stop_gradient(recon) - x) ** 2, axis=-1))
+        return cd + lax.stop_gradient(err - cd)
+
+    def reconstruction_error(self, params, x):
+        recon = self._visible_mean(params, self._hidden_mean(params, x))
+        return jnp.mean(jnp.sum((recon - x) ** 2, axis=-1))

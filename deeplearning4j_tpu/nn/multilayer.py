@@ -39,6 +39,20 @@ def _dtype_of(conf: MultiLayerConfiguration):
     return jnp.dtype(conf.dtype)
 
 
+def _reg_penalty(layer, layer_params):
+    """L1/L2 penalty for one layer (reference calcL1/calcL2)."""
+    reg = 0.0
+    if layer.l1 > 0.0 or layer.l2 > 0.0:
+        for pn in layer.regularizable_params():
+            if pn in layer_params:
+                w = layer_params[pn]
+                if layer.l2 > 0.0:
+                    reg = reg + 0.5 * layer.l2 * jnp.sum(w * w)
+                if layer.l1 > 0.0:
+                    reg = reg + layer.l1 * jnp.sum(jnp.abs(w))
+    return reg
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -62,6 +76,8 @@ class MultiLayerNetwork:
         self._jit_step = None
         self._jit_output = None
         self._jit_rnn_step = None
+        self._jit_pretrain_steps: Dict[int, Callable] = {}
+        self._pretrain_done = False
         self._base_key = jax.random.PRNGKey(conf.seed)
 
     @property
@@ -99,6 +115,7 @@ class MultiLayerNetwork:
             for name, layer in zip(self.layer_names, self.conf.layers)
         }
         self.updater_state = self.updater_def.init(self.params)
+        self._pretrain_done = False  # fresh params ⇒ pretrain again
         return self
 
     # ------------------------------------------------------------------
@@ -171,14 +188,7 @@ class MultiLayerNetwork:
         )
         reg = 0.0
         for lname, layer in zip(self.layer_names, self.conf.layers):
-            if layer.l1 > 0.0 or layer.l2 > 0.0:
-                for pn in layer.regularizable_params():
-                    if pn in params[lname]:
-                        w = params[lname][pn]
-                        if layer.l2 > 0.0:
-                            reg = reg + 0.5 * layer.l2 * jnp.sum(w * w)
-                        if layer.l1 > 0.0:
-                            reg = reg + layer.l1 * jnp.sum(jnp.abs(w))
+            reg = reg + _reg_penalty(layer, params[lname])
         return score + reg, new_state
 
     # ------------------------------------------------------------------
@@ -231,6 +241,15 @@ class MultiLayerNetwork:
     def _fit_batches(self, iterator, epochs: int) -> None:
         if self.params is None:
             self.init()
+        if self.conf.pretrain and not self._pretrain_done:
+            # reference fit():1064 — layer-wise pretrain before backprop
+            if not hasattr(iterator, "reset") and not isinstance(
+                iterator, (list, tuple)
+            ):
+                iterator = list(iterator)
+            self.pretrain(iterator)
+        if not self.conf.backprop:
+            return
         for epoch in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):
@@ -349,6 +368,109 @@ class MultiLayerNetwork:
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
         return score  # 0-d device array; float() to sync
+
+    # -- layer-wise pretraining (reference pretrain(iter) -> :166) ------
+
+    def _input_to_layer_pure(self, params, state, x, idx):
+        """Input tensor as seen by layer ``idx`` — forward through
+        layers [0, idx) including idx's own preprocessor."""
+        ctx = self._ctx_for(x)
+        for i in range(idx):
+            if i in self.conf.preprocessors:
+                x = self.conf.preprocessors[i].preprocess(x, ctx)
+            x, _ = self.conf.layers[i].apply(
+                params[self.layer_names[i]], x,
+                state.get(self.layer_names[i], {}), train=False, rng=None,
+            )
+        if idx in self.conf.preprocessors:
+            x = self.conf.preprocessors[idx].preprocess(x, ctx)
+        return x
+
+    def _build_pretrain_step(self, idx: int, upd_def) -> Callable:
+        """Jitted single-layer update; takes the layer's input tensor
+        precomputed (the frozen lower stack runs once per batch, not
+        once per optimizer iteration — reference feedForwardToLayer
+        once per batch)."""
+        name = self.layer_names[idx]
+        layer = self.conf.layers[idx]
+
+        def step(lparams, upd_state, xin, lrs, t, rng):
+            def loss_fn(p):
+                return layer.pretrain_loss(p, xin, rng) + _reg_penalty(
+                    layer, p
+                )
+
+            loss, grads = jax.value_and_grad(loss_fn)(lparams)
+            new_p, new_upd = upd_def.update(
+                {name: grads}, upd_state, {name: lparams}, lrs, t
+            )
+            return new_p[name], new_upd, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def pretrain(self, data, epochs: int = 1) -> None:
+        """Greedy layer-wise unsupervised pretraining: fit each
+        pretrainable layer (VAE/RBM/AutoEncoder) on the activations of
+        the stack below it (reference ``pretrain(DataSetIterator)`` →
+        per-layer fit at ``MultiLayerNetwork.java:166``)."""
+        from deeplearning4j_tpu.datasets.api import DataSet
+        from deeplearning4j_tpu.nn.updaters import MultiLayerUpdaterDef
+
+        if self.params is None:
+            self.init()
+        if hasattr(data, "features"):
+            data = [data]
+        elif isinstance(data, tuple) and len(data) == 2:
+            data = [DataSet(features=data[0], labels=data[1])]
+        elif not isinstance(data, (list, tuple)) and not hasattr(
+            data, "reset"
+        ):
+            # one-shot generator: materialize so every layer/epoch sees
+            # the full stream (multiple passes are required)
+            data = list(data)
+        dtype = _dtype_of(self.conf)
+        jit_input = jax.jit(
+            self._input_to_layer_pure, static_argnames=("idx",)
+        )
+        for idx, (name, layer) in enumerate(
+            zip(self.layer_names, self.conf.layers)
+        ):
+            if not layer.is_pretrainable():
+                continue
+            upd_def = MultiLayerUpdaterDef({name: layer.updater_settings()})
+            upd_state = upd_def.init({name: self.params[name]})
+            if idx not in self._jit_pretrain_steps:
+                self._jit_pretrain_steps[idx] = self._build_pretrain_step(
+                    idx, upd_def
+                )
+            step = self._jit_pretrain_steps[idx]
+            it = 0
+            for _ in range(epochs):
+                for ds in data:
+                    x = jnp.asarray(
+                        ds.features if hasattr(ds, "features") else ds, dtype
+                    )
+                    xin = jit_input(self.params, self.state, x, idx=idx)
+                    for _ in range(self.conf.iterations):
+                        lrs = {
+                            k: jnp.asarray(v, jnp.float32)
+                            for k, v in upd_def.scheduled_lrs(it).items()
+                        }
+                        t = jnp.asarray(it + 1, jnp.float32)
+                        rng = jax.random.fold_in(
+                            jax.random.fold_in(self._base_key, 7919 + idx), it
+                        )
+                        # reassign atomically: argnum 0 is donated
+                        (
+                            self.params[name], upd_state, loss,
+                        ) = step(
+                            self.params[name], upd_state, xin, lrs, t, rng
+                        )
+                        self._last_score = loss
+                        it += 1
+                if hasattr(data, "reset"):
+                    data.reset()
+        self._pretrain_done = True
 
     # -- inference -----------------------------------------------------
 

@@ -1,0 +1,305 @@
+"""Pretrain/generative stack tests (reference analogs:
+``VaeGradientCheckTests``, RBM/AutoEncoder tests in
+deeplearning4j-core, pretrain path of ``MultiLayerTest``)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    RBM,
+    AutoEncoder,
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    DenseLayer,
+    ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution,
+    LossFunctionWrapper,
+    OutputLayer,
+    VariationalAutoencoder,
+    layer_from_json,
+    layer_to_json,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _batch(rng, n=16, d=8, binary=True):
+    x = rng.rand(n, d)
+    if binary:
+        x = (x > 0.5).astype(np.float64)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# VAE
+# ---------------------------------------------------------------------------
+
+
+DISTRIBUTIONS = [
+    BernoulliReconstructionDistribution(),
+    GaussianReconstructionDistribution(),
+    ExponentialReconstructionDistribution(),
+    LossFunctionWrapper(loss="MSE", activation="sigmoid"),
+]
+
+
+@pytest.mark.parametrize("dist", DISTRIBUTIONS, ids=lambda d: type(d).__name__)
+def test_vae_pretrain_gradient_check(rng, dist):
+    """Numerical central-difference check of the ELBO gradient
+    (reference VaeGradientCheckTests; eps=1e-6 double precision)."""
+    vae = VariationalAutoencoder(
+        n_in=5, n_out=3,
+        encoder_layer_sizes=(7,), decoder_layer_sizes=(6,),
+        activation="tanh",
+        reconstruction_distribution=dist,
+        num_samples=1,
+    )
+    with jax.enable_x64(True):
+        params = vae.init_params(jax.random.PRNGKey(0), jnp.float64)
+        x = jnp.asarray(_batch(rng, n=6, d=5, binary=True), jnp.float64)
+        key = jax.random.PRNGKey(42)
+
+        loss_fn = lambda p: vae.pretrain_loss(p, x, key)
+        grads = jax.grad(loss_fn)(params)
+        eps = 1e-6
+        for pn in ("eW0", "pZXMeanW", "pZXLogStd2b", "dW0", "pXZb"):
+            p = params[pn]
+            flat = np.asarray(p).ravel()
+            g = np.asarray(grads[pn]).ravel()
+            for i in range(0, flat.size, max(1, flat.size // 5)):
+                for sgn, store in ((1, "plus"), (-1, "minus")):
+                    pert = flat.copy()
+                    pert[i] += sgn * eps
+                    pp = dict(params)
+                    pp[pn] = jnp.asarray(pert.reshape(p.shape))
+                    if sgn == 1:
+                        fplus = float(loss_fn(pp))
+                    else:
+                        fminus = float(loss_fn(pp))
+                num = (fplus - fminus) / (2 * eps)
+                denom = max(abs(num), abs(g[i]), 1e-8)
+                rel = abs(num - g[i]) / denom
+                assert rel < 1e-3, (
+                    f"{type(dist).__name__} {pn}[{i}]: numeric {num} "
+                    f"vs autodiff {g[i]} (rel {rel})"
+                )
+
+
+def test_vae_composite_distribution(rng):
+    dist = CompositeReconstructionDistribution(components=(
+        (4, BernoulliReconstructionDistribution()),
+        (4, GaussianReconstructionDistribution()),
+    ))
+    assert dist.param_size(8) == 4 + 8
+    vae = VariationalAutoencoder(
+        n_in=8, n_out=2, reconstruction_distribution=dist,
+        activation="tanh",
+    )
+    params = vae.init_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(_batch(rng, n=4, d=8), jnp.float32)
+    loss = vae.pretrain_loss(params, x, jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
+    # generation round-trips shapes
+    z = jnp.zeros((3, 2))
+    out = vae.generate_at_mean_given_z(params, z)
+    assert out.shape == (3, 8)
+    out = vae.generate_random_given_z(params, z, jax.random.PRNGKey(2))
+    assert out.shape == (3, 8)
+
+
+def test_vae_json_roundtrip():
+    for dist in DISTRIBUTIONS + [
+        CompositeReconstructionDistribution(components=(
+            (2, BernoulliReconstructionDistribution()),
+            (3, GaussianReconstructionDistribution()),
+        ))
+    ]:
+        vae = VariationalAutoencoder(
+            n_in=5, n_out=3, encoder_layer_sizes=(9, 8),
+            decoder_layer_sizes=(7,), reconstruction_distribution=dist,
+            num_samples=2, pzx_activation="tanh",
+        )
+        back = layer_from_json(layer_to_json(vae))
+        assert back == vae
+
+
+def test_vae_training_reduces_elbo(rng):
+    vae = VariationalAutoencoder(
+        n_in=12, n_out=3, encoder_layer_sizes=(16,),
+        decoder_layer_sizes=(16,), activation="tanh",
+        learning_rate=0.05, updater="ADAM",
+    )
+    conf = (
+        NeuralNetConfiguration.Builder().seed(7)
+        .list()
+        .layer(vae)
+        .pretrain(True).backprop(False)
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = _batch(rng, n=64, d=12).astype(np.float32)
+    key = jax.random.PRNGKey(5)
+    p0 = net.params["0"]
+    before = float(net.conf.layers[0].pretrain_loss(p0, x, key))
+    net.pretrain(DataSet(features=x, labels=x), epochs=60)
+    after = float(
+        net.conf.layers[0].pretrain_loss(net.params["0"], x, key)
+    )
+    assert after < before, (before, after)
+
+
+def test_vae_in_supervised_net_runs(rng):
+    """VAE as a hidden layer: supervised forward uses posterior mean."""
+    conf = (
+        NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+        .list()
+        .layer(VariationalAutoencoder(
+            n_in=8, n_out=4, encoder_layer_sizes=(10,),
+            decoder_layer_sizes=(10,), activation="tanh"))
+        .layer(OutputLayer(n_out=3, loss="MCXENT"))
+        .pretrain(True)
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = _batch(rng, n=32, d=8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    net.fit(DataSet(features=x, labels=y), epochs=3)
+    assert net._pretrain_done
+    out = net.output(x)
+    assert out.shape == (32, 3)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# AutoEncoder
+# ---------------------------------------------------------------------------
+
+
+def test_autoencoder_gradient_check(rng):
+    ae = AutoEncoder(n_in=6, n_out=4, corruption_level=0.0, loss="MSE",
+                     activation="sigmoid")
+    with jax.enable_x64(True):
+        params = ae.init_params(jax.random.PRNGKey(0), jnp.float64)
+        x = jnp.asarray(_batch(rng, n=5, d=6), jnp.float64)
+        loss_fn = lambda p: ae.pretrain_loss(p, x, None)
+        grads = jax.grad(loss_fn)(params)
+        eps = 1e-6
+        for pn in ("W", "b", "vb"):
+            p = params[pn]
+            flat = np.asarray(p).ravel()
+            g = np.asarray(grads[pn]).ravel()
+            for i in range(0, flat.size, max(1, flat.size // 6)):
+                pert = flat.copy(); pert[i] += eps
+                pp = dict(params); pp[pn] = jnp.asarray(pert.reshape(p.shape))
+                fp = float(loss_fn(pp))
+                pert = flat.copy(); pert[i] -= eps
+                pp = dict(params); pp[pn] = jnp.asarray(pert.reshape(p.shape))
+                fm = float(loss_fn(pp))
+                num = (fp - fm) / (2 * eps)
+                rel = abs(num - g[i]) / max(abs(num), abs(g[i]), 1e-8)
+                assert rel < 1e-3, f"{pn}[{i}]: {num} vs {g[i]}"
+
+
+def test_autoencoder_denoising_learns(rng):
+    ae = AutoEncoder(n_in=10, n_out=6, corruption_level=0.3, loss="XENT",
+                     activation="sigmoid", learning_rate=0.5)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(11)
+        .list().layer(ae).pretrain(True).backprop(False).build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = _batch(rng, n=64, d=10).astype(np.float32)
+    p0 = net.params["0"]
+    before = float(ae.pretrain_loss(p0, jnp.asarray(x), None))
+    net.pretrain(DataSet(features=x, labels=x), epochs=80)
+    after = float(ae.pretrain_loss(net.params["0"], jnp.asarray(x), None))
+    assert after < before
+
+
+# ---------------------------------------------------------------------------
+# RBM
+# ---------------------------------------------------------------------------
+
+
+def test_rbm_cd_reduces_reconstruction_error(rng):
+    rbm = RBM(n_in=12, n_out=8, k=1, learning_rate=0.1,
+              activation="sigmoid")
+    conf = (
+        NeuralNetConfiguration.Builder().seed(13)
+        .list().layer(rbm).pretrain(True).backprop(False).build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    # bars: two repeating binary patterns — easy structure for an RBM
+    base = np.zeros((64, 12), np.float32)
+    base[::2, :6] = 1.0
+    base[1::2, 6:] = 1.0
+    flips = rng.rand(64, 12) < 0.05
+    x = np.abs(base - flips.astype(np.float32))
+    before = float(net.conf.layers[0].reconstruction_error(
+        net.params["0"], jnp.asarray(x)))
+    net.pretrain(DataSet(features=x, labels=x), epochs=100)
+    after = float(net.conf.layers[0].reconstruction_error(
+        net.params["0"], jnp.asarray(x)))
+    assert after < before, (before, after)
+
+
+def test_rbm_gaussian_visible_runs(rng):
+    rbm = RBM(n_in=5, n_out=4, visible_unit="GAUSSIAN",
+              hidden_unit="BINARY", k=2, activation="sigmoid")
+    params = rbm.init_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(rng.randn(8, 5), jnp.float32)
+    loss = rbm.pretrain_loss(params, x, jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
+    g = jax.grad(lambda p: rbm.pretrain_loss(p, x, jax.random.PRNGKey(1)))(
+        params
+    )
+    assert all(np.all(np.isfinite(np.asarray(v))) for v in g.values())
+
+
+def test_rbm_rejects_unsupported_units():
+    with pytest.raises(ValueError):
+        RBM(n_in=4, n_out=4, visible_unit="SOFTMAX").init_params(
+            jax.random.PRNGKey(0)
+        )
+
+
+def test_rbm_propup_forward(rng):
+    rbm = RBM(n_in=4, n_out=3, activation="sigmoid")
+    params = rbm.init_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(_batch(rng, n=6, d=4), jnp.float32)
+    out, _ = rbm.apply(params, x, {})
+    assert out.shape == (6, 3)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) <= 1))
+
+
+# ---------------------------------------------------------------------------
+# Stacked pretraining (deep-belief-style layerwise loop)
+# ---------------------------------------------------------------------------
+
+
+def test_stacked_pretrain_then_finetune(rng):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(5).learning_rate(0.1)
+        .list()
+        .layer(AutoEncoder(n_in=10, n_out=8, corruption_level=0.2,
+                           activation="sigmoid"))
+        .layer(AutoEncoder(n_out=6, corruption_level=0.2,
+                           activation="sigmoid"))
+        .layer(OutputLayer(n_out=2, loss="MCXENT"))
+        .pretrain(True)
+        .build()
+    )
+    # nIn of layer 1 inferred from layer 0 nOut
+    assert conf.layers[1].n_in == 8
+    net = MultiLayerNetwork(conf).init()
+    x = _batch(rng, n=48, d=10).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 48)]
+    net.fit(DataSet(features=x, labels=y), epochs=5)
+    assert net._pretrain_done
+    preds = net.predict(x)
+    assert preds.shape == (48,)
