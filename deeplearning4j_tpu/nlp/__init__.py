@@ -1,0 +1,40 @@
+"""NLP/embeddings subsystem (reference: ``deeplearning4j-nlp-parent``,
+SURVEY.md §2.6): tokenization pipeline, vocabulary construction,
+Word2Vec / GloVe / ParagraphVectors on batched XLA ops.
+
+Architectural divergence from the reference (documented, deliberate):
+the reference trains embeddings hogwild — N threads racing on shared
+syn0/syn1 (``SequenceVectors.java:935,:1029``). On TPU the idiomatic
+equivalent is large-batch synchronous updates: the host pipeline packs
+(center, context, negatives) into fixed-shape batches and a single
+jitted XLA program applies the fused gather → dot → sigmoid →
+scatter-add update. Parity with the reference is therefore
+statistical (similarity-task scores), not bitwise (SURVEY.md §7 hard
+part 3).
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    CollectionSentenceIterator,
+    DefaultTokenizerFactory,
+    FileSentenceIterator,
+    LineSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import (  # noqa: F401
+    Huffman,
+    VocabCache,
+    VocabConstructor,
+    VocabWord,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
+from deeplearning4j_tpu.nlp.paragraph_vectors import (  # noqa: F401
+    ParagraphVectors,
+)
+from deeplearning4j_tpu.nlp.serializer import (  # noqa: F401
+    load_binary,
+    load_txt,
+    read_word_vectors,
+    write_binary,
+    write_txt,
+    write_word_vectors,
+)

@@ -1,0 +1,229 @@
+"""GloVe embeddings (reference: ``models/glove/Glove.java`` +
+``models/glove/AbstractCoOccurrences.java`` — co-occurrence counting
+host-side, then weighted-least-squares with per-parameter AdaGrad).
+
+TPU-first: co-occurrence triples (i, j, X_ij) are shuffled and packed
+into fixed-shape batches; one jitted step computes
+f(X)·(wᵢ·w̃ⱼ + bᵢ + b̃ⱼ − log X)² for the whole batch and applies
+AdaGrad via gather/scatter — replacing the reference's per-pair
+threaded updates.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _glove_step(state, rows, cols, logx, fx, mask, lr):
+    """One AdaGrad batch. state = (W, Wc, b, bc, hW, hWc, hb, hbc)."""
+    W, Wc, b, bc, hW, hWc, hb, hbc = state
+
+    def loss_fn(p):
+        W_, Wc_, b_, bc_ = p
+        wi = W_[rows]
+        wj = Wc_[cols]
+        diff = jnp.sum(wi * wj, axis=-1) + b_[rows] + bc_[cols] - logx
+        return jnp.sum(mask * fx * diff * diff), diff
+
+    (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (W, Wc, b, bc)
+    )
+    gW, gWc, gb, gbc = grads
+    hW = hW + gW * gW
+    hWc = hWc + gWc * gWc
+    hb = hb + gb * gb
+    hbc = hbc + gbc * gbc
+    eps = 1e-8
+    W = W - lr * gW / jnp.sqrt(hW + eps)
+    Wc = Wc - lr * gWc / jnp.sqrt(hWc + eps)
+    b = b - lr * gb / jnp.sqrt(hb + eps)
+    bc = bc - lr * gbc / jnp.sqrt(hbc + eps)
+    return (W, Wc, b, bc, hW, hWc, hb, hbc), loss
+
+
+class CoOccurrences:
+    """Symmetric windowed co-occurrence counts with 1/distance
+    weighting (reference ``AbstractCoOccurrences``)."""
+
+    def __init__(self, cache: VocabCache, window: int = 5,
+                 symmetric: bool = True):
+        self.cache = cache
+        self.window = window
+        self.symmetric = symmetric
+        self._counts: dict = defaultdict(float)
+
+    def fit(self, id_sequences: Iterable[np.ndarray]) -> None:
+        w = self.window
+        for ids in id_sequences:
+            n = len(ids)
+            for i in range(n):
+                for off in range(1, w + 1):
+                    j = i + off
+                    if j >= n:
+                        break
+                    a, b = int(ids[i]), int(ids[j])
+                    self._counts[(a, b)] += 1.0 / off
+                    if self.symmetric:
+                        self._counts[(b, a)] += 1.0 / off
+
+    def triples(self):
+        n = len(self._counts)
+        rows = np.empty(n, np.int32)
+        cols = np.empty(n, np.int32)
+        vals = np.empty(n, np.float32)
+        for k, ((i, j), x) in enumerate(self._counts.items()):
+            rows[k] = i
+            cols[k] = j
+            vals[k] = x
+        return rows, cols, vals
+
+
+class Glove:
+    """GloVe trainer (reference ``Glove.java`` builder API)."""
+
+    def __init__(self, cache: VocabCache, id_sequences: List[np.ndarray], *,
+                 layer_size=100, window=5, learning_rate=0.05,
+                 x_max=100.0, alpha=0.75, epochs=25, batch_size=1024,
+                 seed=12345, symmetric=True):
+        self.cache = cache
+        self.layer_size = layer_size
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.co = CoOccurrences(cache, window=window, symmetric=symmetric)
+        self.co.fit(id_sequences)
+        v = len(cache)
+        rng = np.random.RandomState(seed)
+        init = lambda *s: jnp.asarray(
+            (rng.rand(*s) - 0.5) / layer_size, jnp.float32
+        )
+        self._state = (
+            init(v, layer_size), init(v, layer_size), init(v), init(v),
+            jnp.zeros((v, layer_size), jnp.float32),
+            jnp.zeros((v, layer_size), jnp.float32),
+            jnp.zeros(v, jnp.float32), jnp.zeros(v, jnp.float32),
+        )
+        self.syn0: Optional[np.ndarray] = None
+        self._normalized: Optional[np.ndarray] = None
+        self.last_loss = float("nan")
+
+    def fit(self) -> "Glove":
+        rows, cols, vals = self.co.triples()
+        if len(rows) == 0:
+            raise ValueError("Empty co-occurrence matrix")
+        logx = np.log(vals)
+        fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(
+            np.float32
+        )
+        B = self.batch_size
+        rng = np.random.RandomState(self.seed)
+        lr = jnp.float32(self.learning_rate)
+        for _ in range(self.epochs):
+            perm = rng.permutation(len(rows))
+            epoch_losses = []
+            for s in range(0, len(rows), B):
+                sl = perm[s:s + B]
+                mask = np.ones(B, np.float32)
+                rb, cb = rows[sl], cols[sl]
+                lb, fb = logx[sl], fx[sl]
+                if len(sl) < B:
+                    pad = B - len(sl)
+                    mask[len(sl):] = 0.0
+                    rb = np.pad(rb, (0, pad))
+                    cb = np.pad(cb, (0, pad))
+                    lb = np.pad(lb, (0, pad))
+                    fb = np.pad(fb, (0, pad))
+                self._state, loss = _glove_step(
+                    self._state,
+                    jnp.asarray(rb), jnp.asarray(cb),
+                    jnp.asarray(lb), jnp.asarray(fb),
+                    jnp.asarray(mask), lr,
+                )
+                epoch_losses.append(loss)  # device scalar; no sync
+            self.last_loss = float(
+                jnp.sum(jnp.stack(epoch_losses))
+            ) / max(len(rows), 1)
+        # final vectors: W + Wc (standard GloVe practice)
+        self.syn0 = np.asarray(self._state[0]) + np.asarray(self._state[1])
+        self._normalized = None
+        return self
+
+    # -- query (same surface as SequenceVectors) ----------------------------
+
+    def _norm(self) -> np.ndarray:
+        if self.syn0 is None:
+            raise ValueError("Call fit() first")
+        if self._normalized is None:
+            n = np.linalg.norm(self.syn0, axis=1, keepdims=True)
+            self._normalized = self.syn0 / np.maximum(n, 1e-12)
+        return self._normalized
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.cache.index_of(word)
+        return None if i < 0 else self.syn0[i]
+
+    def similarity(self, a: str, b: str) -> float:
+        ia, ib = self.cache.index_of(a), self.cache.index_of(b)
+        if ia < 0 or ib < 0:
+            return float("nan")
+        m = self._norm()
+        return float(m[ia] @ m[ib])
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        i = self.cache.index_of(word)
+        if i < 0:
+            return []
+        m = self._norm()
+        sims = m @ m[i]
+        sims[i] = -np.inf
+        return [
+            self.cache.word_at(int(t)) for t in np.argsort(-sims)[:n]
+        ]
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._min_word_frequency = 1
+            self._iterator = None
+            self._tokenizer = None
+
+        def min_word_frequency(self, n):
+            self._min_word_frequency = n; return self
+
+        def layer_size(self, n): self._kw["layer_size"] = n; return self
+        def window_size(self, n): self._kw["window"] = n; return self
+        def learning_rate(self, x): self._kw["learning_rate"] = x; return self
+        def x_max(self, x): self._kw["x_max"] = x; return self
+        def alpha(self, x): self._kw["alpha"] = x; return self
+        def epochs(self, n): self._kw["epochs"] = n; return self
+        def batch_size(self, n): self._kw["batch_size"] = n; return self
+        def seed(self, n): self._kw["seed"] = n; return self
+        def symmetric(self, b): self._kw["symmetric"] = b; return self
+        def iterate(self, it): self._iterator = it; return self
+        def tokenizer_factory(self, tf): self._tokenizer = tf; return self
+
+        def build(self) -> "Glove":
+            if self._iterator is None:
+                raise ValueError("iterate(sentence_iterator) is required")
+            tf = self._tokenizer or DefaultTokenizerFactory()
+            sentences = [tf.create(s).get_tokens() for s in self._iterator]
+            cache = VocabConstructor(
+                min_word_frequency=self._min_word_frequency
+            ).build_vocab_from_tokens(sentences)
+            ids = [
+                np.asarray(cache.id_stream(t), np.int64) for t in sentences
+            ]
+            return Glove(cache, ids, **self._kw)

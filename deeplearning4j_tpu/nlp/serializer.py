@@ -1,0 +1,107 @@
+"""Word-vector serialization (reference:
+``models/embeddings/loader/WordVectorSerializer.java``, 2,603 LoC —
+txt, Google word2vec binary, and zip formats).
+
+Formats:
+- txt: first line "V D", then one "word v1 v2 ..." per line
+  (Google text format; reference ``writeWordVectors``/``loadTxt``).
+- binary: header "V D\\n", then per word: name + 0x20 + D float32 LE
+  (Google ``word2vec`` C binary; reference ``loadGoogleModel``).
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+
+
+def _resolve(model) -> Tuple[VocabCache, np.ndarray]:
+    """Accept a SequenceVectors/Word2Vec/Glove or (cache, matrix)."""
+    if isinstance(model, tuple):
+        return model
+    cache = model.cache
+    if hasattr(model, "lookup"):
+        matrix = np.asarray(model.lookup.syn0)
+    else:
+        matrix = np.asarray(model.syn0)
+    return cache, matrix
+
+
+def write_txt(model, path) -> None:
+    cache, m = _resolve(model)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(f"{m.shape[0]} {m.shape[1]}\n")
+        for i in range(m.shape[0]):
+            vals = " ".join(repr(float(x)) for x in m[i])
+            f.write(f"{cache.word_at(i)} {vals}\n")
+
+
+def load_txt(path) -> Tuple[VocabCache, np.ndarray]:
+    with open(path, "r", encoding="utf-8") as f:
+        header = f.readline().split()
+        v, d = int(header[0]), int(header[1])
+        cache = VocabCache()
+        m = np.zeros((v, d), np.float32)
+        for i in range(v):
+            # rsplit from the right: the word itself may contain
+            # spaces (n-gram vocab entries)
+            parts = f.readline().rstrip("\n").rsplit(" ", d)
+            cache.add(VocabWord(parts[0]))
+            m[i] = [float(x) for x in parts[1:d + 1]]
+    return cache, m
+
+
+def write_binary(model, path) -> None:
+    """Google word2vec C binary format. Words containing spaces are
+    written with '_' in their place (the word2vec phrases convention —
+    the space is the field terminator in this format)."""
+    cache, m = _resolve(model)
+    with open(path, "wb") as f:
+        f.write(f"{m.shape[0]} {m.shape[1]}\n".encode())
+        for i in range(m.shape[0]):
+            word = cache.word_at(i).replace(" ", "_")
+            f.write(word.encode("utf-8") + b" ")
+            f.write(m[i].astype("<f4").tobytes())
+            f.write(b"\n")
+
+
+def load_binary(path) -> Tuple[VocabCache, np.ndarray]:
+    with open(path, "rb") as f:
+        header = f.readline().split()
+        v, d = int(header[0]), int(header[1])
+        cache = VocabCache()
+        m = np.zeros((v, d), np.float32)
+        for i in range(v):
+            word = bytearray()
+            while True:
+                ch = f.read(1)
+                if ch in (b" ", b""):
+                    break
+                word.extend(ch)
+            cache.add(VocabWord(word.decode("utf-8")))
+            m[i] = np.frombuffer(f.read(4 * d), "<f4")
+            nl = f.read(1)
+            if nl not in (b"\n", b""):
+                # older files omit the newline; step back
+                f.seek(-1, 1)
+    return cache, m
+
+
+def write_word_vectors(model, path) -> None:
+    """Dispatch on extension (.bin → binary, else txt) — reference
+    ``writeWordVectors`` overloads."""
+    if str(path).endswith(".bin"):
+        write_binary(model, path)
+    else:
+        write_txt(model, path)
+
+
+def read_word_vectors(path) -> Tuple[VocabCache, np.ndarray]:
+    if str(path).endswith(".bin"):
+        return load_binary(path)
+    return load_txt(path)

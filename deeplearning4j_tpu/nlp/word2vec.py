@@ -1,0 +1,514 @@
+"""Word2Vec / SequenceVectors on batched XLA ops (reference:
+``models/sequencevectors/SequenceVectors.java:161`` fit,
+``models/word2vec/Word2Vec.java:31``, learning algorithms
+``models/embeddings/learning/impl/elements/SkipGram.java:31`` /
+``CBOW.java``, lookup table
+``models/embeddings/inmemory/InMemoryLookupTable.java:55``).
+
+TPU-first redesign of the hogwild trainer: the reference races N
+threads over shared syn0/syn1 with per-pair axpy updates through the
+native ``AggregateSkipGram`` op. Here the host packs fixed-shape
+batches of (center, context, negatives | huffman path) int32 arrays
+and ONE jitted XLA program does gather → dot → sigmoid → scatter-add
+for the whole batch — the TPU-shaped equivalent of the fused native
+aggregate. Updates within a batch are AVERAGED (synchronous
+large-batch SGD; ``learning_rate`` is the batch-level step, default
+0.5) rather than racing per pair; parity is statistical (SURVEY.md §7
+hard part 3).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import (
+    Huffman,
+    VocabCache,
+    VocabConstructor,
+    build_unigram_table,
+    subsample_mask,
+)
+
+# ---------------------------------------------------------------------------
+# Jitted update steps. Static over (B, K|L, D); shapes are pinned by
+# the host batcher so each variant compiles once.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _ns_step(syn0, syn1neg, centers, contexts, negs, mask, alpha):
+    """Negative-sampling step (SkipGram: centers=input word ids,
+    contexts=predicted word ids; CBOW passes precomputed context means
+    through ``_ns_step_cbow`` instead)."""
+    def loss_fn(tables):
+        s0, s1 = tables
+        v = s0[centers]                      # [B, D]
+        u_pos = s1[contexts]                 # [B, D]
+        u_neg = s1[negs]                     # [B, K, D]
+        pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, axis=-1))
+        # a drawn negative equal to the true context is masked out (the
+        # reference resamples on collision; masking is the static-shape
+        # equivalent)
+        nvalid = (negs != contexts[:, None]).astype(v.dtype)
+        neg = jnp.sum(
+            nvalid
+            * jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", v, u_neg)),
+            axis=-1,
+        )
+        return -jnp.sum(mask * (pos + neg)) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn)((syn0, syn1neg))
+    return syn0 - alpha * g0, syn1neg - alpha * g1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0, syn1, centers, codes, points, path_mask, mask, alpha):
+    """Hierarchical-softmax step: codes/points are the context word's
+    padded Huffman path ([B, L]); loss per node is
+    -log σ((1-2·code)·(v_center · syn1[point]))."""
+    def loss_fn(tables):
+        s0, s1 = tables
+        v = s0[centers]                      # [B, D]
+        u = s1[points]                       # [B, L, D]
+        x = jnp.einsum("bd,bld->bl", v, u)
+        sign = 1.0 - 2.0 * codes
+        ll = jax.nn.log_sigmoid(sign * x) * path_mask
+        return -jnp.sum(mask * jnp.sum(ll, axis=-1)) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn)((syn0, syn1))
+    return syn0 - alpha * g0, syn1 - alpha * g1, loss
+
+
+def _cbow_hidden(s0, ctx_ids, ctx_mask):
+    ctx = s0[ctx_ids]                        # [B, W, D]
+    denom = jnp.maximum(jnp.sum(ctx_mask, axis=-1, keepdims=True), 1.0)
+    return jnp.sum(ctx * ctx_mask[..., None], axis=1) / denom  # [B, D]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_ns_step(syn0, syn1neg, ctx_ids, ctx_mask, targets, negs, mask,
+                  alpha):
+    """CBOW + negative sampling: mean of context vectors predicts the
+    center word (reference ``CBOW.java`` iterateSample)."""
+    def loss_fn(tables):
+        s0, s1 = tables
+        h = _cbow_hidden(s0, ctx_ids, ctx_mask)
+        u_pos = s1[targets]
+        u_neg = s1[negs]
+        pos = jax.nn.log_sigmoid(jnp.sum(h * u_pos, axis=-1))
+        nvalid = (negs != targets[:, None]).astype(h.dtype)
+        neg = jnp.sum(
+            nvalid
+            * jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", h, u_neg)),
+            axis=-1,
+        )
+        return -jnp.sum(mask * (pos + neg)) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn)((syn0, syn1neg))
+    return syn0 - alpha * g0, syn1neg - alpha * g1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_hs_step(syn0, syn1, ctx_ids, ctx_mask, codes, points, path_mask,
+                  mask, alpha):
+    """CBOW + hierarchical softmax: context mean against the TARGET
+    word's Huffman path."""
+    def loss_fn(tables):
+        s0, s1 = tables
+        h = _cbow_hidden(s0, ctx_ids, ctx_mask)
+        u = s1[points]                       # [B, L, D]
+        x = jnp.einsum("bd,bld->bl", h, u)
+        sign = 1.0 - 2.0 * codes
+        ll = jax.nn.log_sigmoid(sign * x) * path_mask
+        return -jnp.sum(mask * jnp.sum(ll, axis=-1)) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn)((syn0, syn1))
+    return syn0 - alpha * g0, syn1 - alpha * g1, loss
+
+
+# ---------------------------------------------------------------------------
+# Lookup table
+# ---------------------------------------------------------------------------
+
+
+class InMemoryLookupTable:
+    """syn0/syn1/syn1neg embedding matrices (reference
+    ``InMemoryLookupTable.java:55``); syn0 rows are the word vectors."""
+
+    def __init__(self, cache: VocabCache, layer_size: int, seed: int = 12345,
+                 use_hs: bool = False, negative: int = 5):
+        self.cache = cache
+        self.layer_size = layer_size
+        self.use_hs = use_hs
+        self.negative = negative
+        v = len(cache)
+        rng = np.random.RandomState(seed)
+        # reference resetWeights: syn0 ~ U(-0.5, 0.5)/layerSize
+        self.syn0 = jnp.asarray(
+            (rng.rand(v, layer_size) - 0.5) / layer_size, jnp.float32
+        )
+        self.syn1 = (
+            jnp.zeros((v, layer_size), jnp.float32) if use_hs else None
+        )
+        self.syn1neg = (
+            jnp.zeros((v, layer_size), jnp.float32) if negative > 0 else None
+        )
+        self._normalized: Optional[np.ndarray] = None
+
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.cache.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def invalidate_norms(self):
+        self._normalized = None
+
+    def normalized(self) -> np.ndarray:
+        if self._normalized is None:
+            m = np.asarray(self.syn0)
+            norms = np.linalg.norm(m, axis=1, keepdims=True)
+            self._normalized = m / np.maximum(norms, 1e-12)
+        return self._normalized
+
+
+# ---------------------------------------------------------------------------
+# SequenceVectors: generic trainer over id sequences
+# ---------------------------------------------------------------------------
+
+
+class SequenceVectors:
+    """Generic embedding trainer over integer id sequences (reference
+    ``SequenceVectors<T>`` — DeepWalk and ParagraphVectors reuse it).
+
+    Subclasses/owners supply: a built ``VocabCache`` and an iterable of
+    id sequences per epoch (``_sequences()``).
+    """
+
+    def __init__(self, cache: VocabCache, *, layer_size=100, window=5,
+                 learning_rate=0.5, min_learning_rate=1e-4, negative=5,
+                 use_hierarchic_softmax=False, sample=1e-3, epochs=1,
+                 iterations=1, batch_size=1024, seed=12345,
+                 algorithm="SkipGram"):
+        if negative <= 0 and not use_hierarchic_softmax:
+            raise ValueError(
+                "Need negative sampling (negative>0) or hierarchical "
+                "softmax (use_hierarchic_softmax=True)"
+            )
+        self.cache = cache
+        self.layer_size = layer_size
+        self.window = window
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax
+        self.sample = sample
+        self.epochs = epochs
+        self.iterations = iterations
+        self.batch_size = batch_size
+        self.seed = seed
+        self.algorithm = algorithm
+        self.lookup = InMemoryLookupTable(
+            cache, layer_size, seed=seed, use_hs=use_hierarchic_softmax,
+            negative=negative,
+        )
+        self._rng = np.random.RandomState(seed)
+        if use_hierarchic_softmax:
+            huff = Huffman(cache.words)
+            huff.build()
+            self._codes, self._points, self._code_lens = huff.padded_arrays()
+        if negative > 0:
+            self._table = build_unigram_table(cache)
+        self._counts = np.array([w.count for w in cache.words], np.int64)
+
+    # -- corpus plumbing ----------------------------------------------------
+
+    def _sequences(self) -> Iterable[np.ndarray]:
+        raise NotImplementedError
+
+    def _gen_pairs(self, epoch_seed: int):
+        """(centers, contexts) int32 arrays for one epoch: reduced
+        window sampling + frequent-word subsampling (reference
+        SkipGram.learnSequence)."""
+        rng = np.random.RandomState(epoch_seed)
+        centers: List[np.ndarray] = []
+        contexts: List[np.ndarray] = []
+        total = self.cache.total_word_count
+        for ids in self._sequences():
+            ids = np.asarray(ids, np.int64)
+            if self.sample > 0:
+                keep = subsample_mask(
+                    ids, self._counts, total, self.sample, rng
+                )
+                ids = ids[keep]
+            n = len(ids)
+            if n < 2:
+                continue
+            # vectorized reduced-window pair generation
+            b = rng.randint(1, self.window + 1, n)
+            for off in range(1, self.window + 1):
+                sel = b >= off
+                idx = np.nonzero(sel)[0]
+                left = idx[idx >= off]
+                centers.append(ids[left]); contexts.append(ids[left - off])
+                right = idx[idx < n - off]
+                centers.append(ids[right]); contexts.append(ids[right + off])
+        if not centers:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        c = np.concatenate(centers).astype(np.int32)
+        o = np.concatenate(contexts).astype(np.int32)
+        perm = rng.permutation(len(c))
+        return c[perm], o[perm]
+
+    def _gen_cbow(self, epoch_seed: int):
+        """(targets[N], ctx_ids[N, 2W], ctx_mask[N, 2W]) for one epoch
+        (true windowed CBOW: all context words within the reduced
+        window feed one averaged prediction)."""
+        rng = np.random.RandomState(epoch_seed)
+        W = self.window
+        t_list, c_list, m_list = [], [], []
+        total = self.cache.total_word_count
+        offsets = [o for o in range(-W, W + 1) if o != 0]
+        for ids in self._sequences():
+            ids = np.asarray(ids, np.int64)
+            if self.sample > 0:
+                keep = subsample_mask(
+                    ids, self._counts, total, self.sample, rng
+                )
+                ids = ids[keep]
+            n = len(ids)
+            if n < 2:
+                continue
+            b = rng.randint(1, W + 1, n)
+            padded = np.pad(ids, (W, W))
+            pos = np.arange(n)
+            cols, masks = [], []
+            for off in offsets:
+                cols.append(padded[W + off:W + off + n])
+                masks.append(
+                    (pos + off >= 0) & (pos + off < n) & (np.abs(off) <= b)
+                )
+            ctx = np.stack(cols, 1).astype(np.int32)
+            cm = np.stack(masks, 1)
+            keep_rows = cm.any(axis=1)
+            t_list.append(ids[keep_rows].astype(np.int32))
+            c_list.append(ctx[keep_rows])
+            m_list.append(cm[keep_rows].astype(np.float32))
+        if not t_list:
+            z = np.zeros((0, 2 * W), np.int32)
+            return np.zeros(0, np.int32), z, z.astype(np.float32)
+        t = np.concatenate(t_list)
+        c = np.concatenate(c_list)
+        m = np.concatenate(m_list)
+        perm = rng.permutation(len(t))
+        return t[perm], c[perm], m[perm]
+
+    # -- training -----------------------------------------------------------
+
+    def fit(self) -> None:
+        B = self.batch_size
+        lr0, lr_min = self.learning_rate, self.min_learning_rate
+        total_items = None
+        step = 0
+        cbow = self.algorithm == "CBOW"
+        for epoch in range(self.epochs):
+            if cbow:
+                t, c, m = self._gen_cbow(self.seed + 31 * epoch)
+                n_items = len(t)
+            else:
+                c, o = self._gen_pairs(self.seed + 31 * epoch)
+                n_items = len(c)
+            if total_items is None:
+                total_items = max(n_items * self.epochs, 1)
+            for s in range(0, n_items, B):
+                mask = np.ones(B, np.float32)
+                if cbow:
+                    tb, cb, mb = t[s:s + B], c[s:s + B], m[s:s + B]
+                    if len(tb) < B:
+                        pad = B - len(tb)
+                        mask[len(tb):] = 0.0
+                        tb = np.pad(tb, (0, pad))
+                        cb = np.pad(cb, ((0, pad), (0, 0)))
+                        mb = np.pad(mb, ((0, pad), (0, 0)))
+                else:
+                    cb, ob = c[s:s + B], o[s:s + B]
+                    if len(cb) < B:
+                        pad = B - len(cb)
+                        mask[len(cb):] = 0.0
+                        cb = np.pad(cb, (0, pad))
+                        ob = np.pad(ob, (0, pad))
+                frac = min((step * B) / total_items, 1.0)
+                alpha = max(lr0 * (1 - frac), lr_min)
+                for _ in range(self.iterations):
+                    if cbow:
+                        self._apply_cbow_batch(tb, cb, mb, mask, alpha, step)
+                    else:
+                        self._apply_batch(cb, ob, mask, alpha, step)
+                step += 1
+        self.lookup.invalidate_norms()
+
+    def _path_arrays(self, word_ids: np.ndarray):
+        codes = jnp.asarray(self._codes[word_ids])
+        points = jnp.asarray(self._points[word_ids])
+        lens = self._code_lens[word_ids]
+        pmask = jnp.asarray(
+            (np.arange(self._codes.shape[1])[None, :] < lens[:, None])
+            .astype(np.float32)
+        )
+        return codes, points, pmask
+
+    def _apply_batch(self, centers, contexts, mask, alpha, step):
+        lk = self.lookup
+        alpha = jnp.float32(alpha)
+        mask = jnp.asarray(mask)
+        cb = jnp.asarray(centers)
+        ob = jnp.asarray(contexts)
+        if self.use_hs:
+            codes, points, pmask = self._path_arrays(contexts)
+            lk.syn0, lk.syn1, _ = _hs_step(
+                lk.syn0, lk.syn1, cb, codes, points, pmask, mask, alpha
+            )
+        if self.negative > 0:
+            negs = self._sample_negatives(len(centers), step)
+            lk.syn0, lk.syn1neg, _ = _ns_step(
+                lk.syn0, lk.syn1neg, cb, ob, jnp.asarray(negs), mask, alpha
+            )
+
+    def _apply_cbow_batch(self, targets, ctx_ids, ctx_mask, mask, alpha,
+                          step):
+        lk = self.lookup
+        alpha = jnp.float32(alpha)
+        mask = jnp.asarray(mask)
+        tb = jnp.asarray(targets)
+        cb = jnp.asarray(ctx_ids)
+        cm = jnp.asarray(ctx_mask)
+        if self.use_hs:
+            codes, points, pmask = self._path_arrays(targets)
+            lk.syn0, lk.syn1, _ = _cbow_hs_step(
+                lk.syn0, lk.syn1, cb, cm, codes, points, pmask, mask, alpha
+            )
+        if self.negative > 0:
+            negs = jnp.asarray(self._sample_negatives(len(targets), step))
+            lk.syn0, lk.syn1neg, _ = _cbow_ns_step(
+                lk.syn0, lk.syn1neg, cb, cm, tb, negs, mask, alpha
+            )
+
+    def _sample_negatives(self, b: int, step: int) -> np.ndarray:
+        rng = np.random.RandomState((self.seed + step) % (2**31))
+        idx = rng.randint(0, len(self._table), (b, self.negative))
+        return self._table[idx]
+
+    # -- query API (reference BasicModelUtils / wordVectors) ----------------
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        return self.lookup.vector(word)
+
+    def has_word(self, word: str) -> bool:
+        return word in self.cache
+
+    def similarity(self, a: str, b: str) -> float:
+        """Cosine similarity (reference
+        ``BasicModelUtils.similarity``)."""
+        ia, ib = self.cache.index_of(a), self.cache.index_of(b)
+        if ia < 0 or ib < 0:
+            return float("nan")
+        m = self.lookup.normalized()
+        return float(m[ia] @ m[ib])
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        """Top-n by cosine (reference ``wordsNearest``) — one matmul
+        over the normalized table."""
+        i = self.cache.index_of(word)
+        if i < 0:
+            return []
+        m = self.lookup.normalized()
+        sims = m @ m[i]
+        sims[i] = -np.inf
+        top = np.argsort(-sims)[:n]
+        return [self.cache.word_at(int(t)) for t in top]
+
+    def words_nearest_vec(self, vec: np.ndarray, n: int = 10) -> List[str]:
+        m = self.lookup.normalized()
+        v = vec / max(np.linalg.norm(vec), 1e-12)
+        sims = m @ v
+        top = np.argsort(-sims)[:n]
+        return [self.cache.word_at(int(t)) for t in top]
+
+
+# ---------------------------------------------------------------------------
+# Word2Vec
+# ---------------------------------------------------------------------------
+
+
+class Word2Vec(SequenceVectors):
+    """Word2Vec over a sentence corpus (reference
+    ``models/word2vec/Word2Vec.java`` builder API)."""
+
+    def __init__(self, cache, sentences_ids, **kw):
+        super().__init__(cache, **kw)
+        self._sentence_ids = sentences_ids
+
+    def _sequences(self):
+        return iter(self._sentence_ids)
+
+    class Builder:
+        def __init__(self):
+            self._min_word_frequency = 1
+            self._layer_size = 100
+            self._window = 5
+            self._lr = 0.5
+            self._min_lr = 1e-4
+            self._negative = 5
+            self._hs = False
+            self._sample = 1e-3
+            self._epochs = 1
+            self._iterations = 1
+            self._batch_size = 1024
+            self._seed = 12345
+            self._algorithm = "SkipGram"
+            self._iterator = None
+            self._tokenizer = None
+
+        def min_word_frequency(self, n): self._min_word_frequency = n; return self
+        def layer_size(self, n): self._layer_size = n; return self
+        def window_size(self, n): self._window = n; return self
+        def learning_rate(self, x): self._lr = x; return self
+        def min_learning_rate(self, x): self._min_lr = x; return self
+        def negative_sample(self, n): self._negative = int(n); return self
+        def use_hierarchic_softmax(self, b): self._hs = b; return self
+        def sampling(self, x): self._sample = x; return self
+        def epochs(self, n): self._epochs = n; return self
+        def iterations(self, n): self._iterations = n; return self
+        def batch_size(self, n): self._batch_size = n; return self
+        def seed(self, n): self._seed = n; return self
+        def elements_learning_algorithm(self, a): self._algorithm = a; return self
+        def iterate(self, it): self._iterator = it; return self
+        def tokenizer_factory(self, tf): self._tokenizer = tf; return self
+
+        def build(self) -> "Word2Vec":
+            if self._iterator is None:
+                raise ValueError("iterate(sentence_iterator) is required")
+            tf = self._tokenizer or DefaultTokenizerFactory()
+            sentences = [
+                tf.create(s).get_tokens() for s in self._iterator
+            ]
+            cache = VocabConstructor(
+                min_word_frequency=self._min_word_frequency
+            ).build_vocab_from_tokens(sentences)
+            ids = [
+                np.asarray(cache.id_stream(toks), np.int64)
+                for toks in sentences
+            ]
+            return Word2Vec(
+                cache, ids,
+                layer_size=self._layer_size, window=self._window,
+                learning_rate=self._lr, min_learning_rate=self._min_lr,
+                negative=self._negative, use_hierarchic_softmax=self._hs,
+                sample=self._sample, epochs=self._epochs,
+                iterations=self._iterations, batch_size=self._batch_size,
+                seed=self._seed, algorithm=self._algorithm,
+            )

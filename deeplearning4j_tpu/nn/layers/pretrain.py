@@ -151,6 +151,11 @@ class RBM(FeedForwardLayerSpec):
     def free_energy(self, params, v):
         """F(v) for monitoring; binary hidden only: F = -v·vb -
         Σ softplus(vW + b), Gaussian visible adds 0.5‖v−vb‖²."""
+        if self.hidden_unit != "BINARY":
+            raise ValueError(
+                "free_energy has a closed form only for BINARY hidden "
+                f"units (got {self.hidden_unit})"
+            )
         pre_h = v @ params["W"] + params["b"]
         hidden_term = jnp.sum(jax.nn.softplus(pre_h), axis=-1)
         if self.visible_unit == "GAUSSIAN":

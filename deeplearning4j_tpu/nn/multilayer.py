@@ -77,6 +77,7 @@ class MultiLayerNetwork:
         self._jit_output = None
         self._jit_rnn_step = None
         self._jit_pretrain_steps: Dict[int, Callable] = {}
+        self._jit_pretrain_input = None
         self._pretrain_done = False
         self._base_key = jax.random.PRNGKey(conf.seed)
 
@@ -420,7 +421,10 @@ class MultiLayerNetwork:
             self.init()
         if hasattr(data, "features"):
             data = [data]
-        elif isinstance(data, tuple) and len(data) == 2:
+        elif (
+            isinstance(data, tuple) and len(data) == 2
+            and not hasattr(data[0], "features")
+        ):
             data = [DataSet(features=data[0], labels=data[1])]
         elif not isinstance(data, (list, tuple)) and not hasattr(
             data, "reset"
@@ -429,9 +433,11 @@ class MultiLayerNetwork:
             # the full stream (multiple passes are required)
             data = list(data)
         dtype = _dtype_of(self.conf)
-        jit_input = jax.jit(
-            self._input_to_layer_pure, static_argnames=("idx",)
-        )
+        if self._jit_pretrain_input is None:
+            self._jit_pretrain_input = jax.jit(
+                self._input_to_layer_pure, static_argnames=("idx",)
+            )
+        jit_input = self._jit_pretrain_input
         for idx, (name, layer) in enumerate(
             zip(self.layer_names, self.conf.layers)
         ):

@@ -1,0 +1,261 @@
+"""NLP/embeddings tests (reference analogs: Word2VecTests,
+GloveTest, ParagraphVectorsTest, Huffman/vocab tests, serializer
+round-trips). Parity is statistical — similarity structure on a
+synthetic two-topic corpus — not bitwise (SURVEY.md §7 hard part 3).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CollectionSentenceIterator,
+    DefaultTokenizerFactory,
+    Glove,
+    Huffman,
+    ParagraphVectors,
+    VocabConstructor,
+    Word2Vec,
+    load_binary,
+    load_txt,
+    write_binary,
+    write_txt,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    LabelAwareIterator,
+    NGramTokenizerFactory,
+    common_preprocessor,
+)
+from deeplearning4j_tpu.nlp.vocab import build_unigram_table
+
+
+def _two_topic_corpus(n=300, seed=0):
+    """Sentences drawn from two disjoint topical vocabularies:
+    within-topic words co-occur, across-topic never."""
+    rng = np.random.RandomState(seed)
+    topic_a = ["cat", "dog", "pet", "fur", "paw", "tail"]
+    topic_b = ["stock", "bond", "market", "trade", "price", "share"]
+    sents = []
+    for _ in range(n):
+        words = topic_a if rng.rand() < 0.5 else topic_b
+        sents.append(" ".join(rng.choice(words, 8)))
+    return sents
+
+
+# -- tokenization -----------------------------------------------------------
+
+
+def test_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(common_preprocessor)
+    toks = tf.create("The Cat, sat!! on 42 mats.").get_tokens()
+    assert toks == ["the", "cat", "sat", "on", "mats"]
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(1, 2)
+    toks = tf.create("a b c").get_tokens()
+    assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+# -- vocab / huffman --------------------------------------------------------
+
+
+def test_vocab_constructor_min_frequency():
+    sents = ["a a a b b c", "a b d"]
+    cache = VocabConstructor(min_word_frequency=2).build_vocab(sents)
+    assert "a" in cache and "b" in cache
+    assert "c" not in cache and "d" not in cache
+    # index 0 = most frequent
+    assert cache.word_at(0) == "a"
+    assert cache.words[0].count == 4
+
+
+def test_huffman_prefix_free_and_lengths():
+    sents = [" ".join(" ".join(["w%d" % i] * (i + 1)) for i in range(20))]
+    cache = VocabConstructor().build_vocab(sents)
+    h = Huffman(cache.words)
+    h.build()
+    codes = {}
+    for w in cache.words:
+        codes[w.word] = "".join(map(str, w.code))
+        assert len(w.code) == len(w.points)
+    # prefix-free
+    vals = sorted(codes.values())
+    for a, b in zip(vals, vals[1:]):
+        assert not b.startswith(a)
+    # more frequent -> code no longer than rarest
+    assert len(codes["w19"]) <= len(codes["w0"])
+    # padded arrays shape-consistent
+    c, p, l = h.padded_arrays()
+    assert c.shape == p.shape and c.shape[0] == len(cache)
+    assert (l <= c.shape[1]).all()
+
+
+def test_unigram_table_distribution():
+    cache = VocabConstructor().build_vocab(["a " * 100 + "b " * 10 + "c"])
+    table = build_unigram_table(cache, table_size=10000)
+    counts = np.bincount(table, minlength=3)
+    # a (idx 0) should dominate, c (idx 2) rare but present
+    assert counts[0] > counts[1] > 0
+    assert counts[2] > 0
+    # proportional to count^0.75 within tolerance
+    expect = np.array([100.0, 10.0, 1.0]) ** 0.75
+    expect /= expect.sum()
+    np.testing.assert_allclose(counts / 10000, expect, atol=0.02)
+
+
+# -- word2vec ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["ns", "hs", "cbow"])
+def test_word2vec_two_topic_similarity(mode):
+    builder = (
+        Word2Vec.Builder()
+        .min_word_frequency(2).layer_size(24).window_size(4)
+        .seed(42).epochs(8).batch_size(256).learning_rate(2.0)
+        .sampling(0.0)  # tiny corpus: every word is "frequent"
+        .iterate(CollectionSentenceIterator(_two_topic_corpus()))
+    )
+    if mode == "hs":
+        builder.use_hierarchic_softmax(True).negative_sample(0)
+    elif mode == "cbow":
+        builder.elements_learning_algorithm("CBOW").negative_sample(5)
+    else:
+        builder.negative_sample(5)
+    w2v = builder.build()
+    w2v.fit()
+    within = w2v.similarity("cat", "dog")
+    across = w2v.similarity("cat", "stock")
+    assert within > across + 0.2, (mode, within, across)
+    # wordsNearest returns same-topic words first
+    near = w2v.words_nearest("market", 3)
+    assert set(near) <= {"stock", "bond", "trade", "price", "share"}, near
+
+
+def test_word2vec_api_surface():
+    w2v = (
+        Word2Vec.Builder().min_word_frequency(1).layer_size(8)
+        .epochs(1).seed(1).batch_size(64)
+        .iterate(CollectionSentenceIterator(["a b c a b", "b c d"]))
+        .build()
+    )
+    w2v.fit()
+    assert w2v.has_word("a") and not w2v.has_word("zzz")
+    v = w2v.get_word_vector("a")
+    assert v.shape == (8,)
+    assert np.isnan(w2v.similarity("a", "zzz"))
+    assert w2v.words_nearest("zzz", 3) == []
+    nv = w2v.words_nearest_vec(v, 2)
+    assert nv[0] == "a"
+
+
+def test_word2vec_requires_objective():
+    with pytest.raises(ValueError, match="negative"):
+        (Word2Vec.Builder().negative_sample(0)
+         .iterate(CollectionSentenceIterator(["a b"])).build())
+
+
+# -- serializer -------------------------------------------------------------
+
+
+def test_serializer_roundtrips(tmp_path):
+    w2v = (
+        Word2Vec.Builder().min_word_frequency(1).layer_size(6)
+        .epochs(1).seed(3).batch_size(32)
+        .iterate(CollectionSentenceIterator(
+            ["alpha beta gamma", "beta gamma delta"]))
+        .build()
+    )
+    w2v.fit()
+    txt = tmp_path / "vecs.txt"
+    write_txt(w2v, txt)
+    cache, m = load_txt(txt)
+    assert len(cache) == len(w2v.cache)
+    i = cache.index_of("beta")
+    np.testing.assert_allclose(m[i], w2v.get_word_vector("beta"), rtol=1e-6)
+
+    bin_p = tmp_path / "vecs.bin"
+    write_binary(w2v, bin_p)
+    cache2, m2 = load_binary(bin_p)
+    assert [w.word for w in cache2.words] == [w.word for w in cache.words]
+    j = cache2.index_of("delta")
+    np.testing.assert_allclose(
+        m2[j], w2v.get_word_vector("delta"), rtol=1e-6
+    )
+
+
+def test_serializer_ngram_words(tmp_path):
+    """Vocab words containing spaces (n-grams) round-trip through txt
+    (rsplit parsing) and map to '_' in binary (format limitation)."""
+    w2v = (
+        Word2Vec.Builder().min_word_frequency(1).layer_size(4)
+        .epochs(1).seed(5).batch_size(16)
+        .tokenizer_factory(NGramTokenizerFactory(1, 2))
+        .iterate(CollectionSentenceIterator(["new york city", "new york"]))
+        .build()
+    )
+    w2v.fit()
+    assert w2v.has_word("new york")
+    txt = tmp_path / "ng.txt"
+    write_txt(w2v, txt)
+    cache, m = load_txt(txt)
+    i = cache.index_of("new york")
+    assert i >= 0
+    np.testing.assert_allclose(
+        m[i], w2v.get_word_vector("new york"), rtol=1e-6
+    )
+    bin_p = tmp_path / "ng.bin"
+    write_binary(w2v, bin_p)
+    cache2, m2 = load_binary(bin_p)
+    j = cache2.index_of("new_york")
+    assert j >= 0
+    np.testing.assert_allclose(
+        m2[j], w2v.get_word_vector("new york"), rtol=1e-6
+    )
+
+
+# -- glove ------------------------------------------------------------------
+
+
+def test_glove_two_topic_similarity():
+    glove = (
+        Glove.Builder().min_word_frequency(2).layer_size(16)
+        .window_size(4).epochs(30).seed(7).batch_size(512)
+        .learning_rate(0.1)
+        .iterate(CollectionSentenceIterator(_two_topic_corpus(200)))
+        .build()
+    )
+    glove.fit()
+    within = glove.similarity("cat", "dog")
+    across = glove.similarity("cat", "stock")
+    assert within > across + 0.2, (within, across)
+    assert np.isfinite(glove.last_loss)
+
+
+# -- paragraph vectors ------------------------------------------------------
+
+
+@pytest.mark.parametrize("algo", ["DBOW", "DM"])
+def test_paragraph_vectors_topics(algo):
+    rng = np.random.RandomState(1)
+    topic_a = ["cat", "dog", "pet", "fur", "paw", "tail"]
+    topic_b = ["stock", "bond", "market", "trade", "price", "share"]
+    texts, labels = [], []
+    for i in range(40):
+        words = topic_a if i % 2 == 0 else topic_b
+        texts.append(" ".join(rng.choice(words, 12)))
+        labels.append(f"doc_{i}")
+    pv = (
+        ParagraphVectors.Builder()
+        .min_word_frequency(1).layer_size(20).window_size(3)
+        .epochs(60).seed(11).batch_size(128).learning_rate(2.0)
+        .sequence_learning_algorithm(algo)
+        .iterate(LabelAwareIterator.from_texts(texts, labels))
+        .build()
+    )
+    pv.fit()
+    same = pv.similarity_to_label("doc_0", "doc_2")     # both topic A
+    diff = pv.similarity_to_label("doc_0", "doc_1")     # A vs B
+    assert same > diff, (algo, same, diff)
+    v = pv.get_vector("doc_0")
+    assert v.shape == (20,)
