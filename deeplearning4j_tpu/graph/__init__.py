@@ -1,0 +1,47 @@
+"""Graph embeddings module (reference ``deeplearning4j-graph`` —
+SURVEY.md §2.7): adjacency-list graph, vectorized random walks,
+DeepWalk with hierarchical softmax over a degree-based Huffman tree,
+txt serialization."""
+
+from deeplearning4j_tpu.graph.api import (
+    Edge,
+    NoEdgeHandling,
+    NoEdgesException,
+    ParseException,
+    Vertex,
+    VertexSequence,
+)
+from deeplearning4j_tpu.graph.deepwalk import (
+    DeepWalk,
+    GraphHuffman,
+    GraphVectorsImpl,
+    InMemoryGraphLookupTable,
+)
+from deeplearning4j_tpu.graph.graph import Graph, generate_random_walks
+from deeplearning4j_tpu.graph.loader import (
+    load_undirected_graph_edge_list_file,
+    load_vertex_values,
+    load_weighted_edge_list_file,
+)
+from deeplearning4j_tpu.graph.serializer import (
+    load_txt_vectors,
+    write_graph_vectors,
+)
+from deeplearning4j_tpu.graph.walks import (
+    RandomWalkGraphIteratorProvider,
+    RandomWalkIterator,
+    WeightedRandomWalkGraphIteratorProvider,
+    WeightedRandomWalkIterator,
+)
+
+__all__ = [
+    "Edge", "NoEdgeHandling", "NoEdgesException", "ParseException",
+    "Vertex", "VertexSequence", "DeepWalk", "GraphHuffman",
+    "GraphVectorsImpl", "InMemoryGraphLookupTable", "Graph",
+    "generate_random_walks", "load_undirected_graph_edge_list_file",
+    "load_vertex_values", "load_weighted_edge_list_file",
+    "load_txt_vectors", "write_graph_vectors",
+    "RandomWalkGraphIteratorProvider", "RandomWalkIterator",
+    "WeightedRandomWalkGraphIteratorProvider",
+    "WeightedRandomWalkIterator",
+]

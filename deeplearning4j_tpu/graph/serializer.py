@@ -1,0 +1,44 @@
+"""Save/load graph vectors (reference
+``graph/models/loader/GraphVectorSerializer.java`` — tab-delimited
+"index\\tv0\\tv1..." per line; loading reconstructs a query-only
+GraphVectors)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.deepwalk import (
+    GraphVectorsImpl,
+    InMemoryGraphLookupTable,
+)
+
+_DELIM = "\t"
+
+
+def write_graph_vectors(model: GraphVectorsImpl, path: str) -> None:
+    n = model.num_vertices()
+    d = model.get_vector_size()
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(n):
+            vec = model.get_vertex_vector(i)
+            f.write(
+                str(i) + _DELIM
+                + _DELIM.join(repr(float(vec[j])) for j in range(d)) + "\n"
+            )
+
+
+def load_txt_vectors(path: str) -> GraphVectorsImpl:
+    rows = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split(_DELIM)
+            if len(parts) < 2:
+                continue
+            rows.append((int(parts[0]), [float(x) for x in parts[1:]]))
+    rows.sort()
+    vectors = np.asarray([v for _, v in rows], np.float32)
+    table = InMemoryGraphLookupTable(
+        vectors.shape[0], vectors.shape[1], tree=None, learning_rate=0.01
+    )
+    table.vertex_vectors = vectors
+    return GraphVectorsImpl(table)

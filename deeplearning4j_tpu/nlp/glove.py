@@ -63,18 +63,32 @@ class CoOccurrences:
         self._counts: dict = defaultdict(float)
 
     def fit(self, id_sequences: Iterable[np.ndarray]) -> None:
+        """Vectorized: for each offset d, pair ids[:-d] with ids[d:] in
+        one slice, accumulate 1/d weights keyed by flat (i*V + j) via
+        np.add.at-free bincount (unique+aggregate) — no per-token
+        Python loop."""
+        V = len(self.cache)
         w = self.window
+        keys_parts, vals_parts = [], []
         for ids in id_sequences:
+            ids = np.asarray(ids, np.int64)
             n = len(ids)
-            for i in range(n):
-                for off in range(1, w + 1):
-                    j = i + off
-                    if j >= n:
-                        break
-                    a, b = int(ids[i]), int(ids[j])
-                    self._counts[(a, b)] += 1.0 / off
-                    if self.symmetric:
-                        self._counts[(b, a)] += 1.0 / off
+            for off in range(1, min(w, n - 1) + 1):
+                a, b = ids[:-off], ids[off:]
+                wt = np.full(len(a), 1.0 / off)
+                keys_parts.append(a * V + b)
+                vals_parts.append(wt)
+                if self.symmetric:
+                    keys_parts.append(b * V + a)
+                    vals_parts.append(wt)
+        if not keys_parts:
+            return
+        keys = np.concatenate(keys_parts)
+        vals = np.concatenate(vals_parts)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        sums = np.bincount(inv, weights=vals, minlength=len(uniq))
+        for k, x in zip(uniq, sums):
+            self._counts[(int(k) // V, int(k) % V)] += float(x)
 
     def triples(self):
         n = len(self._counts)

@@ -78,24 +78,26 @@ class ParagraphVectors(SequenceVectors):
             n = len(ids)
             if n < 2 or not labels:
                 continue
-            row = self._label_index[labels[0]]
             b = rng.randint(1, W + 1, n)
             padded = np.pad(ids, (W, W))
             pos = np.arange(n)
-            cols, masks = [], []
+            base_cols, base_masks = [], []
             for off in offsets:
-                cols.append(padded[W + off:W + off + n])
-                masks.append(
+                base_cols.append(padded[W + off:W + off + n])
+                base_masks.append(
                     (pos + off >= 0) & (pos + off < n) & (np.abs(off) <= b)
                 )
-            # extra slot: the label vector, always present
-            cols.append(np.full(n, row, np.int64))
-            masks.append(np.ones(n, bool))
-            ctx = np.stack(cols, 1).astype(np.int32)
-            cm = np.stack(masks, 1)
-            t_list.append(ids.astype(np.int32))
-            c_list.append(ctx)
-            m_list.append(cm.astype(np.float32))
+            # one training example per label: each label row joins the
+            # context window (reference DM trains every sequence label)
+            for lab in labels:
+                row = self._label_index[lab]
+                cols = base_cols + [np.full(n, row, np.int64)]
+                masks = base_masks + [np.ones(n, bool)]
+                ctx = np.stack(cols, 1).astype(np.int32)
+                cm = np.stack(masks, 1)
+                t_list.append(ids.astype(np.int32))
+                c_list.append(ctx)
+                m_list.append(cm.astype(np.float32))
         if not t_list:
             z = np.zeros((0, 2 * W + 1), np.int32)
             return np.zeros(0, np.int32), z, z.astype(np.float32)

@@ -1,0 +1,273 @@
+"""Graph module tests (reference test strategy:
+``deeplearning4j-graph/src/test/.../TestGraph.java``,
+``TestDeepWalk.java``, ``TestGraphLoading.java``)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk,
+    Graph,
+    GraphHuffman,
+    InMemoryGraphLookupTable,
+    NoEdgeHandling,
+    NoEdgesException,
+    RandomWalkGraphIteratorProvider,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+    generate_random_walks,
+    load_txt_vectors,
+    load_undirected_graph_edge_list_file,
+    load_weighted_edge_list_file,
+    write_graph_vectors,
+)
+
+
+def _ring_graph(n=10):
+    g = Graph(n)
+    for i in range(n):
+        g.add_edge(i, (i + 1) % n)
+    return g
+
+
+class TestGraph:
+    def test_undirected_edge_both_ways(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        assert g.get_vertex_degree(0) == 1
+        assert g.get_vertex_degree(1) == 1
+        assert 0 in g.get_connected_vertex_indices(1).tolist()
+
+    def test_directed_edge_one_way(self):
+        g = Graph(4)
+        g.add_edge(0, 1, directed=True)
+        assert g.get_vertex_degree(0) == 1
+        assert g.get_vertex_degree(1) == 0
+
+    def test_duplicate_edges_ignored(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        g.add_edge(0, 1)
+        g.add_edge(1, 0)
+        assert g.get_vertex_degree(0) == 1
+
+    def test_csr(self):
+        g = _ring_graph(5)
+        offsets, targets, weights = g.csr()
+        assert offsets[-1] == 10  # each vertex has degree 2
+        assert sorted(targets[offsets[0]:offsets[1]].tolist()) == [1, 4]
+
+
+class TestWalks:
+    def test_walk_shape_and_connectivity(self):
+        g = _ring_graph(12)
+        starts = np.arange(12, dtype=np.int32)
+        walks = generate_random_walks(g, 6, starts, seed=7)
+        assert walks.shape == (12, 7)
+        # every step must follow a ring edge
+        diff = (walks[:, 1:] - walks[:, :-1]) % 12
+        assert np.all((diff == 1) | (diff == 11))
+
+    def test_disconnected_self_loop(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        walks = generate_random_walks(
+            g, 4, np.array([2], np.int32), seed=0,
+            mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+        )
+        assert np.all(walks == 2)
+
+    def test_disconnected_raises(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        with pytest.raises(NoEdgesException):
+            generate_random_walks(
+                g, 4, np.array([2], np.int32), seed=0,
+                mode=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED,
+            )
+
+    def test_iterator_visits_every_start_once(self):
+        g = _ring_graph(9)
+        it = RandomWalkIterator(g, 3, seed=1)
+        starts = [s.indices()[0] for s in it]
+        assert sorted(starts) == list(range(9))
+        it.reset()
+        assert sorted(s.indices()[0] for s in it) == list(range(9))
+
+    def test_weighted_walk_prefers_heavy_edges(self):
+        # star: 0 connects to 1 (weight 100) and 2 (weight ~0)
+        g = Graph(3)
+        g.add_edge(0, 1, weight=100.0)
+        g.add_edge(0, 2, weight=1e-6)
+        it = WeightedRandomWalkIterator(
+            g, 1, seed=3, mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+            first_vertex=0, last_vertex=1,
+        )
+        hits = [next(iter(it)).indices()[1] for _ in range(1)]
+        it2_hits = []
+        for trial in range(20):
+            it2 = WeightedRandomWalkIterator(
+                g, 1, seed=trial,
+                mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+                first_vertex=0, last_vertex=1,
+            )
+            it2_hits.append(it2.walks_array()[0, 1])
+        assert np.mean(np.asarray(it2_hits) == 1) > 0.9
+
+    def test_provider_splits_range(self):
+        g = _ring_graph(10)
+        provider = RandomWalkGraphIteratorProvider(g, 2, seed=0)
+        iters = provider.get_graph_walk_iterators(3)
+        starts = []
+        for it in iters:
+            starts += [s.indices()[0] for s in it]
+        assert sorted(starts) == list(range(10))
+
+
+class TestGraphHuffman:
+    def test_codes_prefix_free_and_degree_ordered(self):
+        degrees = np.array([1, 50, 2, 30, 4, 4, 10, 1])
+        gh = GraphHuffman(degrees)
+        codes = [
+            "".join(map(str, gh.get_code(i))) for i in range(len(degrees))
+        ]
+        for i, ci in enumerate(codes):
+            for j, cj in enumerate(codes):
+                if i != j:
+                    assert not cj.startswith(ci)
+        # highest-degree vertex gets the shortest code
+        assert gh.get_code_length(1) == min(
+            gh.get_code_length(i) for i in range(len(degrees))
+        )
+
+    def test_inner_nodes_in_range(self):
+        degrees = np.array([3, 1, 4, 1, 5, 9, 2, 6])
+        gh = GraphHuffman(degrees)
+        for i in range(len(degrees)):
+            for p in gh.get_path_inner_nodes(i):
+                assert 0 <= p < len(degrees) - 1
+
+
+class TestLookupTable:
+    def test_iterate_gradcheck(self):
+        """Central-difference check of vectors_and_gradients — the
+        graph analog of the reference's DeepWalkGradientCheck."""
+        degrees = np.array([2, 3, 1, 4, 2])
+        gh = GraphHuffman(degrees)
+        table = InMemoryGraphLookupTable(5, 6, gh, 0.01, seed=99)
+        table.vertex_vectors = table.vertex_vectors.astype(np.float64)
+        table.out_weights = table.out_weights.astype(np.float64)
+        first, second = 1, 3
+
+        def loss():
+            v = table.vertex_vectors[first]
+            total = 0.0
+            for bit, node in zip(
+                gh.get_code(second), gh.get_path_inner_nodes(second)
+            ):
+                x = float(np.dot(table.out_weights[node], v))
+                sig = 1.0 / (1.0 + np.exp(-(2 * bit - 1) * x))
+                total -= np.log(sig)
+            return total
+
+        vecs, grads = table.vectors_and_gradients(first, second)
+        eps = 1e-6
+        # check input-vector gradient
+        for d in range(3):
+            orig = table.vertex_vectors[first, d]
+            table.vertex_vectors[first, d] = orig + eps
+            lp = loss()
+            table.vertex_vectors[first, d] = orig - eps
+            lm = loss()
+            table.vertex_vectors[first, d] = orig
+            num = (lp - lm) / (2 * eps)
+            assert abs(num - grads[0][d]) < 1e-5
+
+    def test_batch_matches_single_direction(self):
+        """One batched step must move vectors in the same direction as
+        per-pair iterate (up to batch averaging)."""
+        degrees = np.array([2, 2, 2, 2])
+        gh = GraphHuffman(degrees)
+        t1 = InMemoryGraphLookupTable(4, 8, gh, 0.5, seed=5)
+        t2 = InMemoryGraphLookupTable(4, 8, gh, 0.5, seed=5)
+        np.testing.assert_allclose(t1.vertex_vectors, t2.vertex_vectors)
+        t1.iterate(0, 2)
+        t2.batch_update(np.array([0]), np.array([2]), alpha=0.5)
+        np.testing.assert_allclose(
+            t1.vertex_vectors, t2.vertex_vectors, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            t1.out_weights, t2.out_weights, atol=1e-5
+        )
+
+
+class TestDeepWalk:
+    def test_embeddings_capture_community_structure(self):
+        """Two dense cliques joined by one edge: intra-clique
+        similarity must exceed inter-clique (reference
+        TestDeepWalk.testFit analog, statistical)."""
+        n = 16
+        g = Graph(n)
+        for a in range(8):
+            for b in range(a + 1, 8):
+                g.add_edge(a, b)
+                g.add_edge(a + 8, b + 8)
+        g.add_edge(0, 8)  # bridge
+        dw = (
+            DeepWalk.Builder().vector_size(16).window_size(2)
+            .learning_rate(0.05).seed(42).batch_size(512).build()
+        )
+        dw.initialize(g)
+        dw.fit(g, walk_length=8, epochs=30)
+        intra = np.mean([dw.similarity(1, b) for b in range(2, 8)])
+        inter = np.mean([dw.similarity(1, b) for b in range(9, 16)])
+        assert intra > inter
+
+    def test_vertices_nearest(self):
+        g = _ring_graph(6)
+        dw = DeepWalk.Builder().vector_size(8).seed(0).build()
+        dw.initialize(g)
+        dw.fit(g, walk_length=4, epochs=2)
+        near = dw.vertices_nearest(0, top=3)
+        assert len(near) == 3 and 0 not in near
+
+    def test_fit_iterator_path(self):
+        g = _ring_graph(8)
+        dw = DeepWalk.Builder().vector_size(8).seed(0).build()
+        dw.initialize(g)
+        it = RandomWalkIterator(
+            g, 6, seed=1, mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED
+        )
+        dw.fit_iterator(it)
+        assert not it.has_next()
+
+
+class TestLoadersAndSerialization:
+    def test_edge_list_loader(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("0,1\n1,2\n# comment\n2,3\n")
+        g = load_undirected_graph_edge_list_file(str(p), 4)
+        assert g.get_vertex_degree(1) == 2
+
+    def test_weighted_loader(self, tmp_path):
+        p = tmp_path / "wedges.txt"
+        p.write_text("0,1,2.5\n1,2,0.5\n")
+        g = load_weighted_edge_list_file(str(p), 3)
+        _, _, weights = g.csr()
+        assert 2.5 in weights.tolist()
+
+    def test_serializer_roundtrip(self, tmp_path):
+        g = _ring_graph(5)
+        dw = DeepWalk.Builder().vector_size(4).seed(7).build()
+        dw.initialize(g)
+        dw.fit(g, walk_length=3, epochs=1)
+        path = str(tmp_path / "vectors.txt")
+        write_graph_vectors(dw, path)
+        loaded = load_txt_vectors(path)
+        assert loaded.num_vertices() == 5
+        assert loaded.get_vector_size() == 4
+        for i in range(5):
+            np.testing.assert_allclose(
+                loaded.get_vertex_vector(i), dw.get_vertex_vector(i),
+                rtol=1e-6,
+            )
