@@ -1,0 +1,20 @@
+"""Clustering + spatial indexes (reference
+``deeplearning4j-core/.../clustering`` — SURVEY.md §2.2): KMeans on
+jitted Lloyd steps, KD-tree, VP-tree, quad/SP trees for Barnes-Hut."""
+
+from deeplearning4j_tpu.clustering.cluster import (
+    Cluster,
+    ClusterSet,
+    Point,
+    PointClassification,
+)
+from deeplearning4j_tpu.clustering.kdtree import HyperRect, KDTree
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.sptree import Cell, QuadTree, SPTree
+from deeplearning4j_tpu.clustering.vptree import DataPoint, VPTree
+
+__all__ = [
+    "Cluster", "ClusterSet", "Point", "PointClassification",
+    "HyperRect", "KDTree", "KMeansClustering", "Cell", "QuadTree",
+    "SPTree", "DataPoint", "VPTree",
+]

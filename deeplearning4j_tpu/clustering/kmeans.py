@@ -1,0 +1,153 @@
+"""KMeans clustering (reference
+``clustering/kmeans/KMeansClustering.java`` +
+``clustering/algorithm/BaseClusteringAlgorithm.java`` and its
+strategy/condition machinery).
+
+TPU-first: the reference iterates points one at a time through
+``ClusterUtils`` thread pools; here one jitted Lloyd step does the
+full [N, K] distance matrix on the MXU (assign = argmin row,
+update = masked mean) and the host loop only checks the termination
+condition (fixed iteration count or distribution-variation rate,
+mirroring ``FixedIterationCountCondition`` /
+``ConvergenceCondition``)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.cluster import (
+    Cluster,
+    ClusterSet,
+    Point,
+)
+
+_DISTANCES = ("euclidean", "manhattan", "cosinesimilarity")
+
+
+@functools.partial(jax.jit, static_argnames=("k", "distance"))
+def _lloyd_step(x, centers, k: int, distance: str):
+    if distance == "euclidean":
+        d = jnp.sum((x[:, None, :] - centers[None, :, :]) ** 2, axis=-1)
+    elif distance == "manhattan":
+        d = jnp.sum(jnp.abs(x[:, None, :] - centers[None, :, :]), axis=-1)
+    else:  # cosine similarity → distance
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True),
+                             1e-12)
+        cn = centers / jnp.maximum(
+            jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-12
+        )
+        d = 1.0 - xn @ cn.T
+    assign = jnp.argmin(d, axis=1)
+    onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)     # [N, K]
+    counts = jnp.sum(onehot, axis=0)                      # [K]
+    sums = onehot.T @ x                                   # [K, D]
+    new_centers = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0),
+        centers,
+    )
+    cost = jnp.sum(jnp.min(d, axis=1))
+    return new_centers, assign, cost
+
+
+class KMeansClustering:
+    """Reference ``KMeansClustering.setup`` twins: fixed iteration
+    count, or convergence on the distribution-variation rate."""
+
+    def __init__(self, cluster_count: int,
+                 max_iteration_count: Optional[int],
+                 distance_function: str = "euclidean",
+                 min_distribution_variation_rate: Optional[float] = None,
+                 allow_empty_clusters: bool = True, seed: int = 12345):
+        if distance_function not in _DISTANCES:
+            raise ValueError(
+                f"unknown distance {distance_function!r}; "
+                f"expected one of {_DISTANCES}"
+            )
+        self.k = cluster_count
+        self.max_iterations = max_iteration_count
+        self.distance = distance_function
+        self.min_variation = min_distribution_variation_rate
+        self.allow_empty = allow_empty_clusters
+        self.seed = seed
+        self.iteration_count = 0
+
+    @classmethod
+    def setup(cls, cluster_count: int, max_iteration_count: int,
+              distance_function: str = "euclidean",
+              seed: int = 12345) -> "KMeansClustering":
+        return cls(cluster_count, max_iteration_count, distance_function,
+                   seed=seed)
+
+    @classmethod
+    def setup_convergence(
+        cls, cluster_count: int,
+        min_distribution_variation_rate: float,
+        distance_function: str = "euclidean",
+        allow_empty_clusters: bool = True, seed: int = 12345,
+    ) -> "KMeansClustering":
+        return cls(cluster_count, None, distance_function,
+                   min_distribution_variation_rate, allow_empty_clusters,
+                   seed)
+
+    def _kmeans_pp_init(self, x: np.ndarray,
+                        rng: np.random.RandomState) -> np.ndarray:
+        """k-means++ seeding (D² sampling): far-apart initial centers,
+        avoiding the bad local optima plain random choice falls into.
+        (The reference seeds from random points —
+        ``ClusterUtils.randomClusters``; ++ strictly improves on it.)"""
+        n = x.shape[0]
+        centers = np.empty((self.k, x.shape[1]), x.dtype)
+        centers[0] = x[rng.randint(n)]
+        d2 = np.sum((x - centers[0]) ** 2, axis=1)
+        for i in range(1, self.k):
+            probs = d2 / max(float(d2.sum()), 1e-12)
+            centers[i] = x[rng.choice(n, p=probs)]
+            d2 = np.minimum(d2, np.sum((x - centers[i]) ** 2, axis=1))
+        return centers
+
+    def apply_to(self, points) -> ClusterSet:
+        """Cluster the points (reference
+        ``BaseClusteringAlgorithm.applyTo``)."""
+        if isinstance(points, np.ndarray):
+            pts = Point.to_points(points)
+            x = np.asarray(points, np.float32)
+        else:
+            pts = list(points)
+            x = np.stack([p.array for p in pts]).astype(np.float32)
+        n = x.shape[0]
+        if self.k > n:
+            raise ValueError(f"k={self.k} > n_points={n}")
+        rng = np.random.RandomState(self.seed)
+        centers = jnp.asarray(self._kmeans_pp_init(x, rng))
+        xj = jnp.asarray(x)
+        prev_cost = None
+        assign = None
+        max_iters = self.max_iterations or 1000
+        self.iteration_count = 0
+        for _ in range(max_iters):
+            centers, assign, cost = _lloyd_step(
+                xj, centers, self.k, self.distance
+            )
+            self.iteration_count += 1
+            cost = float(cost)
+            if self.min_variation is not None and prev_cost is not None:
+                denom = max(abs(prev_cost), 1e-12)
+                if abs(prev_cost - cost) / denom < self.min_variation:
+                    break
+            prev_cost = cost
+        assign = np.asarray(assign)
+        centers = np.asarray(centers)
+        clusters = [
+            Cluster(Point(f"center-{i}", centers[i]), id=str(i))
+            for i in range(self.k)
+        ]
+        for idx, p in zip(assign, pts):
+            clusters[int(idx)].add_point(p)
+        if not self.allow_empty:
+            clusters = [c for c in clusters if c.points]
+        return ClusterSet(clusters)

@@ -305,7 +305,12 @@ class DeepWalk(GraphVectorsImpl):
             )
         centers, contexts = self._pairs_from_walks(walks)
         if len(centers) == 0:
-            return 0.0
+            raise ValueError(
+                f"no skip-gram pairs: walk has {walks.shape[1]} vertices "
+                f"but window_size={self.window_size} needs walks of at "
+                f"least {2 * self.window_size + 1} (walk_length >= "
+                f"{2 * self.window_size})"
+            )
         # shuffle pairs so batches mix walk positions
         perm = np.random.RandomState(self.seed ^ 0x5EED).permutation(
             len(centers)

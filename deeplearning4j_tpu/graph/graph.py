@@ -52,18 +52,31 @@ class Graph(Generic[V]):
                 f"edge ({from_idx},{to_idx}) out of range for "
                 f"{self.n_vertices} vertices"
             )
-        e = Edge(from_idx, to_idx, weight, directed)
-        if not self.allow_multiple_edges:
-            for ex in self._adj[from_idx]:
-                if ex.to_idx == to_idx or (
-                    not ex.directed and ex.from_idx == to_idx
-                ):
-                    return
-        self._adj[from_idx].append(e)
-        if not directed and from_idx != to_idx:
+        if self.allow_multiple_edges:
+            add_fwd = True
+            add_rev = not directed and from_idx != to_idx
+        else:
+            # dedupe each direction independently, so an earlier
+            # directed edge doesn't swallow a later undirected
+            # request's reverse half
+            add_fwd = not any(
+                ex.to_idx == to_idx for ex in self._adj[from_idx]
+            )
+            add_rev = (
+                not directed and from_idx != to_idx
+                and not any(
+                    ex.to_idx == from_idx for ex in self._adj[to_idx]
+                )
+            )
+        if add_fwd:
+            self._adj[from_idx].append(
+                Edge(from_idx, to_idx, weight, directed)
+            )
+        if add_rev:
             self._adj[to_idx].append(Edge(to_idx, from_idx, weight, False))
-        self._csr = None
-        self._weighted_tables = None
+        if add_fwd or add_rev:
+            self._csr = None
+            self._weighted_tables = None
 
     def add_edges(self, edges: Sequence[Edge]) -> None:
         for e in edges:

@@ -28,6 +28,8 @@ def write_graph_vectors(model: GraphVectorsImpl, path: str) -> None:
 
 
 def load_txt_vectors(path: str) -> GraphVectorsImpl:
+    from deeplearning4j_tpu.graph.api import ParseException
+
     rows = []
     with open(path, "r", encoding="utf-8") as f:
         for line in f:
@@ -35,6 +37,10 @@ def load_txt_vectors(path: str) -> GraphVectorsImpl:
             if len(parts) < 2:
                 continue
             rows.append((int(parts[0]), [float(x) for x in parts[1:]]))
+    if not rows:
+        raise ParseException(f"no vector lines found in {path!r}")
+    if len({len(v) for _, v in rows}) != 1:
+        raise ParseException(f"ragged vector lengths in {path!r}")
     rows.sort()
     vectors = np.asarray([v for _, v in rows], np.float32)
     table = InMemoryGraphLookupTable(

@@ -69,7 +69,21 @@ class CoOccurrences:
         Python loop."""
         V = len(self.cache)
         w = self.window
-        keys_parts, vals_parts = [], []
+        flush_at = 1 << 20  # bound peak memory to ~8MB of keys per flush
+        keys_parts, vals_parts, pending = [], [], 0
+
+        def flush():
+            nonlocal keys_parts, vals_parts, pending
+            if not keys_parts:
+                return
+            keys = np.concatenate(keys_parts)
+            vals = np.concatenate(vals_parts)
+            uniq, inv = np.unique(keys, return_inverse=True)
+            sums = np.bincount(inv, weights=vals, minlength=len(uniq))
+            for k, x in zip(uniq, sums):
+                self._counts[(int(k) // V, int(k) % V)] += float(x)
+            keys_parts, vals_parts, pending = [], [], 0
+
         for ids in id_sequences:
             ids = np.asarray(ids, np.int64)
             n = len(ids)
@@ -78,17 +92,14 @@ class CoOccurrences:
                 wt = np.full(len(a), 1.0 / off)
                 keys_parts.append(a * V + b)
                 vals_parts.append(wt)
+                pending += len(a)
                 if self.symmetric:
                     keys_parts.append(b * V + a)
                     vals_parts.append(wt)
-        if not keys_parts:
-            return
-        keys = np.concatenate(keys_parts)
-        vals = np.concatenate(vals_parts)
-        uniq, inv = np.unique(keys, return_inverse=True)
-        sums = np.bincount(inv, weights=vals, minlength=len(uniq))
-        for k, x in zip(uniq, sums):
-            self._counts[(int(k) // V, int(k) % V)] += float(x)
+                    pending += len(a)
+            if pending >= flush_at:
+                flush()
+        flush()
 
     def triples(self):
         n = len(self._counts)

@@ -1,0 +1,6 @@
+"""Dimensionality-reduction / plotting models (reference
+``deeplearning4j-core/.../plot`` — SURVEY.md §2.2)."""
+
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne
+
+__all__ = ["BarnesHutTsne", "Tsne"]

@@ -1,0 +1,187 @@
+"""Clustering + t-SNE tests (reference
+``deeplearning4j-core/src/test/.../clustering`` and ``plot``)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree,
+    KMeansClustering,
+    Point,
+    QuadTree,
+    SPTree,
+    VPTree,
+)
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def _three_blobs(n_per=30, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = np.array([[0.0, 0.0], [10.0, 0.0], [0.0, 10.0]])
+    pts = np.concatenate([
+        c + 0.5 * rng.randn(n_per, 2) for c in centers
+    ])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts.astype(np.float32), labels
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        x, labels = _three_blobs()
+        km = KMeansClustering.setup(3, 50, "euclidean", seed=3)
+        cs = km.apply_to(x)
+        assert cs.get_cluster_count() == 3
+        sizes = sorted(len(c.points) for c in cs.get_clusters())
+        assert sizes == [30, 30, 30]
+
+    def test_convergence_mode_stops_early(self):
+        x, _ = _three_blobs()
+        km = KMeansClustering.setup_convergence(3, 1e-4, seed=3)
+        km.apply_to(x)
+        assert km.iteration_count < 1000
+
+    def test_classify_point(self):
+        x, _ = _three_blobs()
+        km = KMeansClustering.setup(3, 20, seed=1)
+        cs = km.apply_to(x)
+        pc = cs.classify_point(Point("q", np.array([10.0, 0.5])))
+        assert np.linalg.norm(
+            pc.cluster.center.array - np.array([10.0, 0.0])
+        ) < 1.0
+
+    def test_unknown_distance_raises(self):
+        with pytest.raises(ValueError, match="unknown distance"):
+            KMeansClustering.setup(2, 5, "hamming")
+
+    def test_manhattan_and_cosine(self):
+        x, _ = _three_blobs()
+        for dist in ("manhattan", "cosinesimilarity"):
+            cs = KMeansClustering.setup(3, 20, dist, seed=5).apply_to(x)
+            assert cs.get_cluster_count() == 3
+
+
+class TestKDTree:
+    def test_knn_matches_bruteforce(self):
+        rng = np.random.RandomState(7)
+        pts = rng.randn(200, 3)
+        tree = KDTree(3)
+        for p in pts:
+            tree.insert(p)
+        q = rng.randn(3)
+        res = tree.knn(q, 5)
+        brute = np.sort(np.linalg.norm(pts - q, axis=1))[:5]
+        np.testing.assert_allclose(
+            [d for d, _ in res], brute, rtol=1e-10
+        )
+
+    def test_nn(self):
+        tree = KDTree(2)
+        tree.insert([0.0, 0.0])
+        tree.insert([5.0, 5.0])
+        d, p = tree.nn([4.9, 5.1])
+        np.testing.assert_allclose(p, [5.0, 5.0])
+
+    def test_dim_mismatch_raises(self):
+        tree = KDTree(2)
+        with pytest.raises(ValueError):
+            tree.insert([1.0, 2.0, 3.0])
+
+
+class TestVPTree:
+    def test_knn_matches_bruteforce_euclidean(self):
+        rng = np.random.RandomState(11)
+        pts = rng.randn(300, 8)
+        tree = VPTree(pts)
+        q = rng.randn(8)
+        idx, dist = tree.search(q, 7)
+        brute_order = np.argsort(np.linalg.norm(pts - q, axis=1))[:7]
+        assert set(idx) == set(brute_order.tolist())
+
+    def test_cosine(self):
+        pts = np.array([[1.0, 0.0], [0.0, 1.0], [0.9, 0.1], [-1.0, 0.0]])
+        tree = VPTree(pts, "cosinesimilarity")
+        idx, _ = tree.search(np.array([1.0, 0.05]), 2)
+        assert set(idx) == {0, 2}
+
+    def test_bad_similarity_raises(self):
+        with pytest.raises(ValueError, match="unknown similarity"):
+            VPTree(np.zeros((3, 2)), "chebyshev")
+
+
+class TestSPTree:
+    def test_center_of_mass(self):
+        pts = np.array([[0.0, 0.0], [2.0, 0.0], [0.0, 2.0], [2.0, 2.0]])
+        tree = SPTree(pts)
+        np.testing.assert_allclose(tree.center_of_mass, [1.0, 1.0])
+        assert tree.cum_size == 4
+
+    def test_non_edge_forces_match_exact(self):
+        """theta=0 must reduce Barnes-Hut to the exact repulsive
+        term."""
+        rng = np.random.RandomState(5)
+        y = rng.randn(40, 2)
+        tree = SPTree(y)
+        i = 3
+        neg = np.zeros(2)
+        sum_q = tree.compute_non_edge_forces(i, 0.0, neg)
+        # exact
+        diff = y[i] - y
+        d2 = np.sum(diff * diff, axis=1)
+        q = 1.0 / (1.0 + d2)
+        q[i] = 0.0
+        exact_sum = q.sum()
+        exact_neg = ((q * q)[:, None] * diff).sum(axis=0)
+        np.testing.assert_allclose(sum_q, exact_sum, rtol=1e-8)
+        np.testing.assert_allclose(neg, exact_neg, rtol=1e-8)
+
+    def test_quadtree_requires_2d(self):
+        with pytest.raises(ValueError):
+            QuadTree(np.zeros((4, 3)))
+
+    def test_edge_forces(self):
+        y = np.array([[0.0, 0.0], [1.0, 0.0]])
+        rows = np.array([0, 1, 2])
+        cols = np.array([1, 0])
+        vals = np.array([0.5, 0.5])
+        pos = np.zeros_like(y)
+        SPTree.compute_edge_forces(y, rows, cols, vals, pos)
+        np.testing.assert_allclose(pos[0], -pos[1])
+        assert pos[0][0] < 0  # pulled toward the other point
+
+
+class TestTsne:
+    def test_exact_separates_blobs(self):
+        x, labels = _three_blobs(n_per=20, seed=2)
+        ts = Tsne(max_iter=250, perplexity=10.0, learning_rate=100.0,
+                  seed=4)
+        y = ts.fit(x)
+        assert y.shape == (60, 2)
+        assert np.isfinite(ts.kl)
+        # blob centroids in embedding space must be separated vs spread
+        cents = np.stack([y[labels == i].mean(0) for i in range(3)])
+        spread = max(
+            np.linalg.norm(y[labels == i] - cents[i], axis=1).mean()
+            for i in range(3)
+        )
+        min_gap = min(
+            np.linalg.norm(cents[i] - cents[j])
+            for i in range(3) for j in range(i + 1, 3)
+        )
+        assert min_gap > 2 * spread
+
+    def test_barnes_hut_separates_blobs(self):
+        x, labels = _three_blobs(n_per=15, seed=6)
+        ts = BarnesHutTsne(theta=0.5, max_iter=150, perplexity=5.0,
+                           learning_rate=100.0, seed=8)
+        y = ts.fit(x)
+        assert y.shape == (45, 2)
+        cents = np.stack([y[labels == i].mean(0) for i in range(3)])
+        spread = max(
+            np.linalg.norm(y[labels == i] - cents[i], axis=1).mean()
+            for i in range(3)
+        )
+        min_gap = min(
+            np.linalg.norm(cents[i] - cents[j])
+            for i in range(3) for j in range(i + 1, 3)
+        )
+        assert min_gap > spread

@@ -99,20 +99,15 @@ class TestWalks:
         g = Graph(3)
         g.add_edge(0, 1, weight=100.0)
         g.add_edge(0, 2, weight=1e-6)
-        it = WeightedRandomWalkIterator(
-            g, 1, seed=3, mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
-            first_vertex=0, last_vertex=1,
-        )
-        hits = [next(iter(it)).indices()[1] for _ in range(1)]
-        it2_hits = []
+        hits = []
         for trial in range(20):
-            it2 = WeightedRandomWalkIterator(
+            it = WeightedRandomWalkIterator(
                 g, 1, seed=trial,
                 mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
                 first_vertex=0, last_vertex=1,
             )
-            it2_hits.append(it2.walks_array()[0, 1])
-        assert np.mean(np.asarray(it2_hits) == 1) > 0.9
+            hits.append(it.walks_array()[0, 1])
+        assert np.mean(np.asarray(hits) == 1) > 0.9
 
     def test_provider_splits_range(self):
         g = _ring_graph(10)
@@ -227,7 +222,7 @@ class TestDeepWalk:
         g = _ring_graph(6)
         dw = DeepWalk.Builder().vector_size(8).seed(0).build()
         dw.initialize(g)
-        dw.fit(g, walk_length=4, epochs=2)
+        dw.fit(g, walk_length=6, epochs=2)
         near = dw.vertices_nearest(0, top=3)
         assert len(near) == 3 and 0 not in near
 
@@ -260,7 +255,7 @@ class TestLoadersAndSerialization:
         g = _ring_graph(5)
         dw = DeepWalk.Builder().vector_size(4).seed(7).build()
         dw.initialize(g)
-        dw.fit(g, walk_length=3, epochs=1)
+        dw.fit(g, walk_length=5, epochs=1)
         path = str(tmp_path / "vectors.txt")
         write_graph_vectors(dw, path)
         loaded = load_txt_vectors(path)
