@@ -1,0 +1,18 @@
+"""Keras model import (reference ``deeplearning4j-modelimport`` —
+SURVEY.md §2.8)."""
+
+from deeplearning4j_tpu.modelimport.keras import (
+    IncompatibleKerasConfigurationException,
+    import_functional_api_config,
+    import_functional_api_model,
+    import_sequential_model,
+    import_sequential_model_config,
+)
+
+__all__ = [
+    "IncompatibleKerasConfigurationException",
+    "import_functional_api_config",
+    "import_functional_api_model",
+    "import_sequential_model",
+    "import_sequential_model_config",
+]
