@@ -105,8 +105,12 @@ class KMeansClustering:
         centers[0] = x[rng.randint(n)]
         d2 = np.sum((x - centers[0]) ** 2, axis=1)
         for i in range(1, self.k):
-            probs = d2 / max(float(d2.sum()), 1e-12)
-            centers[i] = x[rng.choice(n, p=probs)]
+            total = float(d2.sum())
+            if total <= 0.0:
+                # remaining points are duplicates of chosen centers
+                centers[i] = x[rng.randint(n)]
+                continue
+            centers[i] = x[rng.choice(n, p=d2 / total)]
             d2 = np.minimum(d2, np.sum((x - centers[i]) ** 2, axis=1))
         return centers
 

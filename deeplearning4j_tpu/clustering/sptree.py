@@ -81,8 +81,8 @@ class SPTree:
             self.point_index = index
             return True
         # duplicate point: keep weight in cum_size, don't subdivide
-        if self.is_leaf and np.allclose(
-            self.data[self.point_index], point, atol=0.0
+        if self.is_leaf and np.array_equal(
+            self.data[self.point_index], point
         ):
             return True
         if self.is_leaf:

@@ -112,9 +112,10 @@ class VPTree:
             target.point if isinstance(target, DataPoint) else target,
             np.float64,
         )
-        if self.invert:
-            # negated distance is not a metric — tree pruning bounds
-            # don't hold, so rank the whole set vectorized instead
+        if self.invert or self.similarity_function == COSINE:
+            # negated distance and 1-cos both violate the triangle
+            # inequality, so the tree's pruning bounds don't hold —
+            # rank the whole set vectorized instead (one matmul)
             d = self._dist_vec(q, np.arange(len(self.items)))
             order = np.argsort(d, kind="stable")[:k]
             return order.tolist(), d[order].tolist()

@@ -97,8 +97,8 @@ class CoOccurrences:
                     keys_parts.append(b * V + a)
                     vals_parts.append(wt)
                     pending += len(a)
-            if pending >= flush_at:
-                flush()
+                if pending >= flush_at:
+                    flush()
         flush()
 
     def triples(self):

@@ -1,0 +1,27 @@
+"""Observability / training UI (reference
+``deeplearning4j-ui-parent`` — SURVEY.md §2.9): StatsListener →
+StatsStorage → browser UI, with a remote HTTP router."""
+
+from deeplearning4j_tpu.ui.model import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsInitializationReport,
+    StatsReport,
+    StatsStorage,
+    decode_record,
+)
+from deeplearning4j_tpu.ui.server import (
+    RemoteUIStatsStorageRouter,
+    UIServer,
+)
+from deeplearning4j_tpu.ui.stats_listener import (
+    J7StatsListener,
+    StatsListener,
+)
+
+__all__ = [
+    "FileStatsStorage", "InMemoryStatsStorage",
+    "StatsInitializationReport", "StatsReport", "StatsStorage",
+    "decode_record", "RemoteUIStatsStorageRouter", "UIServer",
+    "J7StatsListener", "StatsListener",
+]

@@ -1,0 +1,255 @@
+"""Browser training UI (reference ``deeplearning4j-play``:
+``PlayUIServer.java:48`` — port 9000, overridable; TrainModule
+overview page; ``RemoteReceiverModule`` accepting remote-posted stats;
+``RemoteUIStatsStorageRouter`` posting them over HTTP).
+
+The Play framework is replaced by a stdlib ``http.server`` thread:
+JSON endpoints + one self-contained overview page (inline SVG chart,
+no external assets)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.model import (
+    StatsStorage,
+    decode_record,
+    StatsInitializationReport,
+)
+
+DEFAULT_PORT = 9000
+PORT_ENV_VAR = "DL4J_UI_PORT"  # analog of org.deeplearning4j.ui.port
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu Training UI</title>
+<style>
+ body { font-family: sans-serif; margin: 2em; color: #222; }
+ h1 { font-size: 1.3em; } h2 { font-size: 1.05em; }
+ .card { border: 1px solid #ccc; border-radius: 6px; padding: 1em;
+         margin-bottom: 1em; max-width: 860px; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #ddd; padding: 4px 10px; font-size: 0.9em; }
+ svg { background: #fafafa; border: 1px solid #eee; }
+</style></head>
+<body>
+<h1>deeplearning4j_tpu &mdash; Training Overview</h1>
+<div class="card"><h2>Score vs. Iteration</h2>
+ <svg id="chart" width="820" height="260"></svg></div>
+<div class="card"><h2>Model</h2><table id="model"></table></div>
+<div class="card"><h2>System</h2><table id="system"></table></div>
+<script>
+async function refresh() {
+  const sessions = await (await fetch('train/sessions')).json();
+  if (!sessions.length) return;
+  const sid = sessions[sessions.length - 1];
+  const d = await (await fetch('train/overview?sid=' + sid)).json();
+  const svg = document.getElementById('chart');
+  const xs = d.iterations, ys = d.scores;
+  svg.innerHTML = '';
+  if (xs.length > 1) {
+    const W = 820, H = 260, P = 34;
+    const xmin = Math.min(...xs), xmax = Math.max(...xs);
+    const yminRaw = Math.min(...ys), ymaxRaw = Math.max(...ys);
+    const ymin = yminRaw, ymax = ymaxRaw === yminRaw ? yminRaw+1 : ymaxRaw;
+    const pts = xs.map((x, i) =>
+      ((P + (x - xmin) / (xmax - xmin || 1) * (W - 2*P)) + ',' +
+       (H - P - (ys[i] - ymin) / (ymax - ymin) * (H - 2*P)))).join(' ');
+    svg.innerHTML =
+      '<polyline fill="none" stroke="#06c" stroke-width="1.5" points="'
+      + pts + '"/>' +
+      '<text x="4" y="14" font-size="11">' + ymaxRaw.toFixed(4) +
+      '</text><text x="4" y="' + (H - 8) + '" font-size="11">' +
+      yminRaw.toFixed(4) + '</text>';
+  }
+  const fill = (id, obj) => {
+    document.getElementById(id).innerHTML = Object.entries(obj || {})
+      .map(([k, v]) => '<tr><th>' + k + '</th><td>' + v + '</td></tr>')
+      .join('');
+  };
+  fill('model', d.model); fill('system', d.system);
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+def _make_handler(server: "UIServer"):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, obj, code: int = 200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            if url.path in ("/", "/train", "/train/overview.html"):
+                body = _PAGE.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if url.path == "/train/sessions":
+                self._json(server.session_ids())
+                return
+            if url.path == "/train/overview":
+                q = parse_qs(url.query)
+                sid = q.get("sid", [None])[0]
+                self._json(server.overview(sid))
+                return
+            self._json({"error": "not found"}, 404)
+
+        def do_POST(self):
+            # RemoteReceiverModule analog: accept posted stats records
+            if urlparse(self.path).path != "/remoteReceive":
+                self._json({"error": "not found"}, 404)
+                return
+            if not server.remote_enabled:
+                self._json({"error": "remote receiver disabled"}, 403)
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(length)
+            try:
+                rec = decode_record(data)
+            except Exception as e:
+                self._json({"error": f"bad record: {e}"}, 400)
+                return
+            storage = server.primary_storage()
+            if isinstance(rec, StatsInitializationReport):
+                storage.put_static_info(rec)
+            else:
+                storage.put_update(rec)
+            self._json({"status": "ok"})
+
+    return Handler
+
+
+class UIServer:
+    """Singleton UI server (reference ``UIServer.getInstance()`` /
+    ``PlayUIServer``)."""
+
+    _instance: Optional["UIServer"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, port: Optional[int] = None):
+        self.port = port if port is not None else int(
+            os.environ.get(PORT_ENV_VAR, DEFAULT_PORT)
+        )
+        self._storages: List[StatsStorage] = []
+        self.remote_enabled = False
+        self._httpd = ThreadingHTTPServer(
+            ("0.0.0.0", self.port), _make_handler(self)
+        )
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dl4j-tpu-ui",
+        )
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: Optional[int] = None) -> "UIServer":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(port)
+            return cls._instance
+
+    # -- reference API ---------------------------------------------------
+
+    def attach(self, storage: StatsStorage) -> None:
+        if storage not in self._storages:
+            self._storages.append(storage)
+
+    def detach(self, storage: StatsStorage) -> None:
+        if storage in self._storages:
+            self._storages.remove(storage)
+
+    def enable_remote_listener(self) -> None:
+        self.remote_enabled = True
+        if not self._storages:
+            self._storages.append(StatsStorage())
+
+    def primary_storage(self) -> StatsStorage:
+        if not self._storages:
+            self._storages.append(StatsStorage())
+        return self._storages[0]
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        with UIServer._lock:
+            if UIServer._instance is self:
+                UIServer._instance = None
+
+    # -- data for the page ----------------------------------------------
+
+    def session_ids(self) -> List[str]:
+        out = []
+        for s in self._storages:
+            out += s.list_session_ids()
+        return sorted(set(out))
+
+    def overview(self, session_id: Optional[str]) -> dict:
+        for storage in self._storages:
+            sids = storage.list_session_ids()
+            if not sids:
+                continue
+            sid = session_id if session_id in sids else sids[-1]
+            workers = storage.list_workers(sid)
+            if not workers:
+                continue
+            wid = workers[0]
+            updates = storage.get_all_updates(sid, wid)
+            static = storage.get_static_info(sid, wid)
+            latest = updates[-1] if updates else None
+            return {
+                "session": sid,
+                "iterations": [u.iteration for u in updates],
+                "scores": [u.score for u in updates],
+                "model": dict(static.model) if static else {},
+                "system": {
+                    **(dict(static.software) if static else {}),
+                    **(dict(static.hardware) if static else {}),
+                    **({"host_rss_mb":
+                        round(latest.memory.get("host_rss_mb", 0), 1)}
+                       if latest else {}),
+                },
+            }
+        return {"session": None, "iterations": [], "scores": [],
+                "model": {}, "system": {}}
+
+
+class RemoteUIStatsStorageRouter:
+    """HTTP POST router to a remote UI (reference
+    ``RemoteUIStatsStorageRouter.java`` → ``RemoteReceiverModule``)."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        self.url = url.rstrip("/") + "/remoteReceive"
+        self.timeout = timeout
+
+    def _post(self, rec) -> None:
+        req = urllib.request.Request(
+            self.url, data=rec.encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            resp.read()
+
+    def put_static_info(self, rec) -> None:
+        self._post(rec)
+
+    def put_update(self, rec) -> None:
+        self._post(rec)

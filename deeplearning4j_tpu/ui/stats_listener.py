@@ -1,0 +1,151 @@
+"""StatsListener (reference ``ui/stats/StatsListener.java:46``,
+``iterationDone:259``): per-iteration score, param/update stats,
+memory (``:310``), learning rates — routed to a StatsStorage.
+
+TPU note: param stats require device→host syncs, so collection is
+gated by ``frequency`` (collect every Nth iteration) and histograms by
+``collect_histograms``, mirroring the reference's
+``StatsUpdateConfiguration`` knobs."""
+
+from __future__ import annotations
+
+import os
+import resource
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.ui.model import (
+    StatsInitializationReport,
+    StatsReport,
+    StatsStorage,
+    now_ms,
+)
+
+
+def _mean_magnitudes(tree: dict) -> dict:
+    out = {}
+    for lname, params in tree.items():
+        for pname, arr in params.items():
+            a = np.asarray(arr)
+            out[f"{lname}_{pname}"] = float(np.mean(np.abs(a)))
+    return out
+
+
+def _histograms(tree: dict, bins: int = 20) -> dict:
+    out = {}
+    for lname, params in tree.items():
+        for pname, arr in params.items():
+            a = np.asarray(arr).ravel()
+            counts, edges = np.histogram(a, bins=bins)
+            out[f"{lname}_{pname}"] = {
+                "min": float(edges[0]), "max": float(edges[-1]),
+                "counts": counts.tolist(),
+            }
+    return out
+
+
+class StatsListener(IterationListener):
+    """Collects and routes training statistics (reference
+    ``StatsListener.java``)."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 collect_histograms: bool = False,
+                 session_id: Optional[str] = None,
+                 worker_id: str = "worker-0"):
+        self.storage = storage
+        self.frequency = max(int(frequency), 1)
+        self.collect_histograms = collect_histograms
+        self.session_id = session_id or uuid.uuid4().hex[:12]
+        self.worker_id = worker_id
+        self._init_sent = False
+        self._last_time: Optional[float] = None
+        self._prev_params: Optional[dict] = None
+
+    def _send_init(self, model) -> None:
+        import jax
+
+        import deeplearning4j_tpu
+
+        n_params = sum(
+            int(np.asarray(a).size)
+            for lp in model.params.values() for a in lp.values()
+        )
+        rec = StatsInitializationReport(
+            session_id=self.session_id, worker_id=self.worker_id,
+            timestamp=now_ms(),
+            software={
+                "framework": "deeplearning4j_tpu",
+                "version": getattr(deeplearning4j_tpu, "__version__", "0"),
+                "backend": jax.default_backend(),
+            },
+            hardware={
+                "device_count": str(jax.device_count()),
+                "devices": ",".join(
+                    d.device_kind for d in jax.devices()
+                ),
+            },
+            model={
+                "class": type(model).__name__,
+                "layers": ",".join(getattr(model, "layer_names", [])),
+                "n_params": str(n_params),
+            },
+        )
+        self.storage.put_static_info(rec)
+        self._init_sent = True
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if not self._init_sent:
+            self._send_init(model)
+        if iteration % self.frequency != 0:
+            return
+        now = time.perf_counter()
+        duration_ms = (
+            (now - self._last_time) * 1000.0 / self.frequency
+            if self._last_time is not None else 0.0
+        )
+        self._last_time = now
+        lrs = {}
+        for i, layer in enumerate(getattr(model.conf, "layers", [])):
+            lrs[getattr(layer, "name", "") or str(i)] = float(
+                getattr(layer, "learning_rate", 0.0)
+            )
+        params = model.params
+        update_mags = {}
+        if self._prev_params is not None:
+            for lname, lp in params.items():
+                for pname, arr in lp.items():
+                    prev = self._prev_params[lname][pname]
+                    update_mags[f"{lname}_{pname}"] = float(
+                        np.mean(np.abs(np.asarray(arr) - prev))
+                    )
+        self._prev_params = {
+            ln: {pn: np.asarray(a) for pn, a in lp.items()}
+            for ln, lp in params.items()
+        }
+        maxrss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        rec = StatsReport(
+            session_id=self.session_id, worker_id=self.worker_id,
+            timestamp=now_ms(), iteration=iteration,
+            score=float(model.score_value),
+            duration_ms=duration_ms,
+            memory={
+                "host_rss_mb": maxrss_kb / 1024.0,
+                "pid": float(os.getpid()),
+            },
+            learning_rates=lrs,
+            param_mean_magnitudes=_mean_magnitudes(params),
+            update_mean_magnitudes=update_mags,
+            param_histograms=(
+                _histograms(params) if self.collect_histograms else {}
+            ),
+        )
+        self.storage.put_update(rec)
+
+
+class J7StatsListener(StatsListener):
+    """Compatibility alias (reference ``J7StatsListener`` — a Java-7
+    safe variant; no behavioral difference here)."""

@@ -1,0 +1,159 @@
+"""Stats/UI subsystem tests (reference test strategy:
+``deeplearning4j-ui-parent`` tests exercise encode/decode + storage;
+``TestListeners`` routes stats through training)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    RemoteUIStatsStorageRouter,
+    StatsListener,
+    StatsReport,
+    UIServer,
+    decode_record,
+)
+
+
+def _train_small_net(listener, n_iters=6):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(7).learning_rate(0.1)
+        .updater("SGD").list()
+        .layer(DenseLayer(n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3, loss="MCXENT"))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.listeners.append(listener)
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+    for _ in range(n_iters):
+        net.fit(DataSet(features=x, labels=y))
+    return net
+
+
+class TestRecords:
+    def test_encode_decode_roundtrip(self):
+        rec = StatsReport(
+            session_id="s", worker_id="w", timestamp=1.0, iteration=3,
+            score=0.5, learning_rates={"0": 0.1},
+            param_mean_magnitudes={"0_W": 0.2},
+        )
+        back = decode_record(rec.encode())
+        assert back.iteration == rec.iteration
+        assert back.score == rec.score
+        assert back.learning_rates == rec.learning_rates
+        assert back.param_mean_magnitudes == rec.param_mean_magnitudes
+        assert np.isnan(back.examples_per_second)  # NaN survives
+
+
+class TestStatsListenerAndStorage:
+    def test_training_routes_stats(self):
+        storage = InMemoryStatsStorage()
+        listener = StatsListener(storage, frequency=1,
+                                 collect_histograms=True)
+        _train_small_net(listener)
+        sid = storage.list_session_ids()[0]
+        wid = storage.list_workers(sid)[0]
+        static = storage.get_static_info(sid, wid)
+        assert static.model["class"] == "MultiLayerNetwork"
+        ups = storage.get_all_updates(sid, wid)
+        assert len(ups) == 6
+        assert all(np.isfinite(u.score) for u in ups)
+        # param stats present for both layers
+        assert any(k.endswith("_W") for k in
+                   ups[0].param_mean_magnitudes)
+        assert ups[0].param_histograms  # histograms on
+        # updates recorded from the second report onward
+        assert ups[1].update_mean_magnitudes
+
+    def test_frequency_gating(self):
+        storage = InMemoryStatsStorage()
+        listener = StatsListener(storage, frequency=3)
+        _train_small_net(listener, n_iters=7)
+        sid = storage.list_session_ids()[0]
+        ups = storage.get_all_updates(sid, storage.list_workers(sid)[0])
+        assert len(ups) == 2  # iterations 3 and 6
+
+    def test_file_storage_persists(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        storage = FileStatsStorage(path)
+        listener = StatsListener(storage, frequency=1)
+        _train_small_net(listener, n_iters=3)
+        # reload in a new storage
+        storage2 = FileStatsStorage(path)
+        sid = storage2.list_session_ids()[0]
+        ups = storage2.get_all_updates(sid,
+                                       storage2.list_workers(sid)[0])
+        assert len(ups) == 3
+        assert storage2.get_static_info(
+            sid, storage2.list_workers(sid)[0]
+        ) is not None
+
+    def test_storage_listener_events(self):
+        storage = InMemoryStatsStorage()
+        events = []
+        storage.register_stats_storage_listener(
+            lambda kind, rec: events.append(kind)
+        )
+        listener = StatsListener(storage, frequency=1)
+        _train_small_net(listener, n_iters=2)
+        assert events[0] == "static"
+        assert events.count("update") == 2
+
+
+class TestUIServer:
+    @pytest.fixture
+    def server(self):
+        s = UIServer(port=0)  # ephemeral port
+        yield s
+        s.stop()
+
+    def test_overview_endpoint(self, server):
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        listener = StatsListener(storage, frequency=1)
+        _train_small_net(listener, n_iters=4)
+        base = f"http://127.0.0.1:{server.port}"
+        sessions = json.loads(
+            urllib.request.urlopen(f"{base}/train/sessions").read()
+        )
+        assert len(sessions) == 1
+        ov = json.loads(urllib.request.urlopen(
+            f"{base}/train/overview?sid={sessions[0]}").read()
+        )
+        assert len(ov["scores"]) == 4
+        assert ov["model"]["class"] == "MultiLayerNetwork"
+        page = urllib.request.urlopen(base + "/").read().decode()
+        assert "Training Overview" in page
+
+    def test_remote_router_roundtrip(self, server):
+        server.enable_remote_listener()
+        router = RemoteUIStatsStorageRouter(
+            f"http://127.0.0.1:{server.port}"
+        )
+        listener = StatsListener(router, frequency=1)
+        _train_small_net(listener, n_iters=3)
+        storage = server.primary_storage()
+        sid = storage.list_session_ids()[0]
+        ups = storage.get_all_updates(sid, storage.list_workers(sid)[0])
+        assert len(ups) == 3
+
+    def test_remote_disabled_rejects(self, server):
+        router = RemoteUIStatsStorageRouter(
+            f"http://127.0.0.1:{server.port}"
+        )
+        rec = StatsReport(session_id="s", worker_id="w", timestamp=0.0,
+                          iteration=0, score=1.0)
+        with pytest.raises(urllib.error.HTTPError):
+            router.put_update(rec)
