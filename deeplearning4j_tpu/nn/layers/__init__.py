@@ -42,3 +42,7 @@ from deeplearning4j_tpu.nn.layers.variational import (  # noqa: F401
     ReconstructionDistribution,
     VariationalAutoencoder,
 )
+from deeplearning4j_tpu.nn.layers.attention import (  # noqa: F401
+    LayerNormalization,
+    MultiHeadSelfAttention,
+)

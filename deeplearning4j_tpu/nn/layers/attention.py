@@ -1,0 +1,166 @@
+"""Attention + layer-norm layers — net-new capability vs the
+reference (which predates attention; SURVEY.md §5 "long-context"
+names TBPTT/masking as its only sequence tools), added because
+long-context is first-class in this framework. Follows the layer
+conventions of the recurrent stack: sequence tensors are
+[batch, features, time] (DL4J layout), masks [batch, time].
+
+Single-shard attention lowers to two MXU matmuls with the softmax
+fused between; for sequences sharded over a ``seq`` mesh axis the same
+layer computes via ring attention
+(``deeplearning4j_tpu.parallel.sequence.ring_attention``) when given a
+``seq_axis``/``seq_axis_size`` — blockwise online softmax with K/V
+blocks rotating over ICI."""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import (
+    LayerSpec,
+    register_layer,
+)
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+@register_layer
+@dataclass(frozen=True)
+class MultiHeadSelfAttention(LayerSpec):
+    """Multi-head self-attention over the time axis. ``causal`` masks
+    future positions (decoder style); the feature mask argument masks
+    padded timesteps (same convention as the recurrent layers)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 4
+    causal: bool = False
+    activation: str = "identity"
+    # when set, q/k/v arrive time-sharded over this mesh axis and the
+    # layer computes ring attention instead of local attention
+    seq_axis: str = ""
+    seq_axis_size: int = 0
+
+    def input_kind(self) -> str:
+        return "recurrent"
+
+    def with_input_type(self, it: InputType) -> "MultiHeadSelfAttention":
+        changes = {}
+        if self.n_in == 0:
+            changes["n_in"] = it.size or it.flat_size()
+        if self.n_out == 0:
+            changes["n_out"] = it.size or it.flat_size()
+        return dataclasses.replace(self, **changes) if changes else self
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def regularizable_params(self) -> tuple:
+        return ("Wq", "Wk", "Wv", "Wo")
+
+    def _head_dim(self) -> int:
+        if self.n_in % self.n_heads != 0:
+            raise ValueError(
+                f"n_in={self.n_in} not divisible by "
+                f"n_heads={self.n_heads}"
+            )
+        return self.n_in // self.n_heads
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        d = self.n_in
+        mk = lambda k, shp: init_weights(  # noqa: E731
+            k, shp, self.weight_init, fan_in=shp[0], fan_out=shp[1],
+            distribution=self.dist, dtype=dtype,
+        )
+        return {
+            "Wq": mk(kq, (d, d)),
+            "Wk": mk(kk, (d, d)),
+            "Wv": mk(kv, (d, d)),
+            "Wo": mk(ko, (d, self.n_out)),
+            "bo": jnp.full((self.n_out,), self.bias_init, dtype),
+        }
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.parallel.sequence import (
+            attention,
+            ring_attention,
+        )
+
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        b, _, t = x.shape
+        h, hd = self.n_heads, self._head_dim()
+        xt = jnp.transpose(x, (0, 2, 1))               # [b, t, f]
+
+        def heads(w):
+            y = xt @ w                                  # [b, t, f]
+            return jnp.transpose(
+                y.reshape(b, t, h, hd), (0, 2, 1, 3)    # [b, h, t, hd]
+            )
+
+        q, k, v = heads(params["Wq"]), heads(params["Wk"]), heads(
+            params["Wv"]
+        )
+        if self.seq_axis and self.seq_axis_size > 1:
+            o = ring_attention(
+                q, k, v, axis_name=self.seq_axis,
+                axis_size=self.seq_axis_size, causal=self.causal,
+                mask=mask,
+            )
+        else:
+            o = attention(q, k, v, causal=self.causal, mask=mask)
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, t, h * hd)
+        y = o @ params["Wo"] + params["bo"]             # [b, t, n_out]
+        if mask is not None:
+            y = y * mask[:, :, None]
+        y = self.activate_fn()(y)
+        return jnp.transpose(y, (0, 2, 1)), state       # [b, n_out, t]
+
+
+@register_layer
+@dataclass(frozen=True)
+class LayerNormalization(LayerSpec):
+    """Layer norm over the feature axis for [b, f] or [b, f, t]
+    tensors (companion to attention; the reference's only norm is
+    BatchNormalization)."""
+
+    n_out: int = 0
+    # named `eps` (not `epsilon`) to avoid shadowing the optimizer
+    # epsilon inherited from LayerSpec — same as BatchNormalization
+    eps: float = 1e-5
+    activation: str = "identity"
+
+    def input_kind(self) -> str:
+        return "any"
+
+    def with_input_type(self, it: InputType) -> "LayerNormalization":
+        if self.n_out == 0:
+            return dataclasses.replace(
+                self, n_out=it.size or it.flat_size()
+            )
+        return self
+
+    def regularizable_params(self) -> tuple:
+        return ()
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        return {
+            "gamma": jnp.ones((self.n_out,), dtype),
+            "beta": jnp.zeros((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        # feature axis is 1 for both [b, f] and [b, f, t]
+        mean = jnp.mean(x, axis=1, keepdims=True)
+        var = jnp.var(x, axis=1, keepdims=True)
+        xn = (x - mean) / jnp.sqrt(var + self.eps)
+        g = params["gamma"]
+        bta = params["beta"]
+        if x.ndim == 3:
+            g = g[:, None]
+            bta = bta[:, None]
+        return self.activate_fn()(xn * g + bta), state

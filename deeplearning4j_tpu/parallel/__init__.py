@@ -9,6 +9,21 @@ from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     process_local_batch,
     replicated,
 )
+from deeplearning4j_tpu.parallel.cluster import (  # noqa: F401
+    ClusterDl4jMultiLayer,
+    ParameterAveragingTrainingMaster,
+    PathDataSetIterator,
+    TrainingHook,
+    TrainingMaster,
+    TrainingWorker,
+    batch_and_export_datasets,
+)
+from deeplearning4j_tpu.parallel.sequence import (  # noqa: F401
+    attention,
+    build_seq_mesh,
+    ring_attention,
+    ring_self_attention_sharded,
+)
 from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
     DistributedTrainer,
     default_partition_rules,

@@ -68,9 +68,16 @@ async function refresh() {
       yminRaw.toFixed(4) + '</text>';
   }
   const fill = (id, obj) => {
-    document.getElementById(id).innerHTML = Object.entries(obj || {})
-      .map(([k, v]) => '<tr><th>' + k + '</th><td>' + v + '</td></tr>')
-      .join('');
+    const table = document.getElementById(id);
+    table.textContent = '';
+    for (const [k, v] of Object.entries(obj || {})) {
+      const tr = document.createElement('tr');
+      const th = document.createElement('th');
+      th.textContent = k;                  // textContent: no HTML
+      const td = document.createElement('td');
+      td.textContent = String(v);          // injection from records
+      tr.append(th, td); table.append(tr);
+    }
   };
   fill('model', d.model); fill('system', d.system);
 }
@@ -79,13 +86,26 @@ refresh(); setInterval(refresh, 2000);
 """
 
 
+def _sanitize(obj):
+    """NaN/Inf are not legal JSON and break the browser's JSON.parse —
+    map them to null (a diverged score must not blank the UI)."""
+    if isinstance(obj, float) and (obj != obj or obj in
+                                   (float("inf"), float("-inf"))):
+        return None
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
 def _make_handler(server: "UIServer"):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
 
         def _json(self, obj, code: int = 200):
-            body = json.dumps(obj).encode()
+            body = json.dumps(_sanitize(obj)).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
@@ -203,7 +223,15 @@ class UIServer:
         return sorted(set(out))
 
     def overview(self, session_id: Optional[str]) -> dict:
-        for storage in self._storages:
+        # honor the requested session across ALL storages before
+        # falling back to any storage's latest
+        ordered = self._storages
+        if session_id is not None:
+            exact = [s for s in self._storages
+                     if session_id in s.list_session_ids()]
+            if exact:
+                ordered = exact
+        for storage in ordered:
             sids = storage.list_session_ids()
             if not sids:
                 continue
@@ -234,19 +262,47 @@ class UIServer:
 
 class RemoteUIStatsStorageRouter:
     """HTTP POST router to a remote UI (reference
-    ``RemoteUIStatsStorageRouter.java`` → ``RemoteReceiverModule``)."""
+    ``RemoteUIStatsStorageRouter.java`` → ``RemoteReceiverModule``).
+    Like the reference, transport failures never propagate into the
+    training loop: failed posts are counted and, after
+    ``max_consecutive_failures``, further sends are dropped with one
+    warning (``retry_on_failure`` re-enables on the next success)."""
 
-    def __init__(self, url: str, timeout: float = 5.0):
+    def __init__(self, url: str, timeout: float = 5.0,
+                 max_consecutive_failures: int = 10,
+                 raise_on_error: bool = False):
         self.url = url.rstrip("/") + "/remoteReceive"
         self.timeout = timeout
+        self.max_consecutive_failures = max_consecutive_failures
+        self.raise_on_error = raise_on_error
+        self._failures = 0
+        self._disabled_logged = False
 
     def _post(self, rec) -> None:
+        if self._failures >= self.max_consecutive_failures:
+            if not self._disabled_logged:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "Remote stats routing disabled after %d consecutive "
+                    "failures (target %s)", self._failures, self.url,
+                )
+                self._disabled_logged = True
+            return
         req = urllib.request.Request(
             self.url, data=rec.encode(),
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            resp.read()
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout
+            ) as resp:
+                resp.read()
+            self._failures = 0
+        except Exception:
+            self._failures += 1
+            if self.raise_on_error:
+                raise
 
     def put_static_info(self, rec) -> None:
         self._post(rec)

@@ -1,0 +1,164 @@
+"""Cluster training SPI tests (reference
+``TestSparkMultiLayerParameterAveraging``,
+``TestCompareParameterAveragingSparkVsSingleMachine``,
+``TestTrainingStatsCollection`` — run in Spark local mode; here on the
+virtual 8-device CPU mesh from conftest)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.eval import Evaluation
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import (
+    ClusterDl4jMultiLayer,
+    ParameterAveragingTrainingMaster,
+    PathDataSetIterator,
+    batch_and_export_datasets,
+)
+from deeplearning4j_tpu.parallel.cluster import _ListIterator
+
+
+def _net(seed=12345, lr=0.1, updater="SGD"):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(lr)
+        .updater(updater).list()
+        .layer(DenseLayer(n_out=10, activation="tanh"))
+        .layer(OutputLayer(n_out=3, loss="MCXENT"))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return x, y
+
+
+class TestParameterAveragingMaster:
+    def test_split_sizing(self):
+        tm = (
+            ParameterAveragingTrainingMaster.Builder(4)
+            .batch_size_per_worker(8).averaging_frequency(3).build()
+        )
+        assert tm.num_examples_per_split() == 96
+
+    def test_matches_single_machine_avg_freq_1(self):
+        """The core equivalence (reference
+        TestCompareParameterAveragingSparkVsSingleMachine): with SGD,
+        averaging_frequency=1 and k workers each stepping on its own
+        batch from identical initial params equals one step on the
+        concatenated batch (losses average over examples)."""
+        x, y = _data(32)
+        # cluster: 2 workers x batch 16
+        net_c = _net()
+        tm = (
+            ParameterAveragingTrainingMaster.Builder(2)
+            .batch_size_per_worker(16).averaging_frequency(1).build()
+        )
+        ClusterDl4jMultiLayer(net_c, tm).fit(
+            DataSet(features=x, labels=y)
+        )
+        # single machine: one batch of 32
+        net_s = _net()
+        net_s.fit(DataSet(features=x, labels=y))
+        for lname in net_s.params:
+            for pname in net_s.params[lname]:
+                np.testing.assert_allclose(
+                    np.asarray(net_c.params[lname][pname]),
+                    np.asarray(net_s.params[lname][pname]),
+                    atol=1e-5,
+                    err_msg=f"{lname}.{pname} diverged",
+                )
+
+    def test_multiple_splits_reduce_score(self):
+        x, y = _data(128, seed=3)
+        net = _net(lr=0.5)
+        tm = (
+            ParameterAveragingTrainingMaster.Builder(2)
+            .batch_size_per_worker(8).averaging_frequency(2).build()
+        )
+        trainer = ClusterDl4jMultiLayer(net, tm)
+        ds = DataSet(features=x, labels=y)
+        s0 = float(net.score(ds))
+        for _ in range(8):
+            trainer.fit(ds)
+        assert float(net.score(ds)) < s0
+
+    def test_stats_collection(self):
+        x, y = _data(64)
+        net = _net()
+        tm = (
+            ParameterAveragingTrainingMaster.Builder(2)
+            .batch_size_per_worker(16).collect_training_stats(True)
+            .build()
+        )
+        ClusterDl4jMultiLayer(net, tm).fit(DataSet(features=x, labels=y))
+        stats = tm.get_training_stats().as_dict()
+        assert stats["fit"]["count"] == 1
+        assert stats["fit"]["total_ms"] > 0
+        assert stats["split"]["count"] == 1
+
+
+class TestExportPath:
+    def test_export_and_fit_paths(self, tmp_path):
+        x, y = _data(64, seed=5)
+        batches = [
+            DataSet(features=x[i:i + 16], labels=y[i:i + 16])
+            for i in range(0, 64, 16)
+        ]
+        paths = batch_and_export_datasets(
+            _ListIterator(batches), str(tmp_path)
+        )
+        assert len(paths) == 4
+        it = PathDataSetIterator(paths)
+        loaded = list(iter(it))
+        assert len(loaded) == 4
+        np.testing.assert_allclose(loaded[0].features, x[:16])
+        net = _net()
+        tm = (
+            ParameterAveragingTrainingMaster.Builder(2)
+            .batch_size_per_worker(16).build()
+        )
+        trainer = ClusterDl4jMultiLayer(net, tm)
+        trainer.fit_paths(paths)  # must not raise
+        # directory form
+        it2 = PathDataSetIterator(str(tmp_path))
+        assert len(list(iter(it2))) == 4
+
+    def test_masks_roundtrip(self, tmp_path):
+        ds = DataSet(
+            features=np.zeros((4, 3, 5), np.float32),
+            labels=np.zeros((4, 2, 5), np.float32),
+            features_mask=np.ones((4, 5), np.float32),
+            labels_mask=np.ones((4, 5), np.float32),
+        )
+        paths = batch_and_export_datasets(
+            _ListIterator([ds]), str(tmp_path)
+        )
+        back = next(iter(PathDataSetIterator(paths)))
+        assert back.features_mask is not None
+        assert back.labels_mask.shape == (4, 5)
+
+
+class TestDistributedEval:
+    def test_sharded_eval_matches_plain(self):
+        x, y = _data(60, seed=7)
+        net = _net()
+        batches = [
+            DataSet(features=x[i:i + 10], labels=y[i:i + 10])
+            for i in range(0, 60, 10)
+        ]
+        tm = ParameterAveragingTrainingMaster.Builder(3).build()
+        trainer = ClusterDl4jMultiLayer(net, tm)
+        merged = trainer.evaluate(batches)
+        # plain eval over everything at once
+        plain = Evaluation()
+        plain.eval(y, np.asarray(net.output(x)))
+        assert merged.accuracy() == pytest.approx(plain.accuracy())
+        assert merged.f1() == pytest.approx(plain.f1())

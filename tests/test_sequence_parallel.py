@@ -1,0 +1,187 @@
+"""Ring-attention / sequence-parallel tests, run on the virtual
+8-device CPU mesh from conftest (the multi-chip sharding test
+strategy of SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import (
+    attention,
+    build_seq_mesh,
+    ring_attention,
+    ring_self_attention_sharded,
+)
+
+
+def _qkv(b=2, h=2, t=16, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(
+        jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+        for _ in range(3)
+    )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_single_device(self, causal):
+        q, k, v = _qkv()
+        mesh = build_seq_mesh(data=1, seq=4)
+        out_ring = ring_self_attention_sharded(
+            mesh, q, k, v, causal=causal
+        )
+        out_ref = attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out_ring), np.asarray(out_ref),
+            rtol=2e-4, atol=2e-5,
+        )
+
+    def test_matches_with_key_mask(self):
+        q, k, v = _qkv(t=16)
+        mask = jnp.asarray(
+            (np.arange(16)[None, :] < np.array([[11], [16]])),
+            jnp.float32,
+        ).reshape(2, 16)
+        mesh = build_seq_mesh(data=1, seq=4)
+        out_ring = ring_self_attention_sharded(
+            mesh, q, k, v, causal=False, mask=mask
+        )
+        out_ref = attention(q, k, v, causal=False, mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(out_ring), np.asarray(out_ref),
+            rtol=2e-4, atol=2e-5,
+        )
+
+    def test_gradients_match(self):
+        """Autodiff through the ring (reverse rotation) must equal the
+        single-device gradient."""
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel.sequence import _shard_map
+        shard_map = _shard_map()
+
+        q, k, v = _qkv(b=1, h=1, t=8, d=4, seed=3)
+        mesh = build_seq_mesh(data=1, seq=4)
+        spec = P(None, None, "seq", None)
+
+        ring = shard_map(
+            partial(ring_attention, axis_name="seq", axis_size=4,
+                    causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )
+
+        def loss_ring(q_, k_, v_):
+            return jnp.sum(ring(q_, k_, v_) ** 2)
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(attention(q_, k_, v_, causal=True) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+    def test_long_sequence_8way(self):
+        q, k, v = _qkv(b=1, h=4, t=64, d=16, seed=9)
+        mesh = build_seq_mesh(data=1, seq=8)
+        out = ring_self_attention_sharded(mesh, q, k, v, causal=True)
+        ref = attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=3e-4, atol=3e-5
+        )
+
+    def test_bad_mesh_shape_raises(self):
+        with pytest.raises(ValueError):
+            build_seq_mesh(data=3, seq=3)  # 9 != 8 devices
+
+
+class TestAttentionLayer:
+    def test_layer_in_network(self):
+        """Attention layer trains inside a MultiLayerNetwork on the
+        [b, f, t] sequence convention."""
+        from deeplearning4j_tpu.datasets.api import DataSet
+        from deeplearning4j_tpu.nn.conf import (
+            InputType,
+            NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers import (
+            LayerNormalization,
+            MultiHeadSelfAttention,
+            RnnOutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(5).learning_rate(0.05)
+            .updater("ADAM").list()
+            .layer(MultiHeadSelfAttention(n_heads=2, causal=True))
+            .layer(LayerNormalization())
+            .layer(RnnOutputLayer(n_out=3, loss="MCXENT"))
+            .set_input_type(InputType.recurrent(8, 12))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.rand(4, 8, 12).astype(np.float32)
+        y = np.zeros((4, 3, 12), np.float32)
+        y[:, 0] = 1.0
+        ds = DataSet(features=x, labels=y)
+        s0 = float(net.score(ds))
+        for _ in range(20):
+            net.fit(ds)
+        assert float(net.score_value) < s0
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 3, 12)
+
+    def test_causality(self):
+        """With causal=True, output at time t must not depend on
+        future inputs."""
+        from deeplearning4j_tpu.nn.layers import MultiHeadSelfAttention
+        import jax.random as jr
+
+        layer = MultiHeadSelfAttention(n_in=6, n_out=6, n_heads=2,
+                                       causal=True)
+        params = layer.init_params(jr.PRNGKey(0))
+        rng = np.random.RandomState(1)
+        x1 = jnp.asarray(rng.rand(1, 6, 10), jnp.float32)
+        x2 = x1.at[:, :, 7:].set(0.0)  # change the future
+        y1, _ = layer.apply(params, x1, {})
+        y2, _ = layer.apply(params, x2, {})
+        np.testing.assert_allclose(
+            np.asarray(y1[:, :, :7]), np.asarray(y2[:, :, :7]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_head_divisibility_error(self):
+        from deeplearning4j_tpu.nn.layers import MultiHeadSelfAttention
+        import jax.random as jr
+
+        layer = MultiHeadSelfAttention(n_in=7, n_out=7, n_heads=2)
+        with pytest.raises(ValueError, match="divisible"):
+            layer.apply(
+                layer.init_params(jr.PRNGKey(0)),
+                jnp.zeros((1, 7, 4)), {},
+            )
+
+    def test_layer_norm_normalizes(self):
+        from deeplearning4j_tpu.nn.layers import LayerNormalization
+        import jax.random as jr
+
+        layer = LayerNormalization(n_out=16)
+        params = layer.init_params(jr.PRNGKey(0))
+        x = jnp.asarray(
+            np.random.RandomState(0).rand(3, 16) * 10 + 5, jnp.float32
+        )
+        y, _ = layer.apply(params, x, {})
+        np.testing.assert_allclose(
+            np.asarray(jnp.mean(y, axis=1)), 0.0, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(jnp.std(y, axis=1)), 1.0, atol=1e-3
+        )

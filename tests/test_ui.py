@@ -151,9 +151,18 @@ class TestUIServer:
 
     def test_remote_disabled_rejects(self, server):
         router = RemoteUIStatsStorageRouter(
-            f"http://127.0.0.1:{server.port}"
+            f"http://127.0.0.1:{server.port}", raise_on_error=True
         )
         rec = StatsReport(session_id="s", worker_id="w", timestamp=0.0,
                           iteration=0, score=1.0)
         with pytest.raises(urllib.error.HTTPError):
             router.put_update(rec)
+
+    def test_remote_failures_never_kill_training(self):
+        # nothing listening on this port: posts fail, training survives
+        router = RemoteUIStatsStorageRouter(
+            "http://127.0.0.1:1", max_consecutive_failures=2
+        )
+        listener = StatsListener(router, frequency=1)
+        _train_small_net(listener, n_iters=4)  # must not raise
+        assert router._failures >= 2
