@@ -86,10 +86,8 @@ class MultiHeadSelfAttention(LayerSpec):
         }
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        from deeplearning4j_tpu.parallel.sequence import (
-            attention,
-            ring_attention,
-        )
+        from deeplearning4j_tpu.ops import mha
+        from deeplearning4j_tpu.parallel.sequence import ring_attention
 
         x = self.maybe_dropout(x, train=train, rng=rng)
         b, _, t = x.shape
@@ -112,7 +110,8 @@ class MultiHeadSelfAttention(LayerSpec):
                 mask=mask,
             )
         else:
-            o = attention(q, k, v, causal=self.causal, mask=mask)
+            # mha dispatches to the Pallas flash kernel on TPU
+            o = mha(q, k, v, causal=self.causal, mask=mask)
         o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, t, h * hd)
         y = o @ params["Wo"] + params["bo"]             # [b, t, n_out]
         if mask is not None:

@@ -81,6 +81,17 @@ def _lstm_scan(p, x_bnt, h0, c0, mask_bt, gate_fn, act_fn, peephole,
     else:
         m_tb = None
 
+    # standard sigmoid/tanh cells on TPU route through the fused
+    # Pallas kernel (one VMEM-resident matmul+gates program per step)
+    from deeplearning4j_tpu.nn import activations as _act
+    from deeplearning4j_tpu.ops import lstm_cell_diff, use_pallas_lstm
+
+    fused = (
+        use_pallas_lstm()
+        and gate_fn is _act.get("sigmoid")
+        and act_fn is _act.get("tanh")
+    )
+
     def cell(carry, inp):
         h, c = carry
         if m_tb is None:
@@ -88,19 +99,23 @@ def _lstm_scan(p, x_bnt, h0, c0, mask_bt, gate_fn, act_fn, peephole,
             m = None
         else:
             xproj, m = inp
-        z = xproj + h @ p["RW"]
-        zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
-        if peephole:
-            zi = zi + c * p["pI"]
-            zf = zf + c * p["pF"]
-        i = gate_fn(zi)
-        f = gate_fn(zf)
-        g = act_fn(zg)
-        c_new = f * c + i * g
-        if peephole:
-            zo = zo + c_new * p["pO"]
-        o = gate_fn(zo)
-        h_new = o * act_fn(c_new)
+        if fused:
+            peeps = (p["pI"], p["pF"], p["pO"]) if peephole else None
+            h_new, c_new = lstm_cell_diff(xproj, h, c, p["RW"], peeps)
+        else:
+            z = xproj + h @ p["RW"]
+            zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+            if peephole:
+                zi = zi + c * p["pI"]
+                zf = zf + c * p["pF"]
+            i = gate_fn(zi)
+            f = gate_fn(zf)
+            g = act_fn(zg)
+            c_new = f * c + i * g
+            if peephole:
+                zo = zo + c_new * p["pO"]
+            o = gate_fn(zo)
+            h_new = o * act_fn(c_new)
         if m is not None:
             h_new = m * h_new + (1.0 - m) * h
             c_new = m * c_new + (1.0 - m) * c

@@ -1,0 +1,16 @@
+"""Custom Pallas TPU kernels for the fusion-critical ops (SURVEY.md
+§2.3 maps libnd4j's hand-written kernels here). Everything else stays
+plain jax.numpy/lax — XLA's fusion already covers it; notably the
+embedding scatter-add and negative-sampling updates lower to native
+TPU scatter ops via ``jnp.ndarray.at``/``segment_sum``, so a custom
+kernel would only re-derive what the compiler emits."""
+
+from deeplearning4j_tpu.ops.flash_attention import flash_attention, mha
+from deeplearning4j_tpu.ops.lstm_cell import (
+    lstm_cell,
+    lstm_cell_diff,
+    use_pallas_lstm,
+)
+
+__all__ = ["flash_attention", "mha", "lstm_cell", "lstm_cell_diff",
+           "use_pallas_lstm"]

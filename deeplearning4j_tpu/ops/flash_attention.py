@@ -1,0 +1,166 @@
+"""Flash-attention Pallas kernel (SURVEY.md §2.3 native-component
+checklist: "custom Pallas kernels where fusion matters").
+
+The XLA fallback materializes the [t, t] score matrix in HBM between
+the two matmuls; this kernel streams K/V through VMEM in blocks with
+an online-softmax accumulator, so HBM traffic is O(t·d) instead of
+O(t²) — the standard flash-attention scheme, with the MXU doing the
+[BQ, d]×[d, BK] tiles. Numerics match
+``deeplearning4j_tpu.parallel.sequence.attention`` (same masking
+convention) to ~1e-5.
+
+Dispatch: ``mha(q, k, v, causal)`` uses the kernel on the TPU backend
+(override with env DL4J_TPU_PALLAS=0/1); elsewhere it falls back to
+the fused-by-XLA reference implementation."""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e9
+
+
+def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      causal: bool, scale: float):
+    """One program handles one (batch·head, q-block) tile.
+    q_ref [BQ, d]; k_ref/v_ref [t, d] resident in VMEM; K/V consumed
+    in block_k chunks with the online softmax."""
+    _, bq, d = q_ref.shape
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0, :, :] * scale
+
+    m0 = jnp.full((bq, 1), 2.0 * _NEG, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o0 = jnp.zeros((bq, d), jnp.float32)
+
+    n_blocks = t // block_k
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    def body(j, carry):
+        o, l, m = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * corr + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return o_new, l_new, m_new
+
+    if causal:
+        # blocks strictly after this q block are fully masked — skip
+        last = (qi + 1) * bq  # first masked key position
+        n_iter = jnp.minimum(
+            jnp.asarray(n_blocks, jnp.int32), pl.cdiv(last, block_k)
+        )
+    else:
+        n_iter = n_blocks
+    o, l, _ = jax.lax.fori_loop(0, n_iter, body, (o0, l0, m0))
+    o_ref[0, :, :] = (o / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """q/k/v: [b, h, t, d] → [b, h, t, d]. t must divide by the block
+    sizes after clamping (blocks clamp to t when t is smaller)."""
+    b, h, t, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(
+            f"sequence length {t} must be divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    scale = 1.0 / (d ** 0.5)
+    qr = q.reshape(b * h, t, d)
+    kr = k.reshape(b * h, t, d)
+    vr = v.reshape(b * h, t, d)
+    kernel = functools.partial(
+        _attention_kernel, block_k=block_k, causal=causal, scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, t, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_diff(q, k, v, causal):
+    """Differentiable wrapper: Pallas forward, XLA-recompute backward
+    (``pallas_call`` has no automatic transpose; the backward re-runs
+    the reference attention under ``jax.vjp`` — same trade flash
+    attention makes anyway: recompute over materialize)."""
+    return flash_attention(q, k, v, causal=causal)
+
+
+def _flash_fwd(q, k, v, causal):
+    return flash_attention(q, k, v, causal=causal), (q, k, v)
+
+
+def _flash_bwd(causal, res, g):
+    from deeplearning4j_tpu.parallel.sequence import attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention(q_, k_, v_, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _use_pallas() -> bool:
+    env = os.environ.get("DL4J_TPU_PALLAS", "auto").lower()
+    if env in ("1", "true", "on"):
+        return True
+    if env in ("0", "false", "off"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def mha(q, k, v, causal: bool = False, mask=None):
+    """Dispatching attention: Pallas kernel on TPU (no key mask — the
+    kernel path), XLA reference otherwise."""
+    from deeplearning4j_tpu.parallel.sequence import attention
+
+    t = q.shape[2]
+    if (
+        mask is None and _use_pallas()
+        and t % min(128, t) == 0 and t >= 8
+    ):
+        try:
+            return _flash_diff(q, k, v, causal)
+        except Exception:  # shape/VMEM limits: fall back silently
+            pass
+    return attention(q, k, v, causal=causal, mask=mask)

@@ -1,0 +1,132 @@
+"""Fused LSTM cell Pallas kernel (SURVEY.md §2.3: the LSTM cell is a
+named Pallas-fusion target; reference hot loop
+``LSTMHelpers.activateHelper:159`` does the ``ifog`` gate matmul +
+five elementwise stages as separate nd4j ops).
+
+One kernel per timestep fuses the recurrent matmul (MXU) with every
+gate nonlinearity and the cell/hidden updates (VPU) — the [b, 4n]
+pre-activation tensor never leaves VMEM. The input projection
+``x @ W`` for ALL timesteps stays outside (one big MXU matmul, already
+optimal).
+
+Gate order matches the layer convention: i, f, o, g."""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _cell_kernel(xproj_ref, h_ref, c_ref, rw_ref, h_out, c_out, *,
+                 peephole_refs=None):
+    n = h_ref.shape[1]
+    z = xproj_ref[:] + jnp.dot(
+        h_ref[:], rw_ref[:], preferred_element_type=jnp.float32
+    )
+    zi = z[:, 0 * n:1 * n]
+    zf = z[:, 1 * n:2 * n]
+    zo = z[:, 2 * n:3 * n]
+    zg = z[:, 3 * n:4 * n]
+    c = c_ref[:]
+    if peephole_refs is not None:
+        pI, pF, pO = peephole_refs
+        zi = zi + c * pI[:]
+        zf = zf + c * pF[:]
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    g = jnp.tanh(zg)
+    c_new = f * c + i * g
+    if peephole_refs is not None:
+        zo = zo + c_new * pO[:]
+    o = jax.nn.sigmoid(zo)
+    h_out[:] = (o * jnp.tanh(c_new)).astype(h_out.dtype)
+    c_out[:] = c_new.astype(c_out.dtype)
+
+
+def _peephole_kernel(xproj_ref, h_ref, c_ref, rw_ref, pi_ref, pf_ref,
+                     po_ref, h_out, c_out):
+    _cell_kernel(xproj_ref, h_ref, c_ref, rw_ref, h_out, c_out,
+                 peephole_refs=(pi_ref, pf_ref, po_ref))
+
+
+def lstm_cell(xproj, h, c, rw, peepholes=None, interpret: bool = False):
+    """One fused cell step. xproj [b, 4n] (= x_t @ W + b), h/c [b, n],
+    rw [n, 4n], peepholes optional (pI, pF, pO) each [n].
+    Returns (h_new, c_new)."""
+    b, n = h.shape
+    out_shape = (
+        jax.ShapeDtypeStruct((b, n), h.dtype),
+        jax.ShapeDtypeStruct((b, n), c.dtype),
+    )
+    vm = pl.BlockSpec(memory_space=pltpu.VMEM)
+    if peepholes is None:
+        return pl.pallas_call(
+            _cell_kernel,
+            out_shape=out_shape,
+            in_specs=[vm, vm, vm, vm],
+            out_specs=(vm, vm),
+            interpret=interpret,
+        )(xproj, h, c, rw)
+    pI, pF, pO = (p.reshape(1, n) for p in peepholes)
+    return pl.pallas_call(
+        _peephole_kernel,
+        out_shape=out_shape,
+        in_specs=[vm] * 7,
+        out_specs=(vm, vm),
+        interpret=interpret,
+    )(xproj, h, c, rw, pI, pF, pO)
+
+
+def _reference_cell(xproj, h, c, rw, peepholes):
+    """XLA reference math — also the backward path (pallas_call has no
+    automatic transpose, so grads recompute through this)."""
+    z = xproj + h @ rw
+    zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+    if peepholes is not None:
+        pI, pF, pO = peepholes
+        zi = zi + c * pI
+        zf = zf + c * pF
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    g = jnp.tanh(zg)
+    c_new = f * c + i * g
+    if peepholes is not None:
+        zo = zo + c_new * peepholes[2]
+    o = jax.nn.sigmoid(zo)
+    return o * jnp.tanh(c_new), c_new
+
+
+@jax.custom_vjp
+def lstm_cell_diff(xproj, h, c, rw, peepholes):
+    return lstm_cell(xproj, h, c, rw, peepholes)
+
+
+def _cell_fwd(xproj, h, c, rw, peepholes):
+    return lstm_cell(xproj, h, c, rw, peepholes), (
+        xproj, h, c, rw, peepholes,
+    )
+
+
+def _cell_bwd(res, g):
+    xproj, h, c, rw, peepholes = res
+    _, vjp = jax.vjp(
+        lambda *a: _reference_cell(*a), xproj, h, c, rw, peepholes
+    )
+    return vjp(g)
+
+
+lstm_cell_diff.defvjp(_cell_fwd, _cell_bwd)
+
+
+def use_pallas_lstm() -> bool:
+    env = os.environ.get("DL4J_TPU_PALLAS", "auto").lower()
+    if env in ("1", "true", "on"):
+        return True
+    if env in ("0", "false", "off"):
+        return False
+    return jax.default_backend() == "tpu"
