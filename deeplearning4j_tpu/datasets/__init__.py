@@ -12,7 +12,9 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     MultipleEpochsIterator,
     SamplingDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.lfw import LFWDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.record_reader import (  # noqa: F401
     CSVRecordReader,

@@ -154,3 +154,30 @@ class ExistingDataSetIterator(DataSetIterator):
 
     def reset(self):
         self._it = None
+
+
+def resolve_synthetic_opt_in(
+    allow_synthetic: Optional[bool], dataset: str, where: str,
+) -> None:
+    """Shared gate for synthetic-data fallbacks (MNIST/CIFAR): real
+    data missing is an error unless the caller opted in explicitly or
+    via ``DL4J_TPU_ALLOW_SYNTHETIC=1``; opting in still warns loudly.
+    Returns None on opt-in; raises FileNotFoundError otherwise."""
+    import os
+    import warnings
+
+    if allow_synthetic is None:
+        allow_synthetic = os.environ.get(
+            "DL4J_TPU_ALLOW_SYNTHETIC", ""
+        ).lower() in ("1", "true", "on")
+    if not allow_synthetic:
+        raise FileNotFoundError(
+            f"{dataset} data not found in {where}. Place the data "
+            "there, or opt in to synthetic data with "
+            "allow_synthetic=True / DL4J_TPU_ALLOW_SYNTHETIC=1."
+        )
+    warnings.warn(
+        f"{dataset} data not found — using SYNTHETIC "
+        f"class-conditional data (not real {dataset}).",
+        RuntimeWarning, stacklevel=3,
+    )

@@ -1,0 +1,173 @@
+"""CIFAR-10 (reference: ``datasets/iterator/impl/CifarDataSetIterator
+.java`` over datavec's ``CifarLoader`` binary parsing).
+
+Parses the standard binary distribution (``cifar-10-batches-bin``:
+``data_batch_{1..5}.bin`` / ``test_batch.bin``, records of 1 label byte
++ 3072 RGB bytes) and the python pickle distribution
+(``cifar-10-batches-py``). No egress in this environment, so resolution
+order mirrors :mod:`deeplearning4j_tpu.datasets.mnist`:
+
+1. ``data_dir`` argument or ``DL4J_TPU_CIFAR_DIR`` env var,
+2. ``~/.deeplearning4j_tpu/cifar10/``,
+3. ONLY with explicit ``allow_synthetic=True`` (or env
+   ``DL4J_TPU_ALLOW_SYNTHETIC=1``): deterministic synthetic
+   class-conditional images, flagged via ``.synthetic`` + warning.
+
+Features are NCHW float32 in [0, 1] (``InputType.convolutional(32, 32,
+3)``); ``flat=True`` yields ``[n, 3072]`` rows for
+``InputType.convolutional_flat``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+
+HEIGHT, WIDTH, CHANNELS, NUM_LABELS = 32, 32, 3, 10
+NUM_TRAIN_IMAGES, NUM_TEST_IMAGES = 50000, 10000
+_REC = 1 + CHANNELS * HEIGHT * WIDTH  # 3073-byte binary record
+
+LABELS = [
+    "airplane", "automobile", "bird", "cat", "deer",
+    "dog", "frog", "horse", "ship", "truck",
+]
+
+
+def _read_bin(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    raw = np.fromfile(path, dtype=np.uint8)
+    if raw.size % _REC:
+        raise ValueError(f"{path}: size {raw.size} not a multiple of {_REC}")
+    raw = raw.reshape(-1, _REC)
+    labels = raw[:, 0]
+    images = raw[:, 1:].reshape(-1, CHANNELS, HEIGHT, WIDTH)
+    return images, labels
+
+
+def _read_py(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    images = np.asarray(d[b"data"], np.uint8).reshape(
+        -1, CHANNELS, HEIGHT, WIDTH
+    )
+    labels = np.asarray(d[b"labels"], np.uint8)
+    return images, labels
+
+
+def _candidate_dirs(data_dir: Optional[str]) -> List[str]:
+    base = (
+        data_dir
+        or os.environ.get("DL4J_TPU_CIFAR_DIR")
+        or os.path.expanduser("~/.deeplearning4j_tpu/cifar10")
+    )
+    return [
+        base,
+        os.path.join(base, "cifar-10-batches-bin"),
+        os.path.join(base, "cifar-10-batches-py"),
+    ]
+
+
+def _load_real(data_dir: Optional[str], train: bool):
+    bin_names = (
+        [f"data_batch_{i}.bin" for i in range(1, 6)] if train
+        else ["test_batch.bin"]
+    )
+    py_names = (
+        [f"data_batch_{i}" for i in range(1, 6)] if train
+        else ["test_batch"]
+    )
+    for d in _candidate_dirs(data_dir):
+        if all(os.path.exists(os.path.join(d, n)) for n in bin_names):
+            parts = [_read_bin(os.path.join(d, n)) for n in bin_names]
+        elif all(os.path.exists(os.path.join(d, n)) for n in py_names):
+            parts = [_read_py(os.path.join(d, n)) for n in py_names]
+        else:
+            continue
+        images = np.concatenate([p[0] for p in parts])
+        labels = np.concatenate([p[1] for p in parts])
+        return images, labels
+    return None
+
+
+def _synthetic_cifar(n: int, seed: int, train: bool):
+    """Class-conditional color-blob images, shaped/scaled like CIFAR."""
+    rng = np.random.RandomState(seed + (0 if train else 1))
+    proto_rng = np.random.RandomState(4321)
+    protos = proto_rng.rand(
+        NUM_LABELS, CHANNELS, HEIGHT, WIDTH
+    ).astype(np.float32) * 180.0
+    labels = rng.randint(0, NUM_LABELS, n).astype(np.uint8)
+    imgs = protos[labels] + rng.randn(n, CHANNELS, HEIGHT, WIDTH) * 30.0
+    return np.clip(imgs, 0, 255).astype(np.uint8), labels
+
+
+class CifarDataSetIterator(DataSetIterator):
+    """Minibatches of CIFAR-10 (reference
+    ``CifarDataSetIterator.java:1``)."""
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 train: bool = True, data_dir: Optional[str] = None,
+                 seed: int = 123, shuffle: bool = True, flat: bool = False,
+                 allow_synthetic: Optional[bool] = None):
+        self.batch_size = batch_size
+        self.synthetic = False
+        loaded = _load_real(data_dir, train)
+        if loaded is not None:
+            images, labels = loaded
+        else:
+            from deeplearning4j_tpu.datasets.api import (
+                resolve_synthetic_opt_in,
+            )
+
+            resolve_synthetic_opt_in(
+                allow_synthetic, "CIFAR-10",
+                f"{_candidate_dirs(data_dir)!r} (or set "
+                "DL4J_TPU_CIFAR_DIR)",
+            )
+            n = num_examples or (
+                NUM_TRAIN_IMAGES if train else NUM_TEST_IMAGES
+            )
+            images, labels = _synthetic_cifar(n, seed, train)
+            self.synthetic = True
+        if num_examples is not None:
+            images, labels = images[:num_examples], labels[:num_examples]
+        if shuffle:
+            idx = np.random.RandomState(seed).permutation(len(images))
+            images, labels = images[idx], labels[idx]
+        feats = images.astype(np.float32) / 255.0
+        if flat:
+            feats = feats.reshape(len(feats), -1)
+        onehot = np.zeros((len(labels), NUM_LABELS), np.float32)
+        onehot[np.arange(len(labels)), labels] = 1.0
+        self._features = feats
+        self._labels = onehot
+        self._pos = 0
+
+    def next(self) -> DataSet:
+        i = self._pos
+        j = min(i + self.batch_size, len(self._features))
+        self._pos = j
+        return DataSet(features=self._features[i:j],
+                       labels=self._labels[i:j])
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._features)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_examples(self) -> int:
+        return len(self._features)
+
+    def input_columns(self) -> int:
+        return int(np.prod(self._features.shape[1:]))
+
+    def total_outcomes(self) -> int:
+        return NUM_LABELS

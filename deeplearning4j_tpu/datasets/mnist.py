@@ -6,9 +6,11 @@ no egress, so resolution order is:
 1. ``DL4J_TPU_MNIST_DIR`` env var or ``data_dir`` argument pointing at
    the four standard IDX files (gz or raw),
 2. ``~/.deeplearning4j_tpu/mnist/``,
-3. a deterministic synthetic fallback (class-conditional strokes) so
-   pipelines and benchmarks run without the real data — clearly flagged
-   via ``.synthetic``.
+3. ONLY with explicit ``allow_synthetic=True`` (or env
+   ``DL4J_TPU_ALLOW_SYNTHETIC=1``): a deterministic synthetic fallback
+   (class-conditional blobs), flagged via ``.synthetic`` and a loud
+   warning. Without the opt-in, missing data raises FileNotFoundError —
+   a run "on MNIST" is never silently noise.
 
 IDX parsing matches MnistManager: big-endian magic 2051/2049.
 """
@@ -87,7 +89,8 @@ class MnistDataSetIterator(DataSetIterator):
     def __init__(self, batch_size: int, train: bool = True,
                  num_examples: Optional[int] = None, seed: int = 123,
                  data_dir: Optional[str] = None,
-                 binarize: bool = False, shuffle: bool = True):
+                 binarize: bool = False, shuffle: bool = True,
+                 allow_synthetic: Optional[bool] = None):
         self.batch_size = batch_size
         self.synthetic = False
         directory = (
@@ -103,6 +106,14 @@ class MnistDataSetIterator(DataSetIterator):
             images = read_idx_images(img_path)
             labels = read_idx_labels(lab_path)
         else:
+            from deeplearning4j_tpu.datasets.api import (
+                resolve_synthetic_opt_in,
+            )
+
+            resolve_synthetic_opt_in(
+                allow_synthetic, "MNIST",
+                f"{directory!r} (or set DL4J_TPU_MNIST_DIR)",
+            )
             n = num_examples or (60000 if train else 10000)
             images, labels = _synthetic_mnist(n, seed, train)
             self.synthetic = True

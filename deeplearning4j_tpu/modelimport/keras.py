@@ -93,14 +93,25 @@ def _merge_passes(layer_dicts: List[dict]) -> List[dict]:
     the next layer, Activation into the previous layer, drop Flatten."""
     merged: List[dict] = []
     pending_dropout = 0.0
+    # a dropped leading Dropout/Flatten may carry the model's input
+    # shape — hoist it onto the next kept layer instead of losing it
+    pending_input: dict = {}
     for entry in layer_dicts:
         cls = entry["class_name"]
         cfg = dict(entry.get("config", {}))
         cfg["keras_class"] = cls
-        if cls == "Dropout":
-            pending_dropout = 1.0 - (1.0 - pending_dropout) * (
-                1.0 - float(cfg.get("p", 0.0))
-            )
+        if cls in ("Dropout", "Flatten"):
+            if not merged:
+                for k in ("batch_input_shape", "input_shape",
+                          "dim_ordering"):
+                    if cfg.get(k) is not None and k not in pending_input:
+                        pending_input[k] = cfg[k]
+            if cls == "Dropout":
+                pending_dropout = 1.0 - (1.0 - pending_dropout) * (
+                    1.0 - float(cfg.get("p", 0.0))
+                )
+            # Flatten: our InputType shape inference inserts the
+            # CNN→FF reshape
             continue
         if cls == "Activation":
             if not merged:
@@ -109,13 +120,15 @@ def _merge_passes(layer_dicts: List[dict]) -> List[dict]:
                 )
             merged[-1]["activation"] = cfg.get("activation")
             continue
-        if cls == "Flatten":
-            # our InputType shape inference inserts the CNN→FF reshape
-            continue
         if pending_dropout > 0:
             old = float(cfg.get("dropout", 0.0) or 0.0)
             cfg["dropout"] = 1.0 - (1.0 - pending_dropout) * (1.0 - old)
             pending_dropout = 0.0
+        if not merged and pending_input:
+            for k, v in pending_input.items():
+                if cfg.get(k) is None:
+                    cfg[k] = v
+            pending_input = {}
         merged.append(cfg)
     return merged
 

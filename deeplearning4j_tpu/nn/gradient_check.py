@@ -10,8 +10,8 @@ composition (layer math, preprocessors, losses, masking) in float64.
 
 Default tolerances match the reference (``GradientCheckTests.java:
 40-42``): eps=1e-6, maxRelError=1e-3, minAbsError=1e-8, run in double
-precision (requires ``jax.config.update('jax_enable_x64', True)``,
-which the helper enables).
+precision (the helper enables x64 only for its own scope via the
+``jax.enable_x64`` context manager, leaving global state untouched).
 """
 
 from __future__ import annotations
@@ -44,7 +44,20 @@ def check_gradients(
     reference checks every element; for large nets subsampling keeps
     the O(2·P) forward passes tractable — pass None for full parity).
     """
-    jax.config.update("jax_enable_x64", True)
+    with jax.enable_x64(True):
+        return _check_gradients_x64(
+            model, x, labels, mask,
+            eps=eps, max_rel_error=max_rel_error,
+            min_abs_error=min_abs_error, max_per_param=max_per_param,
+            print_results=print_results, seed=seed, train=train,
+            features_mask=features_mask,
+        )
+
+
+def _check_gradients_x64(
+    model, x, labels, mask=None, *, eps, max_rel_error, min_abs_error,
+    max_per_param, print_results, seed, train, features_mask,
+) -> bool:
     if model.params is None:
         model.init()
 

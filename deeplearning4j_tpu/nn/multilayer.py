@@ -74,6 +74,8 @@ class MultiLayerNetwork:
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep state
         self._jit_step = None
+        self._jit_multi_step = None
+        self.scan_chunk = 16  # minibatches fused per dispatch
         self._jit_output = None
         self._jit_rnn_step = None
         self._jit_pretrain_steps: Dict[int, Callable] = {}
@@ -217,6 +219,146 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _build_multi_step(self) -> Callable:
+        """k optimizer steps fused into ONE XLA program via lax.scan.
+
+        The reference dispatches one native-op sequence per minibatch
+        (SURVEY.md §3.1 hot loop); the per-dispatch latency is what
+        bounds small-model throughput on TPU (host->device hop per
+        step). Scanning k steps amortizes it k-fold: per-step PRNG keys
+        and Adam's t are computed on device, lr schedules stay host-side
+        (arbitrary Python) and ride in as a tiny stacked array.
+        """
+        updater = self.updater_def
+
+        def body(carry, per_step):
+            params, upd_state, state = carry
+            x, labels, mask, fmask, lrs, t, rng = per_step
+
+            def loss_fn(p):
+                s, new_state = self._score_pure(
+                    p, state, x, labels, mask, rng, train=True, fmask=fmask
+                )
+                return s, new_state
+
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            new_params, new_upd = updater.update(
+                grads, upd_state, params, lrs, t
+            )
+            return (new_params, new_upd, new_state), score
+
+        def multi_step(params, upd_state, state, xs, ys, masks, fmasks,
+                       lr_stack, it0, base_key):
+            k = xs.shape[0]
+            ts = (it0 + 1 + jnp.arange(k)).astype(jnp.float32)
+            rngs = jax.vmap(
+                lambda i: jax.random.fold_in(base_key, i)
+            )(it0 + jnp.arange(k))
+            (params, upd_state, state), scores = jax.lax.scan(
+                body, (params, upd_state, state),
+                (xs, ys, masks, fmasks, lr_stack, ts, rngs),
+            )
+            return params, upd_state, state, scores
+
+        return jax.jit(multi_step, donate_argnums=(0, 1, 2))
+
+    def _can_scan_steps(self) -> bool:
+        """Scan-fused fitting applies to stateless-per-batch nets:
+        recurrent carry is reset between minibatches (pytree structure
+        changes), so RNNs keep the per-step path/TBPTT. Listeners that
+        time individual iterations would observe k near-simultaneous
+        callbacks, so attached listeners also force the per-step path
+        unless they declare ``supports_batched_iterations = True``
+        (e.g. averaging listeners like the reference
+        PerformanceListener pattern)."""
+        return (
+            self.conf.iterations == 1
+            and self.conf.backprop
+            and self.conf.backprop_type != "TruncatedBPTT"
+            and not any(l.is_recurrent() for l in self.conf.layers)
+            and all(
+                getattr(l, "supports_batched_iterations", False)
+                for l in self.listeners
+            )
+        )
+
+    def _ds_scan_sig(self, ds) -> tuple:
+        def sh(a):
+            return None if a is None else np.asarray(a).shape
+        return (
+            sh(ds.features), sh(ds.labels),
+            sh(getattr(ds, "labels_mask", None)),
+            sh(getattr(ds, "features_mask", None)),
+        )
+
+    def _fit_epoch_scan(self, it) -> int:
+        """Buffer same-shaped minibatches into chunks of
+        ``self.scan_chunk`` and run each chunk as one fused dispatch."""
+        buf: List[Any] = []
+        sig = None
+        n = 0
+        for ds in it:
+            s = self._ds_scan_sig(ds)
+            if buf and s != sig:
+                self._flush_scan_chunk(buf)
+                buf = []
+            sig = s
+            buf.append(ds)
+            n += 1
+            if len(buf) >= self.scan_chunk:
+                self._flush_scan_chunk(buf)
+                buf = []
+        if buf:
+            self._flush_scan_chunk(buf)
+        return n
+
+    def _flush_scan_chunk(self, batches: List[Any]) -> None:
+        if len(batches) == 1:
+            self.fit_minibatch(batches[0])
+            return
+        dtype = _dtype_of(self.conf)
+        k = len(batches)
+
+        def stack(get):
+            first = get(batches[0])
+            if first is None:
+                return None
+            return jnp.asarray(
+                np.stack([np.asarray(get(b)) for b in batches]), dtype
+            )
+
+        xs = stack(lambda b: b.features)
+        ys = stack(lambda b: b.labels)
+        masks = stack(lambda b: getattr(b, "labels_mask", None))
+        fmasks = stack(lambda b: getattr(b, "features_mask", None))
+        it0 = self.iteration_count
+        lr_rows = [
+            self.updater_def.scheduled_lrs(it0 + i) for i in range(k)
+        ]
+        lr_stack = {
+            ln: jnp.asarray([row[ln] for row in lr_rows], jnp.float32)
+            for ln in self.updater_def.settings
+        }
+        if self._jit_multi_step is None:
+            self._jit_multi_step = self._build_multi_step()
+        (
+            self.params, self.updater_state, self.state, scores,
+        ) = self._jit_multi_step(
+            self.params, self.updater_state, self.state,
+            xs, ys, masks, fmasks, lr_stack,
+            jnp.asarray(it0, jnp.int32), self._base_key,
+        )
+        self.iteration_count += k
+        self._last_score = scores[-1]
+        if self.listeners:
+            for i in range(k):
+                self._last_score = scores[i]
+                for listener in self.listeners:
+                    listener.iteration_done(self, it0 + i + 1)
+            self._last_score = scores[-1]
+
     # ------------------------------------------------------------------
     # public API (reference fit/output/score)
     # ------------------------------------------------------------------
@@ -256,10 +398,13 @@ class MultiLayerNetwork:
                 if hasattr(listener, "on_epoch_start"):
                     listener.on_epoch_start(self)
             it = iter(iterator)
-            n_batches = 0
-            for ds in it:
-                self.fit_minibatch(ds)
-                n_batches += 1
+            if self._can_scan_steps() and self.scan_chunk > 1:
+                n_batches = self._fit_epoch_scan(it)
+            else:
+                n_batches = 0
+                for ds in it:
+                    self.fit_minibatch(ds)
+                    n_batches += 1
             if epoch > 0 and n_batches == 0:
                 raise ValueError(
                     "Iterator yielded no batches after the first epoch — "
@@ -480,19 +625,34 @@ class MultiLayerNetwork:
 
     # -- inference -----------------------------------------------------
 
-    def output(self, x, train: bool = False):
-        """Activated network output (reference ``output:1638``)."""
+    def output(self, x, train: bool = False, features_mask=None):
+        """Activated network output (reference ``output:1638``;
+        ``train=True`` applies training-mode ops like dropout, and
+        ``features_mask`` is the RNN input mask, reference
+        ``output(INDArray,...,featuresMask,labelsMask)``)."""
         if self.params is None:
             self.init()
         if self._jit_output is None:
-            def out_fn(params, state, x):
+            def out_fn(params, state, x, fmask, rng, train):
                 out, _, _, _ = self._forward_pure(
-                    params, state, x, train=False, rng=None
+                    params, state, x, train=train, rng=rng, fmask=fmask
                 )
                 return out
-            self._jit_output = jax.jit(out_fn)
+            self._jit_output = jax.jit(
+                out_fn, static_argnames=("train",)
+            )
+        dtype = _dtype_of(self.conf)
+        fm = (
+            None if features_mask is None
+            else jnp.asarray(features_mask, dtype)
+        )
+        rng = (
+            jax.random.fold_in(self._base_key, self.iteration_count)
+            if train else None
+        )
         return self._jit_output(
-            self.params, self.state, jnp.asarray(x, _dtype_of(self.conf))
+            self.params, self.state, jnp.asarray(x, dtype), fm, rng,
+            train,
         )
 
     def feed_forward(self, x, train: bool = False) -> List[jax.Array]:

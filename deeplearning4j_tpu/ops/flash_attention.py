@@ -64,10 +64,14 @@ def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         return o_new, l_new, m_new
 
     if causal:
-        # blocks strictly after this q block are fully masked — skip
+        # blocks strictly after this q block are fully masked — skip.
+        # int32 throughout: pl.cdiv would promote its Python-int
+        # divisor to int64 when x64 is globally enabled.
         last = (qi + 1) * bq  # first masked key position
         n_iter = jnp.minimum(
-            jnp.asarray(n_blocks, jnp.int32), pl.cdiv(last, block_k)
+            jnp.asarray(n_blocks, jnp.int32),
+            (last + jnp.asarray(block_k - 1, jnp.int32))
+            // jnp.asarray(block_k, jnp.int32),
         )
     else:
         n_iter = n_blocks
@@ -149,9 +153,24 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+_fallback_warned = False
+
+
 def mha(q, k, v, causal: bool = False, mask=None):
     """Dispatching attention: Pallas kernel on TPU (no key mask — the
-    kernel path), XLA reference otherwise."""
+    kernel path), XLA reference otherwise.
+
+    The fallback catches only the errors the kernel is expected to
+    raise for unsupported shapes/VMEM limits (ValueError/TypeError and
+    XlaRuntimeError), warns once, and re-raises everything else so real
+    kernel bugs surface. Note: when ``mha`` is called inside an
+    enclosing ``jit``, a Pallas compile error surfaces at the caller's
+    compile time, outside this try — the fallback cannot trigger there.
+    """
+    import warnings
+
+    from jax.errors import JaxRuntimeError
+
     from deeplearning4j_tpu.parallel.sequence import attention
 
     t = q.shape[2]
@@ -161,6 +180,15 @@ def mha(q, k, v, causal: bool = False, mask=None):
     ):
         try:
             return _flash_diff(q, k, v, causal)
-        except Exception:  # shape/VMEM limits: fall back silently
-            pass
+        except (ValueError, TypeError, JaxRuntimeError) as e:
+            global _fallback_warned
+            if not _fallback_warned:
+                _fallback_warned = True
+                warnings.warn(
+                    "flash-attention Pallas kernel unavailable for "
+                    f"shape {q.shape}; using XLA reference attention "
+                    f"({type(e).__name__}: {e})",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
     return attention(q, k, v, causal=causal, mask=mask)

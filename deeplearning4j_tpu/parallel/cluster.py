@@ -16,7 +16,7 @@ round is an on-device mean over ICI. The Spark-side SPI shape
 (master/worker split, averaging frequency, splits over the dataset,
 per-phase stats) is preserved so reference users find the same
 control knobs; multi-host scale-out over DCN is
-``deeplearning4j_tpu.parallel.distributed.initialize_multi_host``.
+``deeplearning4j_tpu.parallel.mesh.init_distributed``.
 """
 
 from __future__ import annotations
@@ -229,17 +229,19 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             else _nulltimer
         )
         # replicas step as one stacked vmap, so every batch in a round
-        # must share a shape: the (at most one) smaller tail batch
-        # trains in its own final round
-        full = [b for b in batches
-                if b.num_examples() == self.batch_size_per_worker]
-        tail = [b for b in batches
-                if b.num_examples() != self.batch_size_per_worker]
+        # must share a shape: group batches by size (iterator input can
+        # carry several distinct off-sizes, not just one tail) and fit
+        # once per uniform-size group, full-size group first.
+        by_size: dict = {}
+        for b in batches:
+            by_size.setdefault(b.num_examples(), []).append(b)
+        ordered = sorted(
+            by_size.items(),
+            key=lambda kv: (kv[0] != self.batch_size_per_worker, kv[0]),
+        )
         with timer:
-            if full:
-                wrapper.fit(_ListIterator(full))
-            if tail:
-                wrapper.fit(_ListIterator(tail))
+            for _, group in ordered:
+                wrapper.fit(_ListIterator(group))
 
     def _as_batches(self, data) -> List[DataSet]:
         timer = (
@@ -330,8 +332,14 @@ class ClusterDl4jMultiLayer:
                 continue
             e = Evaluation()
             for ds in shard:
-                out = self.net.output(ds.features)
-                e.eval(np.asarray(ds.labels), np.asarray(out))
+                out = self.net.output(
+                    ds.features, features_mask=ds.features_mask
+                )
+                mask = (
+                    np.asarray(ds.labels_mask)
+                    if ds.labels_mask is not None else None
+                )
+                e.eval(np.asarray(ds.labels), np.asarray(out), mask=mask)
             merged = e if merged is None else merged.merge(e)
         return merged if merged is not None else Evaluation()
 

@@ -25,6 +25,8 @@ from deeplearning4j_tpu.ui.model import (
 
 DEFAULT_PORT = 9000
 PORT_ENV_VAR = "DL4J_UI_PORT"  # analog of org.deeplearning4j.ui.port
+HOST_ENV_VAR = "DL4J_UI_HOST"  # set 0.0.0.0 to expose beyond loopback
+MAX_POST_BYTES = 16 * 1024 * 1024  # /remoteReceive body cap
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j_tpu Training UI</title>
@@ -140,7 +142,15 @@ def _make_handler(server: "UIServer"):
             if not server.remote_enabled:
                 self._json({"error": "remote receiver disabled"}, 403)
                 return
-            length = int(self.headers.get("Content-Length", 0))
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except (TypeError, ValueError):
+                self._json({"error": "bad Content-Length"}, 400)
+                return
+            if length < 0 or length > MAX_POST_BYTES:
+                # negative would make rfile.read unbounded
+                self._json({"error": "payload too large"}, 413)
+                return
             data = self.rfile.read(length)
             try:
                 rec = decode_record(data)
@@ -164,14 +174,20 @@ class UIServer:
     _instance: Optional["UIServer"] = None
     _lock = threading.Lock()
 
-    def __init__(self, port: Optional[int] = None):
+    def __init__(self, port: Optional[int] = None,
+                 host: Optional[str] = None):
         self.port = port if port is not None else int(
             os.environ.get(PORT_ENV_VAR, DEFAULT_PORT)
+        )
+        # default loopback-only: the remote receiver accepts
+        # unauthenticated POSTs, so exposure must be an explicit choice
+        self.host = host if host is not None else os.environ.get(
+            HOST_ENV_VAR, "127.0.0.1"
         )
         self._storages: List[StatsStorage] = []
         self.remote_enabled = False
         self._httpd = ThreadingHTTPServer(
-            ("0.0.0.0", self.port), _make_handler(self)
+            (self.host, self.port), _make_handler(self)
         )
         self.port = self._httpd.server_address[1]  # resolve port 0
         self._thread = threading.Thread(

@@ -23,8 +23,19 @@ from deeplearning4j_tpu.datasets import (
 from deeplearning4j_tpu.datasets.mnist import read_idx_images, read_idx_labels
 
 
+def test_mnist_synthetic_requires_opt_in(monkeypatch, tmp_path):
+    """Missing data must raise, not silently fabricate (the reference
+    MnistDataFetcher downloads real data; we have no egress)."""
+    monkeypatch.delenv("DL4J_TPU_ALLOW_SYNTHETIC", raising=False)
+    with pytest.raises(FileNotFoundError, match="allow_synthetic"):
+        MnistDataSetIterator(32, train=True, num_examples=10,
+                             data_dir=str(tmp_path))
+
+
 def test_mnist_synthetic_fallback_shapes():
-    it = MnistDataSetIterator(32, train=True, num_examples=100)
+    with pytest.warns(RuntimeWarning, match="SYNTHETIC"):
+        it = MnistDataSetIterator(32, train=True, num_examples=100,
+                                  allow_synthetic=True)
     assert it.synthetic  # no real data in this environment
     batches = list(it)
     assert len(batches) == 4  # 3x32 + 1x4
@@ -62,7 +73,8 @@ def test_mnist_trains_a_model():
     from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    it = MnistDataSetIterator(50, train=True, num_examples=200)
+    it = MnistDataSetIterator(50, train=True, num_examples=200,
+                              allow_synthetic=True)
     conf = (
         NeuralNetConfiguration.Builder().seed(1).learning_rate(0.01)
         .updater("ADAM")
@@ -73,8 +85,82 @@ def test_mnist_trains_a_model():
     )
     net = MultiLayerNetwork(conf).init()
     net.fit(it, epochs=8)
-    ev = net.evaluate(MnistDataSetIterator(50, train=True, num_examples=200))
+    ev = net.evaluate(MnistDataSetIterator(50, train=True, num_examples=200,
+                                           allow_synthetic=True))
     assert ev.accuracy() > 0.9  # synthetic digits are separable
+
+
+def test_cifar_binary_parsing_round_trip(tmp_path):
+    """Write real CIFAR-10 binary batches and read them back
+    (reference CifarLoader binary format: 1 label byte + 3072 RGB)."""
+    from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator
+
+    rng = np.random.RandomState(0)
+    for name, n in [(f"data_batch_{i}.bin", 4) for i in range(1, 6)] + [
+        ("test_batch.bin", 4)
+    ]:
+        recs = []
+        for r in range(n):
+            label = np.uint8(rng.randint(0, 10))
+            img = rng.randint(0, 256, 3072).astype(np.uint8)
+            recs.append(np.concatenate([[label], img]))
+        np.concatenate(recs).tofile(os.path.join(tmp_path, name))
+    it = CifarDataSetIterator(8, train=True, data_dir=str(tmp_path),
+                              shuffle=False)
+    assert not it.synthetic
+    assert it.total_examples() == 20  # 5 batches x 4
+    ds = next(iter(it))
+    assert ds.features.shape == (8, 3, 32, 32)
+    assert ds.labels.shape == (8, 10)
+    assert 0.0 <= ds.features.min() <= ds.features.max() <= 1.0
+    test_it = CifarDataSetIterator(4, train=False, data_dir=str(tmp_path),
+                                   flat=True)
+    assert next(iter(test_it)).features.shape == (4, 3072)
+
+
+def test_cifar_synthetic_requires_opt_in(monkeypatch, tmp_path):
+    from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator
+
+    monkeypatch.delenv("DL4J_TPU_ALLOW_SYNTHETIC", raising=False)
+    with pytest.raises(FileNotFoundError, match="allow_synthetic"):
+        CifarDataSetIterator(8, num_examples=16, data_dir=str(tmp_path))
+    with pytest.warns(RuntimeWarning, match="SYNTHETIC"):
+        it = CifarDataSetIterator(8, num_examples=16,
+                                  data_dir=str(tmp_path),
+                                  allow_synthetic=True)
+    assert it.synthetic
+    assert next(iter(it)).features.shape == (8, 3, 32, 32)
+
+
+def test_lfw_directory_tree(tmp_path):
+    """Person-per-directory image tree with parent-path labels
+    (reference LFWLoader + ParentPathLabelGenerator)."""
+    from PIL import Image
+
+    from deeplearning4j_tpu.datasets.lfw import LFWDataSetIterator
+
+    rng = np.random.RandomState(3)
+    for person, count in [("Ada_Lovelace", 3), ("Alan_Turing", 2)]:
+        os.makedirs(os.path.join(tmp_path, person))
+        for i in range(count):
+            arr = rng.randint(0, 256, (40, 40, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(
+                os.path.join(tmp_path, person, f"{person}_{i:04d}.jpg")
+            )
+    it = LFWDataSetIterator(4, img_dim=(32, 32, 3), train=True,
+                            split_train_test=1.0, data_dir=str(tmp_path))
+    assert it.labels == ["Ada_Lovelace", "Alan_Turing"]
+    assert it.total_examples() == 5
+    ds = next(iter(it))
+    assert ds.features.shape == (4, 3, 32, 32)
+    assert ds.labels.shape == (4, 2)
+    # train/test split partitions the data
+    tr = LFWDataSetIterator(8, img_dim=(32, 32, 3), train=True,
+                            split_train_test=0.6, data_dir=str(tmp_path))
+    te = LFWDataSetIterator(8, img_dim=(32, 32, 3), train=False,
+                            split_train_test=0.6, data_dir=str(tmp_path))
+    assert tr.total_examples() + te.total_examples() == 5
+    assert te.total_examples() == 2
 
 
 def test_iris_iterator():

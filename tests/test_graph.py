@@ -241,9 +241,15 @@ def test_unknown_input_reference():
 def test_graph_gradients(rng):
     """Numeric vs analytic gradients through merge + multi-output."""
     import jax
+
+    with jax.enable_x64(True):
+        _graph_gradients_body(rng)
+
+
+def _graph_gradients_body(rng):
+    import jax
     import jax.numpy as jnp
 
-    jax.config.update("jax_enable_x64", True)
     conf = (
         NeuralNetConfiguration.Builder().seed(12345)
         .graph_builder()

@@ -148,3 +148,43 @@ def test_l2_regularization_shrinks_weights(rng):
     wa = np.abs(np.asarray(a.params["0"]["W"])).mean()
     wb = np.abs(np.asarray(b.params["0"]["W"])).mean()
     assert wb < wa
+
+
+def test_scan_fused_fit_matches_per_step(rng):
+    """The lax.scan multi-step path (k minibatches per dispatch) must
+    produce bitwise-identical params to the per-step path — same
+    updater trajectory, same per-iteration PRNG folding (dropout)."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.Builder().seed(7).learning_rate(0.05)
+            .updater("ADAM")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="relu",
+                              dropout=0.2))
+            .layer(OutputLayer(n_out=3))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    batches = [
+        DataSet(
+            features=rng.rand(10, 6).astype(np.float32),
+            labels=np.eye(3, dtype=np.float32)[rng.randint(0, 3, 10)],
+        )
+        for _ in range(7)
+    ]
+    a = build()
+    a.scan_chunk = 1  # forces the per-step path
+    for ds in batches:
+        a.fit_minibatch(ds)
+    b = build()
+    b.scan_chunk = 4  # chunks of 4 + 3
+    b.fit(batches)
+    assert a.iteration_count == b.iteration_count == 7
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(a.params[ln][pn]), np.asarray(b.params[ln][pn])
+            )
