@@ -3,5 +3,6 @@
 from deeplearning4j_tpu.eval.evaluation import (  # noqa: F401
     ConfusionMatrix,
     Evaluation,
+    Prediction,
     RegressionEvaluation,
 )

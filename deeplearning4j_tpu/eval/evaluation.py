@@ -36,14 +36,45 @@ class ConfusionMatrix:
         return int(self.matrix.sum())
 
 
+class Prediction:
+    """One prediction with its source-record metadata (reference
+    ``eval/meta/Prediction.java:1``)."""
+
+    def __init__(self, actual_class: int, predicted_class: int,
+                 record_meta_data=None):
+        self.actual_class = actual_class
+        self.predicted_class = predicted_class
+        self.record_meta_data = record_meta_data
+
+    def __repr__(self):
+        return (
+            f"Prediction(actualClass={self.actual_class},"
+            f"predictedClass={self.predicted_class},"
+            f"RecordMetaData={self.record_meta_data})"
+        )
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Prediction)
+            and self.actual_class == other.actual_class
+            and self.predicted_class == other.predicted_class
+            and self.record_meta_data == other.record_meta_data
+        )
+
+
 class Evaluation:
-    """Accuracy/precision/recall/F1 + confusion matrix."""
+    """Accuracy/precision/recall/F1 + confusion matrix; with record
+    metadata, per-prediction attribution (reference ``eval():202`` +
+    ``getPredictionErrors``/``getPredictionsByActualClass``)."""
 
     def __init__(self, n_classes: Optional[int] = None,
                  labels: Optional[List[str]] = None):
         self.labels = labels
         self.n_classes = n_classes or (len(labels) if labels else None)
         self.confusion: Optional[ConfusionMatrix] = None
+        # (actual, predicted) -> [Prediction]; populated only when
+        # record metadata is supplied (reference addToMetaConfusionMatrix)
+        self._meta: Dict[tuple, List[Prediction]] = defaultdict(list)
 
     def _ensure(self, n: int) -> None:
         if self.confusion is None:
@@ -51,11 +82,14 @@ class Evaluation:
             self.confusion = ConfusionMatrix(self.n_classes)
 
     def eval(self, labels: np.ndarray, predictions: np.ndarray,
-             mask: Optional[np.ndarray] = None) -> None:
+             mask: Optional[np.ndarray] = None,
+             record_meta_data: Optional[List] = None) -> None:
         """labels/predictions: one-hot or probability arrays,
         ``[batch, nClasses]`` or RNN ``[batch, nClasses, time]`` with
         optional ``[batch, time]`` mask (reference ``eval():190`` and
-        ``evalTimeSeries``)."""
+        ``evalTimeSeries``). ``record_meta_data`` (reference ``:202``):
+        one metadata object per example; predictions become queryable
+        via ``get_prediction_errors`` etc."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
         if labels.ndim == 3:
@@ -63,10 +97,16 @@ class Evaluation:
             b, c, t = labels.shape
             lab2 = labels.transpose(0, 2, 1).reshape(-1, c)
             pred2 = predictions.transpose(0, 2, 1).reshape(-1, c)
+            meta2 = (
+                [m for m in record_meta_data for _ in range(t)]
+                if record_meta_data is not None else None
+            )
             if mask is not None:
                 keep = np.asarray(mask).reshape(-1).astype(bool)
                 lab2, pred2 = lab2[keep], pred2[keep]
-            self.eval(lab2, pred2)
+                if meta2 is not None:
+                    meta2 = [m for m, k in zip(meta2, keep) if k]
+            self.eval(lab2, pred2, record_meta_data=meta2)
             return
         self._ensure(labels.shape[1])
         actual = labels.argmax(axis=1)
@@ -74,8 +114,46 @@ class Evaluation:
         if mask is not None:
             keep = np.asarray(mask).reshape(-1).astype(bool)
             actual, guess = actual[keep], guess[keep]
-        for a, g in zip(actual, guess):
-            self.confusion.add(int(a), int(g))
+            if record_meta_data is not None:
+                record_meta_data = [
+                    m for m, k in zip(record_meta_data, keep) if k
+                ]
+        for i, (a, g) in enumerate(zip(actual, guess)):
+            a, g = int(a), int(g)
+            self.confusion.add(a, g)
+            if record_meta_data is not None and i < len(record_meta_data):
+                self._meta[(a, g)].append(
+                    Prediction(a, g, record_meta_data[i])
+                )
+
+    # -- record-metadata queries (reference Evaluation meta methods) ----
+
+    def get_prediction_errors(self) -> List[Prediction]:
+        """All misclassified predictions (reference
+        ``getPredictionErrors``)."""
+        out: List[Prediction] = []
+        for (a, g), preds in sorted(self._meta.items()):
+            if a != g:
+                out.extend(preds)
+        return out
+
+    def get_predictions_by_actual_class(self, c: int) -> List[Prediction]:
+        out: List[Prediction] = []
+        for (a, _), preds in sorted(self._meta.items()):
+            if a == c:
+                out.extend(preds)
+        return out
+
+    def get_predictions_by_predicted_class(self, c: int) -> List[Prediction]:
+        out: List[Prediction] = []
+        for (_, g), preds in sorted(self._meta.items()):
+            if g == c:
+                out.extend(preds)
+        return out
+
+    def get_predictions(self, actual: int, predicted: int
+                        ) -> List[Prediction]:
+        return list(self._meta.get((actual, predicted), ()))
 
     # -- metrics -------------------------------------------------------
 
@@ -116,6 +194,8 @@ class Evaluation:
             return self
         self._ensure(other.n_classes)
         self.confusion.matrix += other.confusion.matrix
+        for key, preds in other._meta.items():
+            self._meta[key].extend(preds)
         return self
 
     def stats(self) -> str:

@@ -14,10 +14,29 @@ part 3).
 """
 
 from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    CharTokenizerFactory,
     CollectionSentenceIterator,
     DefaultTokenizerFactory,
     FileSentenceIterator,
     LineSentenceIterator,
+    NGramTokenizerFactory,
+    RegexTokenizerFactory,
+    register_tokenizer_factory,
+    tokenizer_factory,
+)
+from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
+    BagOfWordsVectorizer,
+    TfidfVectorizer,
+)
+from deeplearning4j_tpu.nlp.inverted_index import InvertedIndex  # noqa: F401
+from deeplearning4j_tpu.nlp.static_word2vec import (  # noqa: F401
+    StaticWord2Vec,
+    save_static,
+)
+from deeplearning4j_tpu.nlp.model_utils import (  # noqa: F401
+    BasicModelUtils,
+    FlatModelUtils,
+    TreeModelUtils,
 )
 from deeplearning4j_tpu.nlp.vocab import (  # noqa: F401
     Huffman,

@@ -145,11 +145,16 @@ class Glove:
         self._normalized: Optional[np.ndarray] = None
         self.last_loss = float("nan")
 
+    def _put(self, a):
+        """Batch-array placement hook — ClusterGlove overrides this to
+        shard over the mesh 'data' axis."""
+        return jnp.asarray(a)
+
     def fit(self) -> "Glove":
         rows, cols, vals = self.co.triples()
         if len(rows) == 0:
             raise ValueError("Empty co-occurrence matrix")
-        logx = np.log(vals)
+        logx = np.log(vals).astype(np.float32)
         fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(
             np.float32
         )
@@ -173,9 +178,9 @@ class Glove:
                     fb = np.pad(fb, (0, pad))
                 self._state, loss = _glove_step(
                     self._state,
-                    jnp.asarray(rb), jnp.asarray(cb),
-                    jnp.asarray(lb), jnp.asarray(fb),
-                    jnp.asarray(mask), lr,
+                    self._put(rb), self._put(cb),
+                    self._put(lb), self._put(fb),
+                    self._put(mask), lr,
                 )
                 epoch_losses.append(loss)  # device scalar; no sync
             self.last_loss = float(

@@ -85,6 +85,71 @@ class NGramTokenizerFactory(DefaultTokenizerFactory):
 
 
 # ---------------------------------------------------------------------------
+# TokenizerFactory registry — the SPI seam the reference fills with
+# per-language modules (deeplearning4j-nlp-japanese's Kuromoji
+# JapaneseTokenizer, -korean's KoreanTokenizer, -uima's UimaTokenizer).
+# Those vendor third-party analyzers (6.9k LoC of Kuromoji); here the
+# seam is an explicit registry: plug any object with
+# ``create(text) -> Tokenizer`` and select it by name wherever a
+# tokenizer_factory is accepted.
+# ---------------------------------------------------------------------------
+
+_TOKENIZER_REGISTRY: dict = {}
+
+
+def register_tokenizer_factory(name: str, factory_cls) -> None:
+    """Register a TokenizerFactory class under a language/name key
+    (e.g. 'japanese' -> a Kuromoji-backed implementation)."""
+    if not callable(factory_cls):
+        raise TypeError("factory_cls must be callable (class or factory)")
+    _TOKENIZER_REGISTRY[name.lower()] = factory_cls
+
+
+def tokenizer_factory(name: str = "default", **kwargs):
+    """Instantiate a registered TokenizerFactory by name."""
+    key = name.lower()
+    if key not in _TOKENIZER_REGISTRY:
+        raise KeyError(
+            f"no TokenizerFactory registered under {name!r}; known: "
+            f"{sorted(_TOKENIZER_REGISTRY)}"
+        )
+    return _TOKENIZER_REGISTRY[key](**kwargs)
+
+
+class RegexTokenizerFactory(DefaultTokenizerFactory):
+    """Split on a regex (covers the reference's PosUimaTokenizer-style
+    customization without UIMA)."""
+
+    def __init__(self, pattern: str = r"\s+"):
+        super().__init__()
+        self._re = re.compile(pattern)
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(
+            [t for t in self._re.split(text) if t], self._pre
+        )
+
+
+class CharTokenizerFactory(DefaultTokenizerFactory):
+    """Character-level tokens — a working default for unsegmented CJK
+    text until a morphological analyzer is registered (the honest
+    stand-in for the vendored Kuromoji)."""
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer([c for c in text if not c.isspace()], self._pre)
+
+
+register_tokenizer_factory("default", DefaultTokenizerFactory)
+register_tokenizer_factory("ngram", NGramTokenizerFactory)
+register_tokenizer_factory("regex", RegexTokenizerFactory)
+register_tokenizer_factory("char", CharTokenizerFactory)
+# CJK entries default to character segmentation; replace via
+# register_tokenizer_factory with a real analyzer when available.
+register_tokenizer_factory("japanese", CharTokenizerFactory)
+register_tokenizer_factory("korean", CharTokenizerFactory)
+
+
+# ---------------------------------------------------------------------------
 # Sentence iterators (reference text/sentenceiterator)
 # ---------------------------------------------------------------------------
 

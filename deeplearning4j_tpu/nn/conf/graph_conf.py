@@ -582,8 +582,11 @@ def _infer_shapes(
     conf: ComputationGraphConfiguration,
 ) -> ComputationGraphConfiguration:
     """Propagate InputTypes through the topo order, filling each layer
-    vertex's nIn (reference ``GraphBuilder.setInputTypes`` +
-    ``addPreProcessors``)."""
+    vertex's nIn and auto-inserting shape preprocessors where the
+    incoming activation family mismatches the layer family (reference
+    ``GraphBuilder.setInputTypes`` + ``addPreProcessors``)."""
+    from deeplearning4j_tpu.nn.conf.multi_layer import _auto_preprocessor
+
     types: Dict[str, InputType] = dict(
         zip(conf.inputs, conf.input_types or ())
     )
@@ -597,6 +600,11 @@ def _infer_shapes(
             it = in_types[0]
             if v.preprocessor is not None:
                 it = v.preprocessor.output_type(it)
+            else:
+                auto = _auto_preprocessor(it, v.layer_conf.input_kind())
+                if auto is not None:
+                    v = dataclasses.replace(v, preprocessor=auto)
+                    it = auto.output_type(it)
             layer = v.layer_conf.with_input_type(it)
             v = dataclasses.replace(v, layer_conf=layer)
             new_vertices[name] = v

@@ -54,6 +54,8 @@ class ComputationGraph:
         self._last_score = float("nan")
         self.listeners: List[Any] = []
         self._jit_step = None
+        self._jit_multi_step = None
+        self.scan_chunk = 16  # minibatches fused per dispatch
         self._jit_output = None
         self._base_key = jax.random.PRNGKey(conf.seed)
 
@@ -213,6 +215,146 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _build_multi_step(self):
+        """k optimizer steps fused into one XLA dispatch via lax.scan
+        (same design as ``MultiLayerNetwork._build_multi_step`` — the
+        per-step host->device transfers of lr/t/rng are what bound
+        small-step throughput)."""
+        updater = self.updater_def
+
+        def body(carry, per_step):
+            params, upd_state, state = carry
+            inputs, labels, lmasks, fmasks, lrs, t, rng = per_step
+
+            def loss_fn(p):
+                s, new_state = self._score_pure(
+                    p, state, inputs, labels, lmasks, rng, train=True,
+                    fmasks=fmasks,
+                )
+                return s, new_state
+
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            new_params, new_upd = updater.update(
+                grads, upd_state, params, lrs, t
+            )
+            return (new_params, new_upd, new_state), score
+
+        def multi_step(params, upd_state, state, xs, ys, lmasks, fmasks,
+                       lr_stack, it0, base_key):
+            k = xs[0].shape[0]
+            ts = (it0 + 1 + jnp.arange(k)).astype(jnp.float32)
+            rngs = jax.vmap(
+                lambda i: jax.random.fold_in(base_key, i)
+            )(it0 + jnp.arange(k))
+            (params, upd_state, state), scores = jax.lax.scan(
+                body, (params, upd_state, state),
+                (xs, ys, lmasks, fmasks, lr_stack, ts, rngs),
+            )
+            return params, upd_state, state, scores
+
+        return jax.jit(multi_step, donate_argnums=(0, 1, 2))
+
+    def _can_scan_steps(self) -> bool:
+        return (
+            self.conf.iterations == 1
+            and not any(
+                self.conf.vertices[n].layer_conf.is_recurrent()
+                for n in self.layer_vertex_names
+            )
+            and all(
+                getattr(l, "supports_batched_iterations", False)
+                for l in self.listeners
+            )
+        )
+
+    def _ds_scan_sig(self, ds) -> tuple:
+        def sh(v):
+            return tuple(
+                None if a is None else np.asarray(a).shape
+                for a in v
+            ) if v else None
+        f, l, fm, lm = self._ds_arrays(ds)
+        return (sh(f), sh(l), sh(fm or []), sh(lm or []))
+
+    def _ds_arrays(self, ds):
+        features = _as_list(getattr(ds, "features"))
+        labels = _as_list(getattr(ds, "labels"))
+        fmasks = _as_list(getattr(ds, "features_masks", None)
+                          or getattr(ds, "features_mask", None))
+        lmasks = _as_list(getattr(ds, "labels_masks", None)
+                          or getattr(ds, "labels_mask", None))
+        return features, labels, fmasks or None, lmasks or None
+
+    def _fit_epoch_scan(self, it) -> int:
+        buf: list = []
+        sig = None
+        n = 0
+        for ds in it:
+            s = self._ds_scan_sig(ds)
+            if buf and s != sig:
+                self._flush_scan_chunk(buf)
+                buf = []
+            sig = s
+            buf.append(ds)
+            n += 1
+            if len(buf) >= self.scan_chunk:
+                self._flush_scan_chunk(buf)
+                buf = []
+        if buf:
+            self._flush_scan_chunk(buf)
+        return n
+
+    def _flush_scan_chunk(self, batches: list) -> None:
+        if len(batches) == 1:
+            self.fit_minibatch(batches[0])
+            return
+        dtype = self._dtype()
+        k = len(batches)
+        rows = [self._ds_arrays(b) for b in batches]
+
+        def stack_lists(idx):
+            first = rows[0][idx]
+            if first is None:
+                return None
+            return [
+                None if first[j] is None else jnp.asarray(
+                    np.stack([np.asarray(r[idx][j]) for r in rows]), dtype
+                )
+                for j in range(len(first))
+            ]
+
+        xs = stack_lists(0)
+        ys = stack_lists(1)
+        fmasks = stack_lists(2)
+        lmasks = stack_lists(3)
+        it0 = self.iteration_count
+        lr_rows = [
+            self.updater_def.scheduled_lrs(it0 + i) for i in range(k)
+        ]
+        lr_stack = {
+            ln: jnp.asarray([row[ln] for row in lr_rows], jnp.float32)
+            for ln in self.updater_def.settings
+        }
+        if self._jit_multi_step is None:
+            self._jit_multi_step = self._build_multi_step()
+        (
+            self.params, self.updater_state, self.state, scores,
+        ) = self._jit_multi_step(
+            self.params, self.updater_state, self.state,
+            xs, ys, lmasks, fmasks, lr_stack,
+            jnp.asarray(it0, jnp.int32), self._base_key,
+        )
+        self.iteration_count += k
+        self._last_score = scores[-1]
+        if self.listeners:
+            for i in range(k):
+                self._last_score = scores[i]
+                for listener in self.listeners:
+                    listener.iteration_done(self, it0 + i + 1)
+            self._last_score = scores[-1]
+
     # ------------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1) -> None:
@@ -235,10 +377,13 @@ class ComputationGraph:
         if self.params is None:
             self.init()
         for epoch in range(epochs):
-            n = 0
-            for ds in iter(iterator):
-                self.fit_minibatch(ds)
-                n += 1
+            if self._can_scan_steps() and self.scan_chunk > 1:
+                n = self._fit_epoch_scan(iter(iterator))
+            else:
+                n = 0
+                for ds in iter(iterator):
+                    self.fit_minibatch(ds)
+                    n += 1
             if epoch > 0 and n == 0:
                 raise ValueError(
                     "Iterator yielded no batches after the first epoch — "

@@ -75,6 +75,7 @@ class MultiLayerNetwork:
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep state
         self._jit_step = None
         self._jit_multi_step = None
+        self._solver = None  # lazily built for LBFGS/CG/line-search
         self.scan_chunk = 16  # minibatches fused per dispatch
         self._jit_output = None
         self._jit_rnn_step = None
@@ -231,6 +232,11 @@ class MultiLayerNetwork:
         """
         updater = self.updater_def
 
+        recurrent_names = [
+            name for name, layer in zip(self.layer_names, self.conf.layers)
+            if layer.is_recurrent()
+        ]
+
         def body(carry, per_step):
             params, upd_state, state = carry
             x, labels, mask, fmask, lrs, t, rng = per_step
@@ -247,6 +253,11 @@ class MultiLayerNetwork:
             new_params, new_upd = updater.update(
                 grads, upd_state, params, lrs, t
             )
+            # standard-backprop semantics: recurrent carry resets per
+            # minibatch (_reset_recurrent_state) — keep the carry
+            # structure constant by restoring the empty input entries
+            for name in recurrent_names:
+                new_state[name] = state[name]
             return (new_params, new_upd, new_state), score
 
         def multi_step(params, upd_state, state, xs, ys, masks, fmasks,
@@ -265,19 +276,21 @@ class MultiLayerNetwork:
         return jax.jit(multi_step, donate_argnums=(0, 1, 2))
 
     def _can_scan_steps(self) -> bool:
-        """Scan-fused fitting applies to stateless-per-batch nets:
-        recurrent carry is reset between minibatches (pytree structure
-        changes), so RNNs keep the per-step path/TBPTT. Listeners that
-        time individual iterations would observe k near-simultaneous
-        callbacks, so attached listeners also force the per-step path
-        unless they declare ``supports_batched_iterations = True``
-        (e.g. averaging listeners like the reference
-        PerformanceListener pattern)."""
+        """Scan-fused fitting applies when per-minibatch semantics are
+        stateless: standard backprop (recurrent carry resets each
+        minibatch — the scan body restores the empty entries), not
+        TBPTT (whose carry threads across host-side chunks). Listeners
+        that time individual iterations would observe k
+        near-simultaneous callbacks, so attached listeners also force
+        the per-step path unless they declare
+        ``supports_batched_iterations = True`` (e.g. averaging
+        listeners like the reference PerformanceListener pattern)."""
         return (
             self.conf.iterations == 1
             and self.conf.backprop
             and self.conf.backprop_type != "TruncatedBPTT"
-            and not any(l.is_recurrent() for l in self.conf.layers)
+            and self.conf.optimization_algo
+            == "STOCHASTIC_GRADIENT_DESCENT"
             and all(
                 getattr(l, "supports_batched_iterations", False)
                 for l in self.listeners
@@ -296,6 +309,7 @@ class MultiLayerNetwork:
     def _fit_epoch_scan(self, it) -> int:
         """Buffer same-shaped minibatches into chunks of
         ``self.scan_chunk`` and run each chunk as one fused dispatch."""
+        self._reset_recurrent_state()  # scan carries empty rnn entries
         buf: List[Any] = []
         sig = None
         n = 0
@@ -420,9 +434,29 @@ class MultiLayerNetwork:
 
     def fit_minibatch(self, ds) -> float:
         """One minibatch through ``conf.iterations`` optimizer steps
-        (reference Solver/StochasticGradientDescent.optimize)."""
+        (reference Solver/StochasticGradientDescent.optimize; LBFGS/
+        ConjugateGradient/LineGradientDescent route through
+        ``optimize.solvers.Solver``)."""
         if self.params is None:
             self.init()
+        if self.conf.optimization_algo != "STOCHASTIC_GRADIENT_DESCENT":
+            from deeplearning4j_tpu.optimize.solvers import (
+                Solver,
+                is_solver_algo,
+            )
+
+            if is_solver_algo(self.conf.optimization_algo):
+                if self._solver is None:
+                    self._solver = Solver(self)
+                return self._solver.optimize(
+                    ds.features, ds.labels,
+                    mask=getattr(ds, "labels_mask", None),
+                    fmask=getattr(ds, "features_mask", None),
+                )
+            raise ValueError(
+                "Unknown optimization_algo "
+                f"'{self.conf.optimization_algo}'"
+            )
         if self._jit_step is None:
             self._jit_step = self._build_step()
         dtype = _dtype_of(self.conf)

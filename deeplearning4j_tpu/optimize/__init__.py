@@ -1,5 +1,10 @@
 """Optimization & listeners (reference ``optimize/**``)."""
 
+from deeplearning4j_tpu.optimize.solvers import (  # noqa: F401
+    Solver,
+    backtrack_line_search,
+    is_solver_algo,
+)
 from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     CollectScoresIterationListener,
     ComposableIterationListener,

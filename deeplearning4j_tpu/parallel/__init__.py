@@ -18,6 +18,12 @@ from deeplearning4j_tpu.parallel.cluster import (  # noqa: F401
     TrainingWorker,
     batch_and_export_datasets,
 )
+from deeplearning4j_tpu.parallel.cluster_nlp import (  # noqa: F401
+    ClusterGlove,
+    ClusterSequenceVectors,
+    ClusterWord2Vec,
+    TextPipeline,
+)
 from deeplearning4j_tpu.parallel.sequence import (  # noqa: F401
     attention,
     build_seq_mesh,

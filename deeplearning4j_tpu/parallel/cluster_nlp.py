@@ -1,0 +1,189 @@
+"""Cluster-scale NLP training — the dl4j-spark-nlp analog (reference
+``spark/dl4j-spark-nlp``: ``TextPipeline.java:1`` accumulator-built
+vocab, ``spark/models/embeddings/word2vec/Word2Vec.java:1``
+map-partitions training with accumulator-merged updates,
+``glove/Glove.java`` + ``CoOccurrenceCalculator``).
+
+TPU-native realization: where Spark shards sentences across executors
+and merges per-partition vocab counters / parameter updates over the
+shuffle network, here
+
+- the **vocab build** shards the corpus into partitions counted
+  independently and merged (the accumulator pattern, host-side), and
+- the **training batch axis is sharded over the mesh 'data' axis**:
+  the same fused skip-gram/CBOW/GloVe XLA steps run SPMD, with XLA
+  inserting the gradient ``psum`` over ICI that Spark performed as an
+  RDD aggregate. Updates are dense and synchronous, so the result is
+  bitwise-equal to single-device training on the same batches — the
+  equivalence Spark's parameter averaging only approximates.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import SequenceVectors, Word2Vec
+from deeplearning4j_tpu.parallel.mesh import (
+    batch_sharding,
+    build_mesh,
+    replicated,
+)
+
+
+class TextPipeline:
+    """Partitioned vocab construction (reference ``TextPipeline.java``:
+    tokenize + per-partition word counts merged through Spark
+    accumulators). Counting runs one task per partition and merges the
+    partial Counters — the accumulator merge — so behavior matches the
+    reference pipeline shape; on one host the tasks run on a thread
+    pool (corpus IO dominates; the merge semantics are what carry to
+    multi-host)."""
+
+    def __init__(self, min_word_frequency: int = 1,
+                 tokenizer_factory=None, n_partitions: int = 4):
+        self.min_word_frequency = min_word_frequency
+        self.tokenizer_factory = tokenizer_factory
+        self.n_partitions = max(int(n_partitions), 1)
+
+    def _tokens_of(self, sentence) -> List[str]:
+        if isinstance(sentence, str):
+            if self.tokenizer_factory is not None:
+                return self.tokenizer_factory.create(
+                    sentence
+                ).get_tokens()
+            return sentence.split()
+        return list(sentence)
+
+    def build_vocab(self, sentences: Iterable) -> VocabCache:
+        corpus = [self._tokens_of(s) for s in sentences]
+        parts = [
+            corpus[i::self.n_partitions] for i in range(self.n_partitions)
+        ]
+
+        def count(part) -> Counter:
+            c: Counter = Counter()
+            for toks in part:
+                c.update(toks)
+            return c
+
+        with ThreadPoolExecutor(max_workers=self.n_partitions) as ex:
+            partials = list(ex.map(count, parts))
+        merged: Counter = Counter()
+        for c in partials:  # accumulator merge
+            merged.update(c)
+        cache = VocabCache()
+        for word, n in sorted(
+            merged.items(), key=lambda kv: (-kv[1], kv[0])
+        ):
+            if n >= self.min_word_frequency:
+                cache.add(VocabWord(word, n))
+        cache.total_word_count = sum(w.count for w in cache.words)
+        return cache
+
+    def to_id_sequences(self, sentences: Iterable,
+                        cache: VocabCache) -> List[np.ndarray]:
+        return [
+            np.asarray(
+                [cache.index_of(t) for t in self._tokens_of(s)
+                 if t in cache],
+                np.int32,
+            )
+            for s in sentences
+        ]
+
+
+class _MeshBatchMixin:
+    """Shards the minibatch arrays over the mesh 'data' axis and keeps
+    the embedding tables replicated; the inherited jitted steps then
+    compile to SPMD programs with XLA-inserted gradient psum."""
+
+    def _init_mesh(self, mesh) -> None:
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self._batch_sharding = batch_sharding(self.mesh)
+        self._rep = replicated(self.mesh)
+        dp = self.mesh.shape["data"]
+        if self.batch_size % dp:
+            # round the pair-batch up so it splits over 'data'
+            self.batch_size += dp - self.batch_size % dp
+
+    def _shard_batch(self, a):
+        return jax.device_put(np.asarray(a), self._batch_sharding)
+
+    def _replicate_tables(self) -> None:
+        lk = self.lookup
+        lk.syn0 = jax.device_put(lk.syn0, self._rep)
+        if lk.syn1 is not None:
+            lk.syn1 = jax.device_put(lk.syn1, self._rep)
+        if lk.syn1neg is not None:
+            lk.syn1neg = jax.device_put(lk.syn1neg, self._rep)
+
+    def _apply_batch(self, centers, contexts, mask, alpha, step):
+        super()._apply_batch(
+            self._shard_batch(centers), self._shard_batch(contexts),
+            self._shard_batch(mask), alpha, step,
+        )
+
+    def _apply_cbow_batch(self, targets, ctx_ids, ctx_mask, mask, alpha,
+                          step):
+        super()._apply_cbow_batch(
+            self._shard_batch(targets), self._shard_batch(ctx_ids),
+            self._shard_batch(ctx_mask), self._shard_batch(mask),
+            alpha, step,
+        )
+
+
+class ClusterWord2Vec(_MeshBatchMixin, Word2Vec):
+    """Data-parallel Word2Vec over a device mesh (reference
+    ``spark/models/embeddings/word2vec/Word2Vec.java`` — Spark's
+    FirstIterationFunction/SecondIterationFunction become one SPMD
+    program over the 'data' axis)."""
+
+    def __init__(self, cache, sentences_ids, mesh=None, **kw):
+        super().__init__(cache, sentences_ids, **kw)
+        self._init_mesh(mesh)
+        self._replicate_tables()
+
+
+class ClusterSequenceVectors(_MeshBatchMixin, SequenceVectors):
+    """Mesh-sharded generic SequenceVectors (DeepWalk-style callers)."""
+
+    def __init__(self, cache, sequences: Sequence[np.ndarray],
+                 mesh=None, **kw):
+        super().__init__(cache, **kw)
+        self._seqs = list(sequences)
+        self._init_mesh(mesh)
+        self._replicate_tables()
+
+    def _sequences(self):
+        return iter(self._seqs)
+
+
+class ClusterGlove(Glove):
+    """Data-parallel GloVe (reference ``spark/glove/Glove.java`` +
+    ``CoOccurrenceCalculator`` — the co-occurrence count is the
+    TextPipeline-partitioned host pass; the AdaGrad batch step runs
+    SPMD over the 'data' axis)."""
+
+    def __init__(self, cache, id_sequences, mesh=None, **kw):
+        super().__init__(cache, id_sequences, **kw)
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self._batch_sharding = batch_sharding(self.mesh)
+        rep = replicated(self.mesh)
+        dp = self.mesh.shape["data"]
+        if self.batch_size % dp:
+            self.batch_size += dp - self.batch_size % dp
+        self._state = tuple(
+            jax.device_put(s, rep) for s in self._state
+        )
+
+    def _put(self, a):
+        """Shard the AdaGrad batch arrays over 'data' — the parent
+        ``Glove.fit`` loop then compiles to the SPMD program."""
+        return jax.device_put(np.asarray(a), self._batch_sharding)

@@ -1,0 +1,13 @@
+"""Streaming ingestion + model serving (reference ``dl4j-streaming``:
+``streaming/kafka/NDArrayKafkaClient.java:1`` / ``NDArrayPublisher`` /
+``NDArrayConsumer`` and the Camel model-serving route
+``routes/DL4jServeRouteBuilder.java:1``)."""
+
+from deeplearning4j_tpu.streaming.ndarray_stream import (  # noqa: F401
+    NDArrayConsumer,
+    NDArrayPublisher,
+    StreamingDataSetIterator,
+    decode_ndarray_message,
+    encode_ndarray_message,
+)
+from deeplearning4j_tpu.streaming.serve import ModelServer  # noqa: F401

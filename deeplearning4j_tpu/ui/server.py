@@ -1,11 +1,14 @@
 """Browser training UI (reference ``deeplearning4j-play``:
-``PlayUIServer.java:48`` — port 9000, overridable; TrainModule
-overview page; ``RemoteReceiverModule`` accepting remote-posted stats;
-``RemoteUIStatsStorageRouter`` posting them over HTTP).
+``PlayUIServer.java:48`` — port 9000, overridable; ``TrainModule.java:1``
+overview/model/system pages; ``HistogramModule`` per-layer param/update
+charts; ``TsneModule`` embedding scatter; ``RemoteReceiverModule``
+accepting remote-posted stats; ``RemoteUIStatsStorageRouter`` posting
+them over HTTP).
 
 The Play framework is replaced by a stdlib ``http.server`` thread:
-JSON endpoints + one self-contained overview page (inline SVG chart,
-no external assets)."""
+JSON endpoints + one self-contained page (inline SVG charts, no
+external assets) with Overview / Histograms / Model / System / t-SNE
+sections fed by the data StatsListener already records."""
 
 from __future__ import annotations
 
@@ -38,50 +41,185 @@ _PAGE = """<!DOCTYPE html>
  table { border-collapse: collapse; }
  td, th { border: 1px solid #ddd; padding: 4px 10px; font-size: 0.9em; }
  svg { background: #fafafa; border: 1px solid #eee; }
+ nav a { margin-right: 1em; cursor: pointer; color: #06c;
+         text-decoration: underline; }
+ select { margin-bottom: 0.6em; }
 </style></head>
 <body>
-<h1>deeplearning4j_tpu &mdash; Training Overview</h1>
-<div class="card"><h2>Score vs. Iteration</h2>
- <svg id="chart" width="820" height="260"></svg></div>
-<div class="card"><h2>Model</h2><table id="model"></table></div>
-<div class="card"><h2>System</h2><table id="system"></table></div>
+<h1>deeplearning4j_tpu &mdash; Training UI</h1>
+<nav>
+ <a data-tab="overview">Overview</a><a data-tab="histograms">Histograms</a>
+ <a data-tab="model">Model</a><a data-tab="system">System</a>
+ <a data-tab="tsne">t-SNE</a>
+</nav>
+<div id="tab-overview">
+ <div class="card"><h2>Score vs. Iteration</h2>
+  <svg id="chart" width="820" height="260"></svg></div>
+</div>
+<div id="tab-histograms" style="display:none">
+ <div class="card"><h2>Parameter Histogram</h2>
+  <select id="hkey"></select>
+  <svg id="hist" width="820" height="220"></svg></div>
+ <div class="card"><h2>Mean Magnitudes vs. Iteration</h2>
+  <svg id="mm" width="820" height="220"></svg>
+  <div id="mmlegend" style="font-size:0.85em"></div></div>
+</div>
+<div id="tab-model" style="display:none">
+ <div class="card"><h2>Model</h2><table id="model"></table></div>
+ <div class="card"><h2>Layers</h2><table id="layers"></table></div>
+</div>
+<div id="tab-system" style="display:none">
+ <div class="card"><h2>System</h2><table id="system"></table></div>
+ <div class="card"><h2>Host RSS (MB) vs. Iteration</h2>
+  <svg id="rss" width="820" height="200"></svg></div>
+</div>
+<div id="tab-tsne" style="display:none">
+ <div class="card"><h2>t-SNE Embedding</h2>
+  <svg id="tsneplot" width="820" height="540"></svg>
+  <p>POST JSON {"vectors": [[...]], "labels": [...]} to /tsne/post
+     to (re)compute.</p></div>
+</div>
 <script>
+const $ = (id) => document.getElementById(id);
+document.querySelectorAll('nav a').forEach(a => a.onclick = () => {
+  for (const t of ['overview','histograms','model','system','tsne'])
+    $('tab-'+t).style.display = (t === a.dataset.tab) ? '' : 'none';
+});
+function line(svg, xs, series, colors) {
+  // series: [[y...], ...] multi-line chart with shared scale
+  svg.innerHTML = '';
+  const W = +svg.getAttribute('width'), H = +svg.getAttribute('height');
+  const P = 34;
+  const all = series.flat().filter(v => v !== null && isFinite(v));
+  if (xs.length < 2 || !all.length) return;
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...all), ymaxR = Math.max(...all);
+  const ymax = ymaxR === ymin ? ymin + 1 : ymaxR;
+  series.forEach((ys, si) => {
+    const pts = xs.map((x, i) => [x, ys[i]])
+      .filter(([x, y]) => y !== null && isFinite(y))  // skip null gaps
+      .map(([x, y]) =>
+      ((P + (x - xmin) / (xmax - xmin || 1) * (W - 2*P)) + ',' +
+       (H - P - (y - ymin) / (ymax - ymin) * (H - 2*P)))).join(' ');
+    const pl = document.createElementNS('http://www.w3.org/2000/svg',
+                                        'polyline');
+    pl.setAttribute('fill', 'none');
+    pl.setAttribute('stroke', colors[si % colors.length]);
+    pl.setAttribute('stroke-width', '1.5');
+    pl.setAttribute('points', pts);
+    svg.append(pl);
+  });
+  const t1 = document.createElementNS('http://www.w3.org/2000/svg','text');
+  t1.setAttribute('x', 4); t1.setAttribute('y', 14);
+  t1.setAttribute('font-size', 11); t1.textContent = ymaxR.toFixed(4);
+  const t2 = document.createElementNS('http://www.w3.org/2000/svg','text');
+  t2.setAttribute('x', 4); t2.setAttribute('y', H - 8);
+  t2.setAttribute('font-size', 11); t2.textContent = ymin.toFixed(4);
+  svg.append(t1, t2);
+}
+function bars(svg, h) {
+  svg.innerHTML = '';
+  if (!h || !h.counts || !h.counts.length) return;
+  const W = +svg.getAttribute('width'), H = +svg.getAttribute('height');
+  const P = 24, n = h.counts.length, cmax = Math.max(...h.counts) || 1;
+  const bw = (W - 2*P) / n;
+  h.counts.forEach((c, i) => {
+    const r = document.createElementNS('http://www.w3.org/2000/svg','rect');
+    const bh = (H - 2*P) * c / cmax;
+    r.setAttribute('x', P + i*bw + 1); r.setAttribute('width', bw - 2);
+    r.setAttribute('y', H - P - bh); r.setAttribute('height', bh);
+    r.setAttribute('fill', '#06c');
+    svg.append(r);
+  });
+  const t = document.createElementNS('http://www.w3.org/2000/svg','text');
+  t.setAttribute('x', 4); t.setAttribute('y', H - 6);
+  t.setAttribute('font-size', 11);
+  t.textContent = h.min.toFixed(3) + ' .. ' + h.max.toFixed(3);
+  svg.append(t);
+}
+const fill = (id, obj) => {
+  const table = $(id);
+  table.textContent = '';
+  for (const [k, v] of Object.entries(obj || {})) {
+    const tr = document.createElement('tr');
+    const th = document.createElement('th');
+    th.textContent = k;                  // textContent: no HTML
+    const td = document.createElement('td');
+    td.textContent = String(v);          // injection from records
+    tr.append(th, td); table.append(tr);
+  }
+};
+const COLORS = ['#06c','#c33','#2a2','#a3c','#f80','#088','#880'];
+let histKey = null;
+$('hkey').onchange = () => { histKey = $('hkey').value; };
 async function refresh() {
   const sessions = await (await fetch('train/sessions')).json();
   if (!sessions.length) return;
   const sid = sessions[sessions.length - 1];
   const d = await (await fetch('train/overview?sid=' + sid)).json();
-  const svg = document.getElementById('chart');
-  const xs = d.iterations, ys = d.scores;
-  svg.innerHTML = '';
-  if (xs.length > 1) {
-    const W = 820, H = 260, P = 34;
-    const xmin = Math.min(...xs), xmax = Math.max(...xs);
-    const yminRaw = Math.min(...ys), ymaxRaw = Math.max(...ys);
-    const ymin = yminRaw, ymax = ymaxRaw === yminRaw ? yminRaw+1 : ymaxRaw;
-    const pts = xs.map((x, i) =>
-      ((P + (x - xmin) / (xmax - xmin || 1) * (W - 2*P)) + ',' +
-       (H - P - (ys[i] - ymin) / (ymax - ymin) * (H - 2*P)))).join(' ');
-    svg.innerHTML =
-      '<polyline fill="none" stroke="#06c" stroke-width="1.5" points="'
-      + pts + '"/>' +
-      '<text x="4" y="14" font-size="11">' + ymaxRaw.toFixed(4) +
-      '</text><text x="4" y="' + (H - 8) + '" font-size="11">' +
-      yminRaw.toFixed(4) + '</text>';
-  }
-  const fill = (id, obj) => {
-    const table = document.getElementById(id);
-    table.textContent = '';
-    for (const [k, v] of Object.entries(obj || {})) {
-      const tr = document.createElement('tr');
-      const th = document.createElement('th');
-      th.textContent = k;                  // textContent: no HTML
-      const td = document.createElement('td');
-      td.textContent = String(v);          // injection from records
-      tr.append(th, td); table.append(tr);
-    }
-  };
+  line($('chart'), d.iterations, [d.scores], COLORS);
   fill('model', d.model); fill('system', d.system);
+
+  const h = await (await fetch('train/histograms?sid=' + sid)).json();
+  const keys = Object.keys(h.latest_histograms || {});
+  const sel = $('hkey');
+  if (sel.options.length !== keys.length) {
+    sel.textContent = '';
+    keys.forEach(k => {
+      const o = document.createElement('option');
+      o.value = k; o.textContent = k; sel.append(o);
+    });
+  }
+  if (!histKey || !keys.includes(histKey)) histKey = keys[0];
+  if (histKey) { sel.value = histKey; bars($('hist'),
+                                          h.latest_histograms[histKey]); }
+  const mmKeys = Object.keys(h.param_mean_magnitudes || {});
+  line($('mm'), h.iterations,
+       mmKeys.map(k => h.param_mean_magnitudes[k]), COLORS);
+  $('mmlegend').textContent = mmKeys.map(
+    (k, i) => k + ' (' + COLORS[i % COLORS.length] + ')').join('   ');
+
+  const m = await (await fetch('train/model?sid=' + sid)).json();
+  const lt = $('layers');
+  lt.textContent = '';
+  (m.layers || []).forEach(row => {
+    const tr = document.createElement('tr');
+    row.forEach(v => {
+      const td = document.createElement('td');
+      td.textContent = String(v); tr.append(td);
+    });
+    lt.append(tr);
+  });
+
+  const s = await (await fetch('train/system?sid=' + sid)).json();
+  line($('rss'), s.iterations, [s.rss_mb], COLORS);
+
+  const t = await (await fetch('train/tsne')).json();
+  const svg = $('tsneplot');
+  svg.innerHTML = '';
+  if (t.coords && t.coords.length) {
+    const W = 820, H = 540, P = 20;
+    const xs = t.coords.map(c => c[0]), ys = t.coords.map(c => c[1]);
+    const xmin = Math.min(...xs), xmax = Math.max(...xs) || xmin + 1;
+    const ymin = Math.min(...ys), ymax = Math.max(...ys) || ymin + 1;
+    t.coords.forEach((c, i) => {
+      const g = document.createElementNS('http://www.w3.org/2000/svg',
+                                         'circle');
+      g.setAttribute('cx', P + (c[0]-xmin)/(xmax-xmin||1)*(W-2*P));
+      g.setAttribute('cy', H - P - (c[1]-ymin)/(ymax-ymin||1)*(H-2*P));
+      g.setAttribute('r', 3); g.setAttribute('fill', '#06c');
+      svg.append(g);
+      if (t.labels && t.labels[i] !== undefined) {
+        const tx = document.createElementNS(
+          'http://www.w3.org/2000/svg', 'text');
+        tx.setAttribute('x', +g.getAttribute('cx') + 5);
+        tx.setAttribute('y', +g.getAttribute('cy') + 4);
+        tx.setAttribute('font-size', 10);
+        tx.textContent = String(t.labels[i]);
+        svg.append(tx);
+      }
+    });
+  }
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
@@ -132,11 +270,46 @@ def _make_handler(server: "UIServer"):
                 sid = q.get("sid", [None])[0]
                 self._json(server.overview(sid))
                 return
+            if url.path == "/train/histograms":
+                q = parse_qs(url.query)
+                self._json(server.histograms(q.get("sid", [None])[0]))
+                return
+            if url.path == "/train/model":
+                q = parse_qs(url.query)
+                self._json(server.model_page(q.get("sid", [None])[0]))
+                return
+            if url.path == "/train/system":
+                q = parse_qs(url.query)
+                self._json(server.system_page(q.get("sid", [None])[0]))
+                return
+            if url.path == "/train/tsne":
+                self._json(server.tsne_coords())
+                return
             self._json({"error": "not found"}, 404)
 
         def do_POST(self):
+            path = urlparse(self.path).path
+            if path == "/tsne/post":
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    self._json({"error": "bad Content-Length"}, 400)
+                    return
+                if length < 0 or length > MAX_POST_BYTES:
+                    self._json({"error": "payload too large"}, 413)
+                    return
+                try:
+                    payload = json.loads(self.rfile.read(length))
+                    n = server.set_tsne_vectors(
+                        payload["vectors"], payload.get("labels")
+                    )
+                except Exception as e:
+                    self._json({"error": f"bad payload: {e}"}, 400)
+                    return
+                self._json({"status": "ok", "points": n})
+                return
             # RemoteReceiverModule analog: accept posted stats records
-            if urlparse(self.path).path != "/remoteReceive":
+            if path != "/remoteReceive":
                 self._json({"error": "not found"}, 404)
                 return
             if not server.remote_enabled:
@@ -274,6 +447,140 @@ class UIServer:
             }
         return {"session": None, "iterations": [], "scores": [],
                 "model": {}, "system": {}}
+
+    def _session_updates(self, session_id: Optional[str]):
+        """(static, updates) for the requested/latest session."""
+        ordered = self._storages
+        if session_id is not None:
+            exact = [s for s in self._storages
+                     if session_id in s.list_session_ids()]
+            if exact:
+                ordered = exact
+        for storage in ordered:
+            sids = storage.list_session_ids()
+            if not sids:
+                continue
+            sid = session_id if session_id in sids else sids[-1]
+            workers = storage.list_workers(sid)
+            if not workers:
+                continue
+            wid = workers[0]
+            return (
+                storage.get_static_info(sid, wid),
+                storage.get_all_updates(sid, wid),
+            )
+        return None, []
+
+    def histograms(self, session_id: Optional[str]) -> dict:
+        """HistogramModule analog: latest per-param histograms +
+        mean-magnitude series over iterations (reference
+        ``HistogramModule.java``)."""
+        _, updates = self._session_updates(session_id)
+        iters = [u.iteration for u in updates]
+        param_mm: dict = {}
+        update_mm: dict = {}
+        for u in updates:
+            for k in u.param_mean_magnitudes:
+                param_mm.setdefault(k, [])
+            for k in u.update_mean_magnitudes:
+                update_mm.setdefault(k, [])
+        for u in updates:
+            for k in param_mm:
+                param_mm[k].append(u.param_mean_magnitudes.get(k))
+            for k in update_mm:
+                update_mm[k].append(u.update_mean_magnitudes.get(k))
+        latest_h = {}
+        for u in reversed(updates):
+            if u.param_histograms:
+                latest_h = u.param_histograms
+                break
+        return {
+            "iterations": iters,
+            "param_mean_magnitudes": param_mm,
+            "update_mean_magnitudes": update_mm,
+            "latest_histograms": latest_h,
+        }
+
+    def model_page(self, session_id: Optional[str]) -> dict:
+        """TrainModule model-page analog: layer table + latest per-layer
+        param magnitudes (reference ``TrainModule.java`` model tab)."""
+        static, updates = self._session_updates(session_id)
+        latest = updates[-1] if updates else None
+        layer_rows = []
+        if static is not None:
+            names = (static.model.get("layers", "") or "").split(",")
+            mm = latest.param_mean_magnitudes if latest else {}
+            for name in names:
+                if not name:
+                    continue
+                w = mm.get(f"{name}_W")
+                b = mm.get(f"{name}_b")
+                layer_rows.append([
+                    name,
+                    "-" if w is None else f"{w:.6f}",
+                    "-" if b is None else f"{b:.6f}",
+                ])
+            if layer_rows:
+                layer_rows.insert(0, ["layer", "mean|W|", "mean|b|"])
+        return {
+            "model": dict(static.model) if static else {},
+            "layers": layer_rows,
+        }
+
+    def system_page(self, session_id: Optional[str]) -> dict:
+        """TrainModule system-page analog: software/hardware + memory
+        over time (reference system tab + ``StatsListener:310``)."""
+        static, updates = self._session_updates(session_id)
+        return {
+            "iterations": [u.iteration for u in updates],
+            "rss_mb": [
+                u.memory.get("host_rss_mb") for u in updates
+            ],
+            "duration_ms": [u.duration_ms for u in updates],
+            "software": dict(static.software) if static else {},
+            "hardware": dict(static.hardware) if static else {},
+        }
+
+    # -- t-SNE module (reference TsneModule.java) ------------------------
+
+    MAX_TSNE_POINTS = 2000
+    MAX_TSNE_DIM = 1024
+
+    def set_tsne_vectors(self, vectors, labels=None) -> int:
+        """Accept vectors, compute 2-D coords (already-2-D input is
+        stored as-is, matching the reference's upload of precomputed
+        coordinates)."""
+        import numpy as np
+
+        arr = np.asarray(vectors, dtype=np.float32)
+        if arr.ndim != 2:
+            raise ValueError("vectors must be 2-d [n, d]")
+        if arr.shape[0] > self.MAX_TSNE_POINTS:
+            raise ValueError(
+                f"at most {self.MAX_TSNE_POINTS} points"
+            )
+        if arr.shape[1] > self.MAX_TSNE_DIM:
+            raise ValueError(f"at most {self.MAX_TSNE_DIM} dims")
+        if labels is not None and len(labels) != arr.shape[0]:
+            raise ValueError("labels length mismatch")
+        if arr.shape[1] == 2:
+            coords = arr
+        else:
+            from deeplearning4j_tpu.plot.tsne import Tsne
+
+            n = arr.shape[0]
+            perplexity = max(2.0, min(30.0, (n - 1) / 3.0))
+            coords = Tsne(
+                max_iter=250, perplexity=perplexity, seed=12345
+            ).fit(arr)
+        self._tsne = {
+            "coords": np.asarray(coords, np.float32).tolist(),
+            "labels": list(labels) if labels is not None else None,
+        }
+        return arr.shape[0]
+
+    def tsne_coords(self) -> dict:
+        return getattr(self, "_tsne", {"coords": [], "labels": None})
 
 
 class RemoteUIStatsStorageRouter:

@@ -1,0 +1,142 @@
+"""Cluster NLP tests (reference analog: dl4j-spark-nlp
+``TextPipelineTest``, spark ``Word2VecTest`` — and the
+spark-vs-single-machine equivalence discipline of
+``TestCompareParameterAveragingSparkVsSingleMachine`` applied to
+embeddings: mesh-sharded training must match single-device)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+from deeplearning4j_tpu.parallel.cluster_nlp import (
+    ClusterGlove,
+    ClusterSequenceVectors,
+    ClusterWord2Vec,
+    TextPipeline,
+)
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+
+def _corpus(rng, n_sent=60, sent_len=12, vocab=30):
+    words = [f"w{i}" for i in range(vocab)]
+    # two "topics" so similarity structure exists
+    return [
+        " ".join(
+            words[rng.randint(0, vocab // 2)] if s % 2 == 0
+            else words[rng.randint(vocab // 2, vocab)]
+            for _ in range(sent_len)
+        )
+        for s in range(n_sent)
+    ]
+
+
+def test_text_pipeline_matches_serial_vocab(rng):
+    sentences = _corpus(rng)
+    serial = VocabConstructor(min_word_frequency=2).build_vocab(sentences)
+    for parts in (1, 3, 4):
+        parallel = TextPipeline(
+            min_word_frequency=2, n_partitions=parts
+        ).build_vocab(sentences)
+        assert len(parallel) == len(serial)
+        for w in serial.words:
+            assert parallel.word_for(w.word).count == w.count
+        # deterministic ordering -> identical indices
+        assert [w.word for w in parallel.words] == [
+            w.word for w in serial.words
+        ]
+
+
+def test_text_pipeline_id_sequences(rng):
+    sentences = _corpus(rng)
+    tp = TextPipeline(min_word_frequency=2)
+    cache = tp.build_vocab(sentences)
+    ids = tp.to_id_sequences(sentences, cache)
+    assert len(ids) == len(sentences)
+    assert all(a.dtype == np.int32 for a in ids)
+    assert all((a >= 0).all() and (a < len(cache)).all()
+               for a in ids if len(a))
+
+
+class _Seq(SequenceVectors):
+    def __init__(self, cache, seqs, **kw):
+        super().__init__(cache, **kw)
+        self._seqs = seqs
+
+    def _sequences(self):
+        return iter(self._seqs)
+
+
+def test_mesh_word2vec_matches_single_device(rng):
+    """The SPMD skip-gram step over the 8-device 'data' axis must
+    produce the same tables as unsharded training (synchronous dense
+    updates — exact, unlike the reference's hogwild)."""
+    sentences = _corpus(rng)
+    tp = TextPipeline(min_word_frequency=1)
+    cache = tp.build_vocab(sentences)
+    ids = tp.to_id_sequences(sentences, cache)
+    kw = dict(layer_size=16, window=3, negative=4, batch_size=64,
+              epochs=1, seed=7)
+    single = _Seq(cache, ids, **kw)
+    single.fit()
+    mesh = build_mesh(data=len(jax.devices()), model=1)
+    sharded = ClusterSequenceVectors(cache, ids, mesh=mesh, **kw)
+    assert sharded.batch_size == 64  # 64 divides 8 already
+    sharded.fit()
+    np.testing.assert_allclose(
+        np.asarray(single.lookup.syn0), np.asarray(sharded.lookup.syn0),
+        rtol=2e-5, atol=1e-6,
+    )
+    # similarity task parity
+    w = cache.word_at(0)
+    assert single.words_nearest(w, 3) == sharded.words_nearest(w, 3)
+
+
+def test_mesh_word2vec_rounds_batch_to_mesh(rng):
+    sentences = _corpus(rng, n_sent=20)
+    tp = TextPipeline()
+    cache = tp.build_vocab(sentences)
+    ids = tp.to_id_sequences(sentences, cache)
+    mesh = build_mesh(data=len(jax.devices()), model=1)
+    sv = ClusterSequenceVectors(
+        cache, ids, mesh=mesh, layer_size=8, batch_size=30, epochs=1,
+        negative=2, seed=3,
+    )
+    assert sv.batch_size % mesh.shape["data"] == 0
+    sv.fit()  # must run without uneven-shard errors
+
+
+def test_cluster_word2vec_builder_path(rng):
+    """ClusterWord2Vec IS-A Word2Vec: same query surface after fit."""
+    sentences = _corpus(rng, n_sent=30)
+    tp = TextPipeline()
+    cache = tp.build_vocab(sentences)
+    ids = tp.to_id_sequences(sentences, cache)
+    w2v = ClusterWord2Vec(
+        cache, ids, layer_size=12, window=3, negative=3,
+        batch_size=64, epochs=1, seed=5,
+    )
+    w2v.fit()
+    w = cache.word_at(0)
+    assert w2v.has_word(w)
+    assert w2v.get_word_vector(w).shape == (12,)
+    assert len(w2v.words_nearest(w, 5)) == 5
+
+
+def test_mesh_glove_matches_single_device(rng):
+    sentences = _corpus(rng)
+    tp = TextPipeline(min_word_frequency=1)
+    cache = tp.build_vocab(sentences)
+    ids = tp.to_id_sequences(sentences, cache)
+    kw = dict(layer_size=12, window=3, learning_rate=0.05, epochs=3,
+              batch_size=64, seed=11)
+    single = Glove(cache, ids, **kw).fit()
+    mesh = build_mesh(data=len(jax.devices()), model=1)
+    sharded = ClusterGlove(cache, ids, mesh=mesh, **kw).fit()
+    np.testing.assert_allclose(
+        single.syn0, sharded.syn0, rtol=2e-5, atol=1e-6
+    )
+    assert single.last_loss == pytest.approx(sharded.last_loss,
+                                             rel=1e-4)

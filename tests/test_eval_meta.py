@@ -1,0 +1,80 @@
+"""Record-metadata attribution in Evaluation (reference
+``Evaluation.eval(...,recordMetaData)`` at ``Evaluation.java:202`` and
+``eval/meta/Prediction.java``; reference test: EvaluationToolsTests /
+EvalTest metadata cases)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.eval import Evaluation, Prediction
+
+
+def _onehot(ids, n):
+    return np.eye(n, dtype=np.float32)[ids]
+
+
+def test_prediction_attribution_basic():
+    e = Evaluation()
+    labels = _onehot([0, 1, 2, 1], 3)
+    preds = _onehot([0, 2, 2, 1], 3)  # example 1 is wrong (1 -> 2)
+    meta = ["rec0", "rec1", "rec2", "rec3"]
+    e.eval(labels, preds, record_meta_data=meta)
+
+    errors = e.get_prediction_errors()
+    assert errors == [Prediction(1, 2, "rec1")]
+    by_actual = e.get_predictions_by_actual_class(1)
+    assert sorted(p.record_meta_data for p in by_actual) == [
+        "rec1", "rec3"
+    ]
+    by_pred = e.get_predictions_by_predicted_class(2)
+    assert sorted(p.record_meta_data for p in by_pred) == [
+        "rec1", "rec2"
+    ]
+    assert e.get_predictions(0, 0) == [Prediction(0, 0, "rec0")]
+    assert e.get_predictions(2, 0) == []
+    assert "rec1" in repr(errors[0])
+
+
+def test_without_metadata_no_predictions_tracked():
+    e = Evaluation()
+    e.eval(_onehot([0, 1], 2), _onehot([1, 1], 2))
+    assert e.get_prediction_errors() == []
+    assert e.accuracy() == 0.5  # confusion still counted
+
+
+def test_metadata_respects_mask():
+    e = Evaluation()
+    labels = _onehot([0, 1, 0], 2)
+    preds = _onehot([1, 1, 0], 2)
+    mask = np.array([0.0, 1.0, 1.0])
+    e.eval(labels, preds, mask=mask, record_meta_data=["a", "b", "c"])
+    # masked row 0 (an error) must not appear
+    assert e.get_prediction_errors() == []
+    assert e.get_predictions(1, 1) == [Prediction(1, 1, "b")]
+    assert e.get_predictions(0, 0) == [Prediction(0, 0, "c")]
+
+
+def test_metadata_time_series_expansion():
+    """3-d labels: each example's metadata attaches to every unmasked
+    timestep (reference evalTimeSeries + metadata)."""
+    e = Evaluation()
+    # [b=2, c=2, t=2]
+    labels = np.zeros((2, 2, 2), np.float32)
+    preds = np.zeros((2, 2, 2), np.float32)
+    labels[:, 0, :] = 1.0           # actual always class 0
+    preds[0, 0, :] = 1.0            # example 0 right both steps
+    preds[1, 1, :] = 1.0            # example 1 wrong both steps
+    mask = np.array([[1.0, 1.0], [1.0, 0.0]])
+    e.eval(labels, preds, mask=mask, record_meta_data=["e0", "e1"])
+    errs = e.get_prediction_errors()
+    assert errs == [Prediction(0, 1, "e1")]  # only unmasked wrong step
+    assert len(e.get_predictions(0, 0)) == 2  # e0's two correct steps
+
+
+def test_merge_carries_metadata():
+    a, b = Evaluation(), Evaluation()
+    a.eval(_onehot([0], 2), _onehot([1], 2), record_meta_data=["x"])
+    b.eval(_onehot([1], 2), _onehot([1], 2), record_meta_data=["y"])
+    a.merge(b)
+    assert a.get_prediction_errors() == [Prediction(0, 1, "x")]
+    assert a.get_predictions(1, 1) == [Prediction(1, 1, "y")]
+    assert a.accuracy() == 0.5

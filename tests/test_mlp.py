@@ -188,3 +188,41 @@ def test_scan_fused_fit_matches_per_step(rng):
             np.testing.assert_array_equal(
                 np.asarray(a.params[ln][pn]), np.asarray(b.params[ln][pn])
             )
+
+
+def test_scan_fused_fit_matches_per_step_rnn(rng):
+    """RNN under standard backprop: recurrent carry resets each
+    minibatch, so the scan path must match the per-step path exactly."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.Builder().seed(3).learning_rate(0.05)
+            .updater("SGD")
+            .list()
+            .layer(GravesLSTM(n_in=4, n_out=6))
+            .layer(RnnOutputLayer(n_out=2, loss="MCXENT"))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    batches = []
+    for _ in range(5):
+        x = rng.rand(3, 4, 7).astype(np.float32)
+        y = np.zeros((3, 2, 7), np.float32)
+        y[:, 0, :] = 1.0
+        batches.append(DataSet(features=x, labels=y))
+    a = build()
+    a.scan_chunk = 1
+    for ds in batches:
+        a.fit_minibatch(ds)
+    b = build()
+    b.scan_chunk = 3
+    b.fit(batches)
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[ln][pn]),
+                np.asarray(b.params[ln][pn]), rtol=1e-6, atol=1e-7,
+            )

@@ -1,0 +1,156 @@
+"""NLP periphery tests (reference analogs: ``TfidfVectorizerTest``,
+``BagOfWordsVectorizerTest``, inverted-index usage, StaticWord2Vec,
+``TreeModelUtils.wordsNearest``, tokenizer-factory SPI)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer,
+    BasicModelUtils,
+    CharTokenizerFactory,
+    InvertedIndex,
+    StaticWord2Vec,
+    TfidfVectorizer,
+    TreeModelUtils,
+    register_tokenizer_factory,
+    save_static,
+    tokenizer_factory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+
+DOCS = [
+    ("the quick brown fox jumps", "animal"),
+    ("the lazy dog sleeps all day", "animal"),
+    ("stocks rallied as markets rose", "finance"),
+    ("the markets fell on rate fears", "finance"),
+]
+
+
+def test_bag_of_words_counts():
+    v = BagOfWordsVectorizer()
+    v.fit(DOCS)
+    ds = v.vectorize("the the dog", "animal")
+    assert ds.features.shape == (1, len(v.cache))
+    assert ds.features[0, v.cache.index_of("the")] == 2.0
+    assert ds.features[0, v.cache.index_of("dog")] == 1.0
+    assert ds.labels[0, v.labels.index("animal")] == 1.0
+
+
+def test_tfidf_downweights_common_words():
+    v = TfidfVectorizer()
+    v.fit(DOCS)
+    # 'the' appears in 3 of 4 docs, 'fox' in 1 — idf must rank fox higher
+    row = v.transform("the fox")
+    assert row[v.cache.index_of("fox")] > row[v.cache.index_of("the")]
+    assert v.tfidf_word("fox", "the fox") > 0
+    assert v.tfidf_word("absent", "the fox") == 0.0
+    # a word present in every document has idf log(1) = 0 only if
+    # docfreq == ndocs; 'the' (3/4) must still be positive but small
+    assert row[v.cache.index_of("the")] >= 0.0
+
+
+def test_vectorize_all_matrix():
+    v = TfidfVectorizer()
+    v.fit(DOCS)
+    ds = v.vectorize_all(DOCS)
+    assert ds.features.shape == (4, len(v.cache))
+    assert ds.labels.shape == (4, 2)
+    np.testing.assert_array_equal(ds.labels.sum(axis=1), 1.0)
+
+
+def test_inverted_index_postings_and_batches():
+    idx = InvertedIndex(batch_size=2)
+    d0 = idx.add_doc(["a", "b", "a"], label="x")
+    d1 = idx.add_doc(["b", "c"], label="y")
+    d2 = idx.add_doc(["a"], label="x")
+    idx.finish()
+    assert idx.num_documents() == 3
+    assert idx.documents("a") == [d0, d2]
+    assert idx.documents("b") == [d0, d1]
+    assert idx.doc_frequency("a") == 2
+    assert idx.document(d1) == ["b", "c"]
+    assert idx.document_label(d1) == "y"
+    batches = list(idx.batch_iter())
+    assert [len(b) for b in batches] == [2, 1]
+    sample = idx.sample(5, seed=1)
+    assert len(sample) == 5
+    assert all(s in [["a", "b", "a"], ["b", "c"], ["a"]] for s in sample)
+
+
+def _toy_vectors():
+    cache = VocabCache()
+    words = ["king", "queen", "man", "woman", "apple"]
+    for w in words:
+        cache.add(VocabWord(w, 5))
+    m = np.array([
+        [1.0, 1.0, 0.0],   # king
+        [1.0, 0.9, 0.2],   # queen
+        [0.9, 0.1, 0.0],   # man
+        [0.9, 0.0, 0.2],   # woman
+        [0.0, 0.0, 1.0],   # apple
+    ], np.float32)
+    return cache, m
+
+
+def test_static_word2vec_round_trip(tmp_path):
+    cache, m = _toy_vectors()
+    save_static((cache, m), str(tmp_path))
+    sw = StaticWord2Vec(str(tmp_path))
+    assert sw.has_word("king") and not sw.has_word("nope")
+    np.testing.assert_allclose(sw.get_word_vector("queen"), m[1])
+    # mmap'd backing array is read-only
+    assert not sw.syn0.flags.writeable
+    assert sw.similarity("king", "queen") > sw.similarity("king", "apple")
+    assert sw.words_nearest("king", 1) == ["queen"]
+    # LRU serves the cached row on the second hit
+    v1 = sw.get_word_vector("king")
+    v2 = sw.get_word_vector("king")
+    assert v1 is v2
+
+
+def test_model_utils_flat_vs_tree_agree():
+    cache, m = _toy_vectors()
+    flat = BasicModelUtils((cache, m))
+    tree = TreeModelUtils((cache, m))
+    for w in ["king", "queen", "man"]:
+        assert flat.words_nearest(w, 2) == tree.words_nearest(w, 2)
+    assert flat.similarity("king", "queen") == pytest.approx(
+        float(
+            (m[0] / np.linalg.norm(m[0])) @ (m[1] / np.linalg.norm(m[1]))
+        ), abs=1e-6,
+    )
+
+
+def test_words_nearest_sum_analogy():
+    cache, m = _toy_vectors()
+    utils = BasicModelUtils((cache, m))
+    # king - man + woman ~ queen
+    got = utils.words_nearest_sum(
+        ["king", "woman"], negative=["man"], n=1
+    )
+    assert got == ["queen"]
+
+
+def test_tokenizer_registry_spi():
+    tf = tokenizer_factory("default")
+    assert tf.create("a b c").get_tokens() == ["a", "b", "c"]
+    cj = tokenizer_factory("japanese")  # char-level stand-in
+    assert cj.create("日本語 テスト").get_tokens() == list("日本語テスト")
+    rx = tokenizer_factory("regex", pattern=r"[,;]")
+    assert rx.create("a,b;c").get_tokens() == ["a", "b", "c"]
+
+    class Upper(CharTokenizerFactory):
+        pass
+
+    register_tokenizer_factory("upper-test", Upper)
+    assert isinstance(tokenizer_factory("upper-test"), Upper)
+    with pytest.raises(KeyError, match="no TokenizerFactory"):
+        tokenizer_factory("klingon")
+
+
+def test_vectorizer_with_registered_tokenizer():
+    v = BagOfWordsVectorizer(tokenizer_factory=tokenizer_factory("char"))
+    v.fit([("ab", "x"), ("bc", "y")])
+    row = v.transform("abb")
+    assert row[v.cache.index_of("b")] == 2.0

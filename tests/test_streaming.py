@@ -1,0 +1,158 @@
+"""Streaming + serving tests (reference analog: dl4j-streaming's
+``NDArrayKafkaClient`` publish/consume tests and the
+``DL4jServeRouteBuilder`` predict route)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.streaming import (
+    ModelServer,
+    NDArrayConsumer,
+    NDArrayPublisher,
+    StreamingDataSetIterator,
+    decode_ndarray_message,
+    encode_ndarray_message,
+)
+
+
+def test_message_round_trip(rng):
+    f = rng.rand(4, 7).astype(np.float32)
+    l = rng.rand(4, 2).astype(np.float32)
+    body = encode_ndarray_message(f, l)
+    f2, l2 = decode_ndarray_message(body[8:])
+    np.testing.assert_array_equal(f, f2)
+    np.testing.assert_array_equal(l, l2)
+    # features only
+    f3, l3 = decode_ndarray_message(encode_ndarray_message(f)[8:])
+    np.testing.assert_array_equal(f, f3)
+    assert l3 is None
+
+
+def test_publish_consume_round_trip(rng):
+    consumer = NDArrayConsumer(port=0).listen()
+    pub = NDArrayPublisher("127.0.0.1", consumer.port)
+    sent = [rng.rand(3).astype(np.float32) for _ in range(5)]
+    for a in sent:
+        pub.publish(a, labels=a * 2)
+    got = [consumer.get(timeout=5) for _ in range(5)]
+    pub.close()
+    consumer.close()
+    for (f, l), a in zip(got, sent):
+        np.testing.assert_array_equal(f, a)
+        np.testing.assert_array_equal(l, a * 2)
+
+
+def test_streaming_iterator_feeds_training(rng):
+    """Stream -> StreamingDataSetIterator -> net.fit (the reference's
+    Kafka -> DataSet -> fit pipeline)."""
+    consumer = NDArrayConsumer(port=0).listen()
+    pub = NDArrayPublisher("127.0.0.1", consumer.port)
+    for _ in range(20):
+        x = rng.rand(4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[int(x[0] > 0.5)]
+        pub.publish(x, labels=y)
+    it = StreamingDataSetIterator(consumer, batch_size=5,
+                                  total_batches=4, timeout=5)
+    batches = list(it)
+    pub.close()
+    consumer.close()
+    assert len(batches) == 4
+    assert batches[0].features.shape == (5, 4)
+    assert batches[0].labels.shape == (5, 2)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(batches)  # must train without shape errors
+    assert np.isfinite(float(net.score_value))
+
+
+def test_model_server_predicts(tmp_path, rng):
+    from deeplearning4j_tpu.util.model_serializer import write_model
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(2).learning_rate(0.1)
+        .list()
+        .layer(DenseLayer(n_in=3, n_out=6, activation="tanh"))
+        .layer(OutputLayer(n_out=2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    path = str(tmp_path / "model.zip")
+    write_model(net, path)
+
+    server = ModelServer(path, output_classes=True).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        health = json.loads(
+            urllib.request.urlopen(base + "/healthz").read()
+        )
+        assert health["status"] == "ok"
+        assert health["model"] == "MultiLayerNetwork"
+        x = rng.rand(4, 3).astype(np.float32)
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"features": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(urllib.request.urlopen(req).read())
+        out = np.asarray(resp["output"])
+        np.testing.assert_allclose(
+            out, np.asarray(net.output(x)), rtol=1e-5
+        )
+        assert resp["classes"] == out.argmax(axis=1).tolist()
+        # bad payload -> 400
+        bad = urllib.request.Request(base + "/predict", data=b"nope")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad)
+    finally:
+        server.stop()
+
+
+def test_model_server_transform_hook(rng):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(2)
+        .list()
+        .layer(OutputLayer(n_in=2, n_out=2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    server = ModelServer(
+        net, transform=lambda f: f * 0.0, output_classes=False
+    ).start()
+    try:
+        x = rng.rand(3, 2).astype(np.float32)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/predict",
+            data=json.dumps({"features": x.tolist()}).encode(),
+        )
+        resp = json.loads(urllib.request.urlopen(req).read())
+        out = np.asarray(resp["output"])
+        # transform zeroed the input: all rows identical
+        assert np.allclose(out, out[0])
+    finally:
+        server.stop()
+
+
+def test_streaming_iterator_rejects_mixed_labels(rng):
+    consumer = NDArrayConsumer(port=0).listen()
+    pub = NDArrayPublisher("127.0.0.1", consumer.port)
+    pub.publish(rng.rand(3).astype(np.float32),
+                labels=np.ones(2, np.float32))
+    pub.publish(rng.rand(3).astype(np.float32))  # unlabeled
+    it = StreamingDataSetIterator(consumer, batch_size=2,
+                                  total_batches=1, timeout=5)
+    with pytest.raises(ValueError, match="mixes labeled"):
+        next(iter(it))
+    pub.close()
+    consumer.close()

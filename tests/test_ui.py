@@ -135,7 +135,7 @@ class TestUIServer:
         assert len(ov["scores"]) == 4
         assert ov["model"]["class"] == "MultiLayerNetwork"
         page = urllib.request.urlopen(base + "/").read().decode()
-        assert "Training Overview" in page
+        assert "Training UI" in page
 
     def test_remote_router_roundtrip(self, server):
         server.enable_remote_listener()
@@ -166,3 +166,91 @@ class TestUIServer:
         listener = StatsListener(router, frequency=1)
         _train_small_net(listener, n_iters=4)  # must not raise
         assert router._failures >= 2
+
+
+class TestTrainPages:
+    """Histogram / model / system / t-SNE pages (reference
+    ``HistogramModule``, ``TrainModule`` model+system tabs,
+    ``TsneModule``)."""
+
+    @pytest.fixture
+    def server(self):
+        s = UIServer(port=0)
+        yield s
+        s.stop()
+
+    def _get(self, server, path):
+        return json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}").read())
+
+    def test_histograms_endpoint(self, server):
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        listener = StatsListener(storage, frequency=1,
+                                 collect_histograms=True)
+        _train_small_net(listener, n_iters=4)
+        sid = storage.list_session_ids()[0]
+        h = self._get(server, f"/train/histograms?sid={sid}")
+        assert len(h["iterations"]) == 4
+        assert "0_W" in h["param_mean_magnitudes"]
+        assert len(h["param_mean_magnitudes"]["0_W"]) == 4
+        hist = h["latest_histograms"]["0_W"]
+        assert len(hist["counts"]) == 20
+        assert hist["min"] < hist["max"]
+        # update magnitudes appear from the 2nd iteration on
+        assert any(
+            v is not None for v in h["update_mean_magnitudes"]["0_W"]
+        )
+
+    def test_model_and_system_endpoints(self, server):
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        listener = StatsListener(storage, frequency=1)
+        _train_small_net(listener, n_iters=3)
+        sid = storage.list_session_ids()[0]
+        m = self._get(server, f"/train/model?sid={sid}")
+        assert m["model"]["class"] == "MultiLayerNetwork"
+        assert m["layers"][0] == ["layer", "mean|W|", "mean|b|"]
+        assert len(m["layers"]) == 3  # header + 2 layers
+        s = self._get(server, f"/train/system?sid={sid}")
+        assert len(s["rss_mb"]) == 3
+        assert s["software"]["framework"] == "deeplearning4j_tpu"
+        assert "device_count" in s["hardware"]
+
+    def test_tsne_module_round_trip(self, server):
+        rng = np.random.RandomState(0)
+        # two well-separated clusters in 8-d
+        vecs = np.concatenate([
+            rng.randn(10, 8) * 0.1,
+            rng.randn(10, 8) * 0.1 + 5.0,
+        ]).tolist()
+        labels = ["a"] * 10 + ["b"] * 10
+        body = json.dumps({"vectors": vecs, "labels": labels}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/tsne/post", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(urllib.request.urlopen(req).read())
+        assert resp == {"status": "ok", "points": 20}
+        t = self._get(server, "/train/tsne")
+        coords = np.asarray(t["coords"])
+        assert coords.shape == (20, 2)
+        assert t["labels"] == labels
+        # clusters must stay separated in the embedding
+        a, b = coords[:10], coords[10:]
+        da = np.linalg.norm(a - a.mean(0), axis=1).mean()
+        cross = np.linalg.norm(a.mean(0) - b.mean(0))
+        assert cross > da
+
+    def test_tsne_post_2d_passthrough_and_errors(self, server):
+        base = f"http://127.0.0.1:{server.port}"
+        body = json.dumps({"vectors": [[0.0, 1.0], [1.0, 0.0]]}).encode()
+        req = urllib.request.Request(base + "/tsne/post", data=body)
+        assert json.loads(urllib.request.urlopen(req).read())[
+            "points"] == 2
+        t = self._get(server, "/train/tsne")
+        assert t["coords"] == [[0.0, 1.0], [1.0, 0.0]]
+        bad = json.dumps({"vectors": [1, 2, 3]}).encode()
+        req = urllib.request.Request(base + "/tsne/post", data=bad)
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req)
