@@ -13,6 +13,7 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     SamplingDataSetIterator,
 )
 from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.curves import CurvesDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.lfw import LFWDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401

@@ -39,13 +39,14 @@ LABELS = [
 
 
 def _read_bin(path: str) -> Tuple[np.ndarray, np.ndarray]:
-    raw = np.fromfile(path, dtype=np.uint8)
-    if raw.size % _REC:
-        raise ValueError(f"{path}: size {raw.size} not a multiple of {_REC}")
-    raw = raw.reshape(-1, _REC)
-    labels = raw[:, 0]
-    images = raw[:, 1:].reshape(-1, CHANNELS, HEIGHT, WIDTH)
-    return images, labels
+    from deeplearning4j_tpu.native import split_cifar
+
+    with open(path, "rb") as f:
+        buf = f.read()
+    if len(buf) % _REC:
+        raise ValueError(f"{path}: size {len(buf)} not a multiple of {_REC}")
+    images, labels = split_cifar(buf)  # native C++ when available
+    return images.reshape(-1, CHANNELS, HEIGHT, WIDTH), labels
 
 
 def _read_py(path: str) -> Tuple[np.ndarray, np.ndarray]:
@@ -135,27 +136,35 @@ class CifarDataSetIterator(DataSetIterator):
             self.synthetic = True
         if num_examples is not None:
             images, labels = images[:num_examples], labels[:num_examples]
-        if shuffle:
-            idx = np.random.RandomState(seed).permutation(len(images))
-            images, labels = images[idx], labels[idx]
-        feats = images.astype(np.float32) / 255.0
-        if flat:
-            feats = feats.reshape(len(feats), -1)
-        onehot = np.zeros((len(labels), NUM_LABELS), np.float32)
-        onehot[np.arange(len(labels)), labels] = 1.0
-        self._features = feats
-        self._labels = onehot
+        # uint8 rows + permutation; batches assembled on demand by the
+        # native fused gather+normalize+one-hot kernel
+        self._images = np.ascontiguousarray(
+            images.reshape(len(images), -1), np.uint8
+        )
+        self._labels_u8 = np.ascontiguousarray(labels, np.uint8)
+        self._order = (
+            np.random.RandomState(seed).permutation(len(images))
+            if shuffle else np.arange(len(images))
+        )
+        self.flat = flat
         self._pos = 0
 
     def next(self) -> DataSet:
+        from deeplearning4j_tpu.native import assemble_batch
+
         i = self._pos
-        j = min(i + self.batch_size, len(self._features))
+        j = min(i + self.batch_size, len(self._images))
         self._pos = j
-        return DataSet(features=self._features[i:j],
-                       labels=self._labels[i:j])
+        feats, onehot = assemble_batch(
+            self._images, self._labels_u8, self._order[i:j],
+            NUM_LABELS,
+        )
+        if not self.flat:
+            feats = feats.reshape(len(feats), CHANNELS, HEIGHT, WIDTH)
+        return DataSet(features=feats, labels=onehot)
 
     def has_next(self) -> bool:
-        return self._pos < len(self._features)
+        return self._pos < len(self._images)
 
     def reset(self) -> None:
         self._pos = 0
@@ -164,10 +173,10 @@ class CifarDataSetIterator(DataSetIterator):
         return self.batch_size
 
     def total_examples(self) -> int:
-        return len(self._features)
+        return len(self._images)
 
     def input_columns(self) -> int:
-        return int(np.prod(self._features.shape[1:]))
+        return CHANNELS * HEIGHT * WIDTH
 
     def total_outcomes(self) -> int:
         return NUM_LABELS

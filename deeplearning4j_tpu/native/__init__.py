@@ -1,0 +1,167 @@
+"""ctypes bindings for the native data-loader (see ``loader.cpp`` —
+the C++ runtime piece standing in for the reference's libnd4j/DataVec
+decode path). Built lazily with g++ on first use and cached next to
+the source; every entry point has a numpy fallback so the package
+works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "loader.cpp")
+_SO = os.path.join(_DIR, "_loader.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return _SO
+    except Exception:
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building if needed; None when no
+    toolchain / build failure (callers fall back to numpy)."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        stale = (
+            os.path.exists(_SO)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        )
+        so = (
+            _SO if os.path.exists(_SO) and not stale else _build()
+        )
+        if so is None:
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.idx3_header.restype = ctypes.c_int
+        lib.split_cifar_records.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def _ptr(a: np.ndarray, t):
+    return a.ctypes.data_as(ctypes.POINTER(t))
+
+
+def parse_idx3(buf: bytes) -> np.ndarray:
+    """IDX3 image bytes -> uint8 [n, rows*cols] (native; numpy
+    fallback mirrors datasets.mnist.read_idx_images)."""
+    lib = get_lib()
+    arr = np.frombuffer(buf, np.uint8)
+    if lib is not None:
+        n = ctypes.c_int64()
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        rc = lib.idx3_header(
+            _ptr(arr, ctypes.c_uint8), ctypes.c_int64(arr.size),
+            ctypes.byref(n), ctypes.byref(rows), ctypes.byref(cols),
+        )
+        if rc != 0:
+            raise ValueError(f"bad IDX3 data (code {rc})")
+        d = rows.value * cols.value
+        return arr[16:16 + n.value * d].reshape(n.value, d).copy()
+    import struct
+
+    magic, n, rows, cols = struct.unpack(">IIII", buf[:16])
+    if magic != 2051:
+        raise ValueError(f"bad IDX3 magic {magic}")
+    return (
+        np.frombuffer(buf[16:16 + n * rows * cols], np.uint8)
+        .reshape(n, rows * cols).copy()
+    )
+
+
+def normalize_u8(images: np.ndarray) -> np.ndarray:
+    """uint8 -> float32 in [0, 1]."""
+    images = np.ascontiguousarray(images, np.uint8)
+    lib = get_lib()
+    out = np.empty(images.shape, np.float32)
+    if lib is not None:
+        lib.normalize_u8(
+            _ptr(images, ctypes.c_uint8), _ptr(out, ctypes.c_float),
+            ctypes.c_int64(images.size),
+        )
+        return out
+    return images.astype(np.float32) / 255.0
+
+
+def assemble_batch(features_u8: np.ndarray, labels_u8: np.ndarray,
+                   perm: np.ndarray, n_classes: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Fused gather+normalize+one-hot (one memory pass in C++):
+    returns (x float32 [b, d], y float32 [b, n_classes])."""
+    features_u8 = np.ascontiguousarray(features_u8, np.uint8)
+    labels_u8 = np.ascontiguousarray(labels_u8, np.uint8)
+    perm = np.ascontiguousarray(perm, np.int64)
+    b = perm.size
+    d = features_u8.shape[1]
+    lib = get_lib()
+    if lib is not None:
+        x = np.empty((b, d), np.float32)
+        y = np.empty((b, n_classes), np.float32)
+        lib.assemble_batch_u8(
+            _ptr(features_u8, ctypes.c_uint8),
+            _ptr(labels_u8, ctypes.c_uint8),
+            _ptr(perm, ctypes.c_int64),
+            ctypes.c_int64(b), ctypes.c_int64(d),
+            ctypes.c_int64(n_classes),
+            _ptr(x, ctypes.c_float), _ptr(y, ctypes.c_float),
+        )
+        return x, y
+    x = features_u8[perm].astype(np.float32) / 255.0
+    y = np.zeros((b, n_classes), np.float32)
+    y[np.arange(b), labels_u8[perm]] = 1.0
+    return x, y
+
+
+def split_cifar(buf: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-10 binary records -> (images u8 [n, 3072], labels u8 [n])."""
+    arr = np.frombuffer(buf, np.uint8)
+    if arr.size % 3073:
+        raise ValueError(
+            f"size {arr.size} not a multiple of the 3073-byte record"
+        )
+    n = arr.size // 3073
+    lib = get_lib()
+    if lib is not None:
+        images = np.empty((n, 3072), np.uint8)
+        labels = np.empty((n,), np.uint8)
+        rc = lib.split_cifar_records(
+            _ptr(arr, ctypes.c_uint8), ctypes.c_int64(arr.size),
+            _ptr(images, ctypes.c_uint8), _ptr(labels, ctypes.c_uint8),
+        )
+        if rc != 0:
+            raise ValueError("bad CIFAR records")
+        return images, labels
+    rec = arr.reshape(n, 3073)
+    return rec[:, 1:].copy(), rec[:, 0].copy()

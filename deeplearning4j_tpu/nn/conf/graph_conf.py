@@ -382,6 +382,8 @@ class ComputationGraphConfiguration:
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
     input_types: Optional[Tuple[InputType, ...]] = None
+    optimization_algo: str = "STOCHASTIC_GRADIENT_DESCENT"
+    max_num_line_search_iterations: int = 5
 
     def topological_order(self) -> List[str]:
         """Kahn ordering of vertex names (reference
@@ -434,6 +436,9 @@ class ComputationGraphConfiguration:
                 [t.to_json() for t in self.input_types]
                 if self.input_types else None
             ),
+            "optimization_algo": self.optimization_algo,
+            "max_num_line_search_iterations":
+                self.max_num_line_search_iterations,
         }
 
     def to_json(self) -> str:
@@ -462,6 +467,12 @@ class ComputationGraphConfiguration:
             input_types=(
                 tuple(InputType.from_json(t) for t in d["input_types"])
                 if d.get("input_types") else None
+            ),
+            optimization_algo=d.get(
+                "optimization_algo", "STOCHASTIC_GRADIENT_DESCENT"
+            ),
+            max_num_line_search_iterations=d.get(
+                "max_num_line_search_iterations", 5
             ),
         )
 
@@ -570,6 +581,13 @@ class GraphBuilder:
             tbptt_back_length=self._tbptt_back,
             input_types=(
                 tuple(self._input_types) if self._input_types else None
+            ),
+            optimization_algo=getattr(
+                self._parent, "_optimization_algo",
+                "STOCHASTIC_GRADIENT_DESCENT",
+            ),
+            max_num_line_search_iterations=getattr(
+                self._parent, "_max_num_line_search_iterations", 5
             ),
         )
         if self._input_types is not None:

@@ -55,6 +55,7 @@ class ComputationGraph:
         self.listeners: List[Any] = []
         self._jit_step = None
         self._jit_multi_step = None
+        self._solver = None  # lazily built for LBFGS/CG/line-search
         self.scan_chunk = 16  # minibatches fused per dispatch
         self._jit_output = None
         self._base_key = jax.random.PRNGKey(conf.seed)
@@ -259,6 +260,10 @@ class ComputationGraph:
     def _can_scan_steps(self) -> bool:
         return (
             self.conf.iterations == 1
+            and getattr(
+                self.conf, "optimization_algo",
+                "STOCHASTIC_GRADIENT_DESCENT",
+            ) == "STOCHASTIC_GRADIENT_DESCENT"
             and not any(
                 self.conf.vertices[n].layer_conf.is_recurrent()
                 for n in self.layer_vertex_names
@@ -396,6 +401,21 @@ class ComputationGraph:
     def fit_minibatch(self, ds) -> float:
         if self.params is None:
             self.init()
+        if self.conf.optimization_algo != "STOCHASTIC_GRADIENT_DESCENT":
+            from deeplearning4j_tpu.optimize.solvers import (
+                Solver,
+                is_solver_algo,
+            )
+
+            if is_solver_algo(self.conf.optimization_algo):
+                if self._solver is None:
+                    self._solver = Solver(self)
+                f, l, fm, lm = self._ds_arrays(ds)
+                return self._solver.optimize(f, l, mask=lm, fmask=fm)
+            raise ValueError(
+                "Unknown optimization_algo "
+                f"'{self.conf.optimization_algo}'"
+            )
         if self._jit_step is None:
             self._jit_step = self._build_step()
         dtype = self._dtype()

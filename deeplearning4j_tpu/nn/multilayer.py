@@ -347,6 +347,8 @@ class MultiLayerNetwork:
         ys = stack(lambda b: b.labels)
         masks = stack(lambda b: getattr(b, "labels_mask", None))
         fmasks = stack(lambda b: getattr(b, "features_mask", None))
+        if self._wants_last_features():
+            self._last_features = batches[-1].features
         it0 = self.iteration_count
         lr_rows = [
             self.updater_def.scheduled_lrs(it0 + i) for i in range(k)
@@ -474,6 +476,8 @@ class MultiLayerNetwork:
             mask = jnp.asarray(mask, dtype)
         if fmask is not None:
             fmask = jnp.asarray(fmask, dtype)
+        if self._wants_last_features():
+            self._last_features = ds.features  # activation listeners
         score = None
         for _ in range(self.conf.iterations):
             lrs = self.updater_def.scheduled_lrs(self.iteration_count)
@@ -496,6 +500,15 @@ class MultiLayerNetwork:
             # the step's state pytree structure stable -> no recompile)
             self._reset_recurrent_state()
         return score  # 0-d device array; float() to sync
+
+    def _wants_last_features(self) -> bool:
+        """Snapshot the batch only when a listener needs it — holding a
+        reference unconditionally would pin the user's feature array in
+        memory for the model's lifetime."""
+        return any(
+            getattr(l, "needs_last_features", False)
+            for l in self.listeners
+        )
 
     def _reset_recurrent_state(self) -> None:
         """Standard-backprop mode: recurrent carry does not persist

@@ -1,5 +1,9 @@
 """Optimization & listeners (reference ``optimize/**``)."""
 
+from deeplearning4j_tpu.optimize.profiler import (  # noqa: F401
+    ProfilerListener,
+    annotate,
+)
 from deeplearning4j_tpu.optimize.solvers import (  # noqa: F401
     Solver,
     backtrack_line_search,

@@ -1,0 +1,87 @@
+"""Profiler hooks (SURVEY.md §5 tracing: "same listener SPI + jax
+profiler hooks" — the reference has only PerformanceListener timing;
+the TPU-era upgrade is a listener that brackets training with the XLA
+profiler so traces open in TensorBoard/XProf/Perfetto).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+class ProfilerListener(IterationListener):
+    """Capture a jax profiler trace for iterations
+    [start_iteration, start_iteration + num_iterations) (device +
+    host timelines, one trace directory per session).
+
+    Usage::
+
+        net.listeners.append(ProfilerListener("/tmp/trace", 10, 5))
+        net.fit(data)          # iterations 10..14 are traced
+    """
+
+    # force the per-step fit path: under the fused lax.scan path all
+    # listener callbacks fire after the chunk's single dispatch, so a
+    # trace started there would bracket no device work
+    supports_batched_iterations = False
+
+    def __init__(self, log_dir: str, start_iteration: int = 5,
+                 num_iterations: int = 5):
+        self.log_dir = log_dir
+        self.start_iteration = int(start_iteration)
+        self.stop_iteration = int(start_iteration) + int(num_iterations)
+        self._active = False
+        self.trace_dir: Optional[str] = None
+
+    def _start(self) -> None:
+        import jax
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+
+    def _stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+        self.trace_dir = self.log_dir
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if not self._active and (
+            self.start_iteration <= iteration < self.stop_iteration
+        ):
+            self._start()
+        elif self._active and iteration >= self.stop_iteration:
+            # block so the trace includes finished device work
+            try:
+                float(model.score_value)
+            except Exception:
+                pass
+            self._stop()
+
+    def on_epoch_end(self, model) -> None:
+        """Finalize an open trace when training ends before
+        ``stop_iteration`` — an unfinalized jax trace blocks any later
+        ``start_trace`` in the process."""
+        if self._active:
+            try:
+                float(model.score_value)
+            except Exception:
+                pass
+            self._stop()
+
+    def close(self) -> None:
+        if self._active:
+            self._stop()
+
+
+def annotate(name: str):
+    """Named trace span for host-side phases (jax TraceAnnotation) —
+    usable around data loading / eval to label the profile."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -195,15 +195,26 @@ class Solver:
         self._dtype = flat.dtype
         net_ref = net
         unravel = self._unravel
+        # ComputationGraph's _score_pure takes input/label/mask LISTS
+        self._is_graph = hasattr(net.conf, "vertex_inputs")
 
-        def score(flat_params, state, x, y, mask, fmask):
-            # state rides as a traced arg: a stale-state constant baked
-            # at first trace would silently misuse later BN stats
-            s, _ = net_ref._score_pure(
-                unravel(flat_params), state, x, y, mask, None,
-                train=False, fmask=fmask,
-            )
-            return s
+        if self._is_graph:
+            def score(flat_params, state, x, y, mask, fmask):
+                s, _ = net_ref._score_pure(
+                    unravel(flat_params), state, x, y, mask, None,
+                    train=False, fmasks=fmask,
+                )
+                return s
+        else:
+            def score(flat_params, state, x, y, mask, fmask):
+                # state rides as a traced arg: a stale-state constant
+                # baked at first trace would silently misuse later BN
+                # running stats
+                s, _ = net_ref._score_pure(
+                    unravel(flat_params), state, x, y, mask, None,
+                    train=False, fmask=fmask,
+                )
+                return s
 
         self._score = score  # stable identity -> one compile per shape
         self.reset_state()
@@ -224,14 +235,26 @@ class Solver:
 
     def optimize(self, x, y, mask=None, fmask=None,
                  iterations: Optional[int] = None):
+        """For a ComputationGraph, ``x``/``y``/``mask``/``fmask`` may
+        be lists (multi-input/-output); scalars/arrays are wrapped."""
         net = self.net
         dtype = self._dtype
-        x = jnp.asarray(np.asarray(x), dtype)
-        y = jnp.asarray(np.asarray(y), dtype)
-        mask = None if mask is None else jnp.asarray(np.asarray(mask), dtype)
-        fmask = (
-            None if fmask is None else jnp.asarray(np.asarray(fmask), dtype)
-        )
+
+        def conv(v):
+            return (
+                None if v is None else jnp.asarray(np.asarray(v), dtype)
+            )
+
+        if self._is_graph:
+            as_list = lambda v: (
+                None if v is None
+                else [conv(e) for e in
+                      (v if isinstance(v, (list, tuple)) else [v])]
+            )
+            x, y = as_list(x), as_list(y)
+            mask, fmask = as_list(mask), as_list(fmask)
+        else:
+            x, y, mask, fmask = conv(x), conv(y), conv(mask), conv(fmask)
         p, _ = ravel_pytree(net.params)
         step0 = self._initial_step()
         iters = iterations or net.conf.iterations

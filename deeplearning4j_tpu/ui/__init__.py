@@ -18,10 +18,14 @@ from deeplearning4j_tpu.ui.stats_listener import (
     J7StatsListener,
     StatsListener,
 )
+from deeplearning4j_tpu.ui.conv_listener import (
+    ConvolutionalIterationListener,
+)
 
 __all__ = [
     "FileStatsStorage", "InMemoryStatsStorage",
     "StatsInitializationReport", "StatsReport", "StatsStorage",
     "decode_record", "RemoteUIStatsStorageRouter", "UIServer",
     "J7StatsListener", "StatsListener",
+    "ConvolutionalIterationListener",
 ]

@@ -1,0 +1,84 @@
+"""Convolutional activation visualization (reference
+``ConvolutionalIterationListener`` + ``ConvolutionalListenerModule`` —
+renders each conv layer's activation maps for one example as an image
+grid in the training UI).
+
+The listener re-runs the forward pass on the first example of the
+net's last minibatch every ``frequency`` iterations, tiles each conv
+layer's [C, H, W] activations into one grayscale grid, PNG-encodes it
+(PIL) and hands it to the UIServer, which serves it at
+``/train/activations``.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+from typing import Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+def _tile_grid(act: np.ndarray, pad: int = 1) -> np.ndarray:
+    """[C, H, W] -> one [gh*H', gw*W'] uint8 grid, channels tiled
+    near-square, each map min-max normalized."""
+    c, h, w = act.shape
+    gw = int(np.ceil(np.sqrt(c)))
+    gh = int(np.ceil(c / gw))
+    out = np.zeros((gh * (h + pad) + pad, gw * (w + pad) + pad),
+                   np.uint8)
+    for i in range(c):
+        a = act[i]
+        lo, hi = float(a.min()), float(a.max())
+        norm = (a - lo) / (hi - lo) if hi > lo else np.zeros_like(a)
+        r, col = divmod(i, gw)
+        y = pad + r * (h + pad)
+        x = pad + col * (w + pad)
+        out[y:y + h, x:x + w] = (norm * 255).astype(np.uint8)
+    return out
+
+
+def _png_b64(grid: np.ndarray) -> str:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(grid, mode="L").save(buf, format="PNG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+class ConvolutionalIterationListener(IterationListener):
+    """Every ``frequency`` iterations, publish conv activation grids
+    for one example to the UI server (reference
+    ``ConvolutionalIterationListener.java``)."""
+
+    supports_batched_iterations = True  # grids are per-snapshot anyway
+    needs_last_features = True  # nets snapshot the batch for us
+
+    def __init__(self, ui_server=None, frequency: int = 10):
+        self.ui_server = ui_server
+        self.frequency = max(int(frequency), 1)
+        self.last_grids: Dict[str, str] = {}
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency != 0:
+            return
+        x = getattr(model, "_last_features", None)
+        if x is None:
+            return
+        x1 = np.asarray(x)[:1]
+        try:
+            acts = model.feed_forward(x1)
+        except Exception:
+            return
+        grids: Dict[str, str] = {}
+        names = getattr(model, "layer_names", [])
+        for name, act in zip(names, acts):
+            a = np.asarray(act)
+            if a.ndim == 4:  # [1, C, H, W] conv activation
+                grids[str(name)] = _png_b64(_tile_grid(a[0]))
+        if grids:
+            self.last_grids = grids
+            if self.ui_server is not None:
+                self.ui_server.set_activations(grids)

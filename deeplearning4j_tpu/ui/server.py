@@ -49,7 +49,8 @@ _PAGE = """<!DOCTYPE html>
 <h1>deeplearning4j_tpu &mdash; Training UI</h1>
 <nav>
  <a data-tab="overview">Overview</a><a data-tab="histograms">Histograms</a>
- <a data-tab="model">Model</a><a data-tab="system">System</a>
+ <a data-tab="model">Model</a><a data-tab="graph">Graph</a>
+ <a data-tab="system">System</a><a data-tab="activations">Activations</a>
  <a data-tab="tsne">t-SNE</a>
 </nav>
 <div id="tab-overview">
@@ -73,6 +74,14 @@ _PAGE = """<!DOCTYPE html>
  <div class="card"><h2>Host RSS (MB) vs. Iteration</h2>
   <svg id="rss" width="820" height="200"></svg></div>
 </div>
+<div id="tab-graph" style="display:none">
+ <div class="card"><h2>Model Graph</h2>
+  <svg id="modelgraph" width="820" height="480"></svg></div>
+</div>
+<div id="tab-activations" style="display:none">
+ <div class="card"><h2>Conv Activations (latest snapshot)</h2>
+  <div id="actgrids"></div></div>
+</div>
 <div id="tab-tsne" style="display:none">
  <div class="card"><h2>t-SNE Embedding</h2>
   <svg id="tsneplot" width="820" height="540"></svg>
@@ -82,9 +91,74 @@ _PAGE = """<!DOCTYPE html>
 <script>
 const $ = (id) => document.getElementById(id);
 document.querySelectorAll('nav a').forEach(a => a.onclick = () => {
-  for (const t of ['overview','histograms','model','system','tsne'])
+  for (const t of ['overview','histograms','model','graph','system',
+                   'activations','tsne'])
     $('tab-'+t).style.display = (t === a.dataset.tab) ? '' : 'none';
 });
+function drawGraph(svg, g) {
+  svg.innerHTML = '';
+  if (!g.nodes || !g.nodes.length) return;
+  // layer nodes into columns by longest-path depth
+  const depth = {}, incoming = {};
+  g.nodes.forEach(n => { depth[n.name] = 0; incoming[n.name] = []; });
+  g.edges.forEach(e => incoming[e.to] && incoming[e.to].push(e.from));
+  for (let pass = 0; pass < g.nodes.length; pass++) {
+    let changed = false;
+    g.edges.forEach(e => {
+      if (depth[e.from] !== undefined &&
+          depth[e.to] < depth[e.from] + 1) {
+        depth[e.to] = depth[e.from] + 1; changed = true;
+      }
+    });
+    if (!changed) break;
+  }
+  const cols = {};
+  g.nodes.forEach(n => {
+    (cols[depth[n.name]] = cols[depth[n.name]] || []).push(n);
+  });
+  const W = +svg.getAttribute('width'), H = +svg.getAttribute('height');
+  const nCols = Object.keys(cols).length;
+  const pos = {};
+  Object.entries(cols).forEach(([d, nodes]) => {
+    nodes.forEach((n, i) => {
+      pos[n.name] = {
+        x: 30 + (W - 160) * (+d) / Math.max(nCols - 1, 1),
+        y: 30 + (H - 70) * i / Math.max(nodes.length - 1, 1) *
+           (nodes.length > 1 ? 1 : 0) + (nodes.length === 1 ? H/2-35 : 0),
+      };
+    });
+  });
+  const NS = 'http://www.w3.org/2000/svg';
+  g.edges.forEach(e => {
+    const a = pos[e.from], b = pos[e.to];
+    if (!a || !b) return;
+    const l = document.createElementNS(NS, 'line');
+    l.setAttribute('x1', a.x + 110); l.setAttribute('y1', a.y + 15);
+    l.setAttribute('x2', b.x); l.setAttribute('y2', b.y + 15);
+    l.setAttribute('stroke', '#999');
+    svg.append(l);
+  });
+  g.nodes.forEach(n => {
+    const p = pos[n.name];
+    const r = document.createElementNS(NS, 'rect');
+    r.setAttribute('x', p.x); r.setAttribute('y', p.y);
+    r.setAttribute('width', 110); r.setAttribute('height', 30);
+    r.setAttribute('rx', 4);
+    r.setAttribute('fill', n.type === 'input' ? '#def' : '#fff');
+    r.setAttribute('stroke', '#06c');
+    const t = document.createElementNS(NS, 'text');
+    t.setAttribute('x', p.x + 55); t.setAttribute('y', p.y + 13);
+    t.setAttribute('text-anchor', 'middle');
+    t.setAttribute('font-size', 10);
+    t.textContent = n.name;
+    const t2 = document.createElementNS(NS, 'text');
+    t2.setAttribute('x', p.x + 55); t2.setAttribute('y', p.y + 25);
+    t2.setAttribute('text-anchor', 'middle');
+    t2.setAttribute('font-size', 8); t2.setAttribute('fill', '#666');
+    t2.textContent = n.type;
+    svg.append(r, t, t2);
+  });
+}
 function line(svg, xs, series, colors) {
   // series: [[y...], ...] multi-line chart with shared scale
   svg.innerHTML = '';
@@ -194,6 +268,22 @@ async function refresh() {
   const s = await (await fetch('train/system?sid=' + sid)).json();
   line($('rss'), s.iterations, [s.rss_mb], COLORS);
 
+  const gr = await (await fetch('train/graph?sid=' + sid)).json();
+  drawGraph($('modelgraph'), gr);
+
+  const act = await (await fetch('train/activations')).json();
+  const ag = $('actgrids');
+  ag.textContent = '';
+  for (const [layer, b64] of Object.entries(act.grids || {})) {
+    const cap = document.createElement('div');
+    cap.textContent = 'layer ' + layer;
+    const img = document.createElement('img');
+    img.src = 'data:image/png;base64,' + b64;
+    img.style.imageRendering = 'pixelated';
+    img.style.width = '640px';
+    ag.append(cap, img);
+  }
+
   const t = await (await fetch('train/tsne')).json();
   const svg = $('tsneplot');
   svg.innerHTML = '';
@@ -284,6 +374,13 @@ def _make_handler(server: "UIServer"):
                 return
             if url.path == "/train/tsne":
                 self._json(server.tsne_coords())
+                return
+            if url.path == "/train/graph":
+                q = parse_qs(url.query)
+                self._json(server.graph_page(q.get("sid", [None])[0]))
+                return
+            if url.path == "/train/activations":
+                self._json(server.activations())
                 return
             self._json({"error": "not found"}, 404)
 
@@ -540,6 +637,30 @@ class UIServer:
             "software": dict(static.software) if static else {},
             "hardware": dict(static.hardware) if static else {},
         }
+
+    def graph_page(self, session_id: Optional[str]) -> dict:
+        """Model-graph page data (reference ``FlowListenerModule`` /
+        TrainModule model tab): nodes + edges recorded by
+        StatsListener's init report."""
+        static, _ = self._session_updates(session_id)
+        if static is None:
+            return {"nodes": [], "edges": []}
+        try:
+            g = json.loads(static.model.get("graph_json", "{}"))
+        except json.JSONDecodeError:
+            g = {}
+        return {"nodes": g.get("nodes", []),
+                "edges": g.get("edges", [])}
+
+    # -- conv activations (reference ConvolutionalListenerModule) --------
+
+    def set_activations(self, grids: dict) -> None:
+        """{layer_name: base64 PNG} from a
+        ConvolutionalIterationListener."""
+        self._activations = dict(grids)
+
+    def activations(self) -> dict:
+        return {"grids": getattr(self, "_activations", {})}
 
     # -- t-SNE module (reference TsneModule.java) ------------------------
 

@@ -26,6 +26,47 @@ from deeplearning4j_tpu.ui.model import (
 )
 
 
+def _graph_structure_json(model) -> str:
+    """Nodes + edges for the model-graph page (reference
+    ``FlowListenerModule``/``TrainModule`` model tab): a chain for
+    MultiLayerNetwork, vertex_inputs for ComputationGraph."""
+    import json
+
+    try:
+        conf = model.conf
+        if hasattr(conf, "vertex_inputs"):  # ComputationGraph
+            nodes = (
+                [{"name": n, "type": "input"} for n in conf.inputs]
+                + [
+                    {
+                        "name": n,
+                        "type": type(
+                            getattr(v, "layer_conf", None) or v
+                        ).__name__,
+                    }
+                    for n, v in conf.vertices.items()
+                ]
+            )
+            edges = [
+                {"from": src, "to": name}
+                for name, srcs in conf.vertex_inputs.items()
+                for src in srcs
+            ]
+        else:  # MultiLayerNetwork chain
+            names = list(getattr(model, "layer_names", []))
+            nodes = [{"name": "input", "type": "input"}] + [
+                {"name": n, "type": type(l).__name__}
+                for n, l in zip(names, conf.layers)
+            ]
+            chain = ["input"] + names
+            edges = [
+                {"from": a, "to": b} for a, b in zip(chain, chain[1:])
+            ]
+        return json.dumps({"nodes": nodes, "edges": edges})
+    except Exception:
+        return "{}"
+
+
 def _mean_magnitudes(tree: dict) -> dict:
     out = {}
     for lname, params in tree.items():
@@ -92,6 +133,7 @@ class StatsListener(IterationListener):
                 "class": type(model).__name__,
                 "layers": ",".join(getattr(model, "layer_names", [])),
                 "n_params": str(n_params),
+                "graph_json": _graph_structure_json(model),
             },
         )
         self.storage.put_static_info(rec)

@@ -6,3 +6,8 @@ from deeplearning4j_tpu.util.model_serializer import (  # noqa: F401
     restore_multi_layer_network,
     write_model,
 )
+from deeplearning4j_tpu.util.model_guesser import (  # noqa: F401
+    ModelGuessingException,
+    config_guess,
+    load_model_guess,
+)

@@ -1,0 +1,85 @@
+"""Load "whatever model file this is" (reference ``ModelGuesser``
+utility pattern in dl4j tooling — try each known artifact format in
+turn and return the right model object).
+
+Order tried:
+1. checkpoint zip (ModelSerializer layout: ``configuration.json`` +
+   ``coefficients.npz``) → MultiLayerNetwork / ComputationGraph,
+2. bare configuration JSON → un-initialized model from conf,
+3. Keras HDF5 (.h5) → imported MultiLayerNetwork / ComputationGraph.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+
+
+class ModelGuessingException(ValueError):
+    pass
+
+
+def load_model_guess(path: str):
+    """Return a model for any supported artifact (reference
+    ``ModelGuesser.loadModelGuess``)."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as zf:
+            names = set(zf.namelist())
+        if "configuration.json" in names:
+            from deeplearning4j_tpu.util.model_serializer import (
+                restore_model,
+            )
+
+            return restore_model(path)
+    # HDF5 magic
+    with open(path, "rb") as f:
+        head = f.read(8)
+    if head.startswith(b"\x89HDF\r\n\x1a\n"):
+        from deeplearning4j_tpu.modelimport import keras as keras_import
+
+        try:
+            return keras_import.import_sequential_model(path)
+        except Exception:
+            return keras_import.import_functional_api_model(path)
+    # conf JSON
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            d = json.load(f)
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ModelGuessingException(
+            f"{path!r} is not a checkpoint zip, Keras HDF5, or "
+            f"configuration JSON ({e})"
+        )
+    return config_guess(d)
+
+
+def config_guess(d: dict):
+    """Model (un-initialized) from a conf dict/JSON (reference
+    ``ModelGuesser.loadConfigGuess``)."""
+    if isinstance(d, str):
+        d = json.loads(d)
+    fmt = d.get("format", "")
+    if "MultiLayerConfiguration" in fmt:
+        from deeplearning4j_tpu.nn.conf.multi_layer import (
+            MultiLayerConfiguration,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return MultiLayerNetwork(
+            MultiLayerConfiguration.from_dict(d)
+        )
+    if "ComputationGraphConfiguration" in fmt:
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        return ComputationGraph(
+            ComputationGraphConfiguration.from_dict(d)
+        )
+    raise ModelGuessingException(
+        f"unrecognized configuration format {fmt!r}"
+    )

@@ -311,3 +311,71 @@ def test_async_error_not_redelivered():
             seen.append(int(ds.features[0, 0]))
     assert seen == [0, 1]
     assert not it.has_next()
+
+
+def test_curves_iterator_and_pretraining(tmp_path):
+    """Curves feeds autoencoder-style pretraining (reference
+    CurvesDataFetcher usage); synthetic generation needs the opt-in."""
+    from deeplearning4j_tpu.datasets.curves import CurvesDataSetIterator
+
+    with pytest.raises(FileNotFoundError, match="allow_synthetic"):
+        CurvesDataSetIterator(16, num_examples=32,
+                              data_dir=str(tmp_path))
+    with pytest.warns(RuntimeWarning, match="SYNTHETIC"):
+        it = CurvesDataSetIterator(16, num_examples=32,
+                                   data_dir=str(tmp_path),
+                                   allow_synthetic=True)
+    assert it.synthetic
+    ds = next(iter(it))
+    assert ds.features.shape == (16, 784)
+    np.testing.assert_array_equal(ds.features, ds.labels)
+    assert 0.0 <= ds.features.min() <= ds.features.max() <= 1.0
+    assert (ds.features.sum(axis=1) > 0).all()  # every image has a stroke
+    # real-file path: save npz and reload
+    np.savez(os.path.join(tmp_path, "curves.npz"),
+             features=np.ones((8, 784), np.float32) * 0.5)
+    it2 = CurvesDataSetIterator(4, data_dir=str(tmp_path))
+    assert not it2.synthetic
+    assert it2.total_examples() == 8
+
+
+def test_model_guesser(tmp_path):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.util import (
+        ModelGuessingException,
+        load_model_guess,
+        write_model,
+    )
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1)
+        .list()
+        .layer(DenseLayer(n_in=3, n_out=4))
+        .layer(OutputLayer(n_out=2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    # 1) checkpoint zip
+    zpath = os.path.join(tmp_path, "m.zip")
+    write_model(net, zpath)
+    loaded = load_model_guess(zpath)
+    assert type(loaded).__name__ == "MultiLayerNetwork"
+    np.testing.assert_array_equal(
+        np.asarray(loaded.params["0"]["W"]),
+        np.asarray(net.params["0"]["W"]),
+    )
+    # 2) bare conf JSON
+    jpath = os.path.join(tmp_path, "conf.json")
+    with open(jpath, "w") as f:
+        f.write(conf.to_json())
+    fresh = load_model_guess(jpath)
+    assert fresh.params is None  # un-initialized
+    assert len(fresh.conf.layers) == 2
+    # 3) garbage
+    gpath = os.path.join(tmp_path, "junk.bin")
+    with open(gpath, "wb") as f:
+        f.write(b"\x00\x01\x02 not a model")
+    with pytest.raises(ModelGuessingException):
+        load_model_guess(gpath)

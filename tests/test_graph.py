@@ -346,3 +346,141 @@ def test_graph_scan_fused_fit_matches_per_step(rng):
             np.testing.assert_array_equal(
                 np.asarray(a.params[vn][pn]), np.asarray(b.params[vn][pn])
             )
+
+
+def _check_graph_gradients(g, inputs, labels, rng, lmasks=None,
+                           n_per_param=4, eps=1e-6, tol=1e-3):
+    """Central differences vs jax.grad for a ComputationGraph in f64
+    (reference ``GradientCheckUtil.checkGradients`` CG variant at
+    ``GradientCheckUtil.java:194``)."""
+    import jax
+    import jax.numpy as jnp
+
+    with jax.enable_x64(True):
+        f64 = lambda t: jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, jnp.float64), t
+        )
+        params = f64(g.params)
+        state = f64(g.state)
+        xs = [jnp.asarray(np.asarray(x), jnp.float64) for x in inputs]
+        ys = [jnp.asarray(np.asarray(y), jnp.float64) for y in labels]
+        ms = (
+            [None if m is None else jnp.asarray(np.asarray(m),
+                                                jnp.float64)
+             for m in lmasks] if lmasks else None
+        )
+
+        def score(p):
+            s, _ = g._score_pure(p, state, xs, ys, ms, None, train=False)
+            return s
+
+        analytic = jax.grad(score)(params)
+        checked = 0
+        for vn in params:
+            for pn in params[vn]:
+                base = np.asarray(params[vn][pn], dtype=np.float64)
+                flat = base.ravel().copy()
+                a_grad = np.asarray(analytic[vn][pn]).ravel()
+                idxs = rng.choice(
+                    flat.size, size=min(n_per_param, flat.size),
+                    replace=False,
+                )
+                for i in idxs:
+                    orig = flat[i]
+                    vals = {}
+                    for sign in (1, -1):
+                        flat[i] = orig + sign * eps
+                        p2 = {k: dict(v) for k, v in params.items()}
+                        p2[vn][pn] = jnp.asarray(flat.reshape(base.shape))
+                        vals[sign] = float(score(p2))
+                    flat[i] = orig
+                    numeric = (vals[1] - vals[-1]) / (2 * eps)
+                    assert abs(numeric - a_grad[i]) < tol * max(
+                        1.0, abs(numeric)
+                    ), f"{vn}.{pn}[{i}]: {numeric} vs {a_grad[i]}"
+                    checked += 1
+        assert checked > 0
+
+
+def test_graph_gradients_cnn_merge(rng):
+    """CNN towers merged into dense output (reference
+    ``GradientCheckTestsComputationGraph`` CNN cases)."""
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer,
+        SubsamplingLayer,
+    )
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(5)
+        .graph_builder()
+        .add_inputs("img")
+        .add_layer("c1", ConvolutionLayer(n_out=3, kernel_size=(2, 2),
+                                          activation="tanh"), "img")
+        .add_layer("p1", SubsamplingLayer(pooling_type="AVG",
+                                          kernel_size=(2, 2)), "c1")
+        .add_layer("c2", ConvolutionLayer(n_out=2, kernel_size=(3, 3),
+                                          activation="sigmoid"), "img")
+        .add_layer("p2", SubsamplingLayer(pooling_type="MAX",
+                                          kernel_size=(2, 2)), "c2")
+        .add_vertex("m", MergeVertex(), "p1", "p2")
+        .add_layer("out", OutputLayer(n_out=2, loss="MCXENT"), "m")
+        .set_outputs("out")
+        .set_input_types(InputType.convolutional(6, 6, 1))
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    x = rng.randn(4, 1, 6, 6)
+    y = np.eye(2)[rng.randint(0, 2, 4)]
+    _check_graph_gradients(g, [x], [y], rng)
+
+
+def test_graph_gradients_rnn_masked_seq2seq(rng):
+    """Recurrent graph with LastTimeStep/DuplicateToTimeSeries vertices
+    under a labels mask (reference ``GradientCheckTestsMasking`` + CG
+    rnn cases)."""
+    conf = (
+        NeuralNetConfiguration.Builder().seed(5)
+        .graph_builder()
+        .add_inputs("seq")
+        .add_layer("enc", GravesLSTM(n_in=3, n_out=4), "seq")
+        .add_vertex("last", LastTimeStepVertex(mask_input="seq"), "enc")
+        .add_vertex("dup",
+                    DuplicateToTimeSeriesVertex(reference_input="seq"),
+                    "last")
+        .add_layer("dec", GravesLSTM(n_in=4, n_out=4), "dup")
+        .add_layer("out", RnnOutputLayer(n_in=4, n_out=2,
+                                         loss="MCXENT"), "dec")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    x = rng.randn(3, 3, 5)
+    y = np.zeros((3, 2, 5))
+    y[:, 0, :] = 1.0
+    mask = np.ones((3, 5))
+    mask[:, 4:] = 0.0
+    _check_graph_gradients(g, [x], [y], rng, lmasks=[mask],
+                           n_per_param=3)
+
+
+def test_graph_gradients_multi_output_weighted(rng):
+    """Two output layers with different losses (reference CG
+    multi-output gradient case)."""
+    conf = (
+        NeuralNetConfiguration.Builder().seed(9)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("h", DenseLayer(n_in=4, n_out=6, activation="elu",
+                                   l2=0.01), "in")
+        .add_layer("o1", OutputLayer(n_in=6, n_out=2, loss="MCXENT"),
+                   "h")
+        .add_layer("o2", OutputLayer(n_in=6, n_out=3, loss="MSE",
+                                     activation="identity"), "h")
+        .set_outputs("o1", "o2")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    x = rng.randn(5, 4)
+    y1 = np.eye(2)[rng.randint(0, 2, 5)]
+    y2 = rng.randn(5, 3)
+    _check_graph_gradients(g, [x], [y1, y2], rng)

@@ -1,0 +1,78 @@
+"""Native C++ data-loader tests (SURVEY.md §2.3: the native
+data-loader component; analog of the reference's backend-vs-builtin
+consistency tests — native results must equal the numpy fallback)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.native as nat
+
+
+def test_native_builds_and_loads():
+    # this environment ships g++ (Environment notes); the library must
+    # actually build here, not silently fall back
+    assert nat.native_available()
+
+
+def test_parse_idx3_matches_fallback(rng):
+    imgs = rng.randint(0, 256, (5, 28 * 28)).astype(np.uint8)
+    buf = struct.pack(">IIII", 2051, 5, 28, 28) + imgs.tobytes()
+    native = nat.parse_idx3(buf)
+    np.testing.assert_array_equal(native, imgs)
+    with pytest.raises(ValueError):
+        nat.parse_idx3(struct.pack(">IIII", 1234, 1, 2, 2) + b"\x00" * 4)
+
+
+def test_normalize_u8_matches_numpy(rng):
+    a = rng.randint(0, 256, (7, 13)).astype(np.uint8)
+    np.testing.assert_allclose(
+        nat.normalize_u8(a), a.astype(np.float32) / 255.0
+    )
+
+
+def test_assemble_batch_matches_numpy(rng):
+    n, d, k, b = 50, 12, 4, 16
+    feats = rng.randint(0, 256, (n, d)).astype(np.uint8)
+    labels = rng.randint(0, k, n).astype(np.uint8)
+    perm = rng.permutation(n)[:b]
+    x, y = nat.assemble_batch(feats, labels, perm, k)
+    np.testing.assert_allclose(
+        x, feats[perm].astype(np.float32) / 255.0
+    )
+    expect_y = np.zeros((b, k), np.float32)
+    expect_y[np.arange(b), labels[perm]] = 1.0
+    np.testing.assert_array_equal(y, expect_y)
+
+
+def test_split_cifar_matches_layout(rng):
+    n = 6
+    recs = []
+    for i in range(n):
+        label = np.uint8(i % 10)
+        img = rng.randint(0, 256, 3072).astype(np.uint8)
+        recs.append((label, img))
+    buf = b"".join(bytes([l]) + img.tobytes() for l, img in recs)
+    images, labels = nat.split_cifar(buf)
+    assert images.shape == (n, 3072)
+    np.testing.assert_array_equal(labels,
+                                  [l for l, _ in recs])
+    for i, (_, img) in enumerate(recs):
+        np.testing.assert_array_equal(images[i], img)
+    with pytest.raises(ValueError, match="3073"):
+        nat.split_cifar(b"\x00" * 100)
+
+
+def test_mnist_cifar_paths_use_native(tmp_path, rng):
+    """End-to-end through the dataset iterators (decode parity with
+    the pure-python path is covered by the iterators' own tests; here
+    we confirm the native library is on the path)."""
+    from deeplearning4j_tpu.datasets.mnist import read_idx_images
+
+    imgs = rng.randint(0, 256, (3, 784)).astype(np.uint8)
+    p = tmp_path / "train-images-idx3-ubyte"
+    with open(p, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 3, 28, 28))
+        f.write(imgs.tobytes())
+    np.testing.assert_array_equal(read_idx_images(str(p)), imgs)

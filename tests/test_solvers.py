@@ -147,3 +147,39 @@ def test_hidden_layer_network_trains_with_lbfgs(rng):
 
     ev = net.evaluate(ListDataSetIterator([ds]))
     assert ev.accuracy() > 0.9
+
+
+def test_lbfgs_on_computation_graph(rng):
+    """ComputationGraph must route non-SGD optimization_algo through
+    the Solver too (reference runs every algo on CG)."""
+    from deeplearning4j_tpu.datasets.api import MultiDataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(4).learning_rate(1.0)
+        .optimization_algo("LBFGS")
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                      activation="identity",
+                                      loss="MSE"), "in")
+        .set_outputs("out")
+        .build()
+    )
+    assert conf.optimization_algo == "LBFGS"
+    g = ComputationGraph(conf).init()
+    x, y = _convex_problem(rng)
+    mds = MultiDataSet(features=[x], labels=[y])
+    s0 = float(g.score(mds))
+    for _ in range(20):
+        g.fit_minibatch(mds)
+    s1 = float(g.score(mds))
+    assert s1 < s0 * 0.05, f"{s0} -> {s1}"
+    # round-trips through JSON too
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration,
+    )
+
+    assert ComputationGraphConfiguration.from_json(
+        conf.to_json()
+    ).optimization_algo == "LBFGS"

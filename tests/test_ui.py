@@ -254,3 +254,104 @@ class TestTrainPages:
         req = urllib.request.Request(base + "/tsne/post", data=bad)
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(req)
+
+
+class TestGraphAndActivations:
+    """Model-graph page + conv-activation grids (reference
+    ``FlowListenerModule``, ``ConvolutionalListenerModule`` /
+    ``ConvolutionalIterationListener``)."""
+
+    @pytest.fixture
+    def server(self):
+        s = UIServer(port=0)
+        yield s
+        s.stop()
+
+    def _get(self, server, path):
+        return json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}").read())
+
+    def test_graph_page_mln_chain(self, server):
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        _train_small_net(StatsListener(storage, frequency=1), n_iters=1)
+        sid = storage.list_session_ids()[0]
+        g = self._get(server, f"/train/graph?sid={sid}")
+        names = [n["name"] for n in g["nodes"]]
+        assert names == ["input", "0", "1"]
+        assert {"from": "input", "to": "0"} in g["edges"]
+        assert {"from": "0", "to": "1"} in g["edges"]
+
+    def test_graph_page_computation_graph(self, server):
+        from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_in=3, n_out=4), "a")
+            .add_layer("db", DenseLayer(n_in=3, n_out=4), "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2), "m")
+            .set_outputs("out")
+            .build()
+        )
+        g = ComputationGraph(conf).init()
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        g.set_listeners(StatsListener(storage, frequency=1))
+        rng = np.random.RandomState(0)
+        from deeplearning4j_tpu.datasets.api import MultiDataSet
+
+        mds = MultiDataSet(
+            features=[rng.rand(4, 3).astype(np.float32),
+                      rng.rand(4, 3).astype(np.float32)],
+            labels=[np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)]],
+        )
+        g.fit(mds)
+        sid = storage.list_session_ids()[0]
+        page = self._get(server, f"/train/graph?sid={sid}")
+        names = {n["name"] for n in page["nodes"]}
+        assert {"a", "b", "da", "db", "m", "out"} <= names
+        assert {"from": "m", "to": "out"} in page["edges"]
+
+    def test_conv_activation_grids(self, server):
+        import base64
+
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.layers import (
+            ConvolutionLayer,
+            SubsamplingLayer,
+        )
+        from deeplearning4j_tpu.ui import ConvolutionalIterationListener
+        from deeplearning4j_tpu.datasets.api import DataSet
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(1).learning_rate(0.05)
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="MAX"))
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        listener = ConvolutionalIterationListener(server, frequency=1)
+        net.listeners.append(listener)
+        rng = np.random.RandomState(0)
+        ds = DataSet(
+            features=rng.rand(4, 1, 8, 8).astype(np.float32),
+            labels=np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)],
+        )
+        net.fit(ds)
+        act = self._get(server, "/train/activations")
+        assert act["grids"]  # conv + pool layers captured
+        from PIL import Image
+        import io as _io
+
+        for b64 in act["grids"].values():
+            img = Image.open(_io.BytesIO(base64.b64decode(b64)))
+            assert img.size[0] > 1 and img.size[1] > 1
