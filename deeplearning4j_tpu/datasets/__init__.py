@@ -9,7 +9,10 @@ from deeplearning4j_tpu.datasets.api import (  # noqa: F401
 )
 from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     AsyncDataSetIterator,
+    INDArrayDataSetIterator,
+    MovingWindowDataSetIterator,
     MultipleEpochsIterator,
+    ReconstructionDataSetIterator,
     SamplingDataSetIterator,
 )
 from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator  # noqa: F401

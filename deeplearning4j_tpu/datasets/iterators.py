@@ -199,3 +199,133 @@ class SamplingDataSetIterator(DataSetIterator):
 
     def batch(self) -> int:
         return self.batch_size
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Wraps an iterator so labels == features (reference
+    ``ReconstructionDataSetIterator`` — autoencoder training over a
+    labeled dataset)."""
+
+    def __init__(self, base: DataSetIterator):
+        self.base = base
+
+    def has_next(self) -> bool:
+        return self.base.has_next()
+
+    def next(self) -> DataSet:
+        ds = self.base.next()
+        return DataSet(features=ds.features, labels=ds.features,
+                       features_mask=ds.features_mask,
+                       labels_mask=ds.features_mask)
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def batch(self) -> int:
+        return self.base.batch()
+
+
+class MovingWindowDataSetIterator(DataSetIterator):
+    """Sliding windows over the time axis of one sequence DataSet
+    (reference ``MovingWindowBaseDataSetIterator`` — windowed slices
+    become independent examples)."""
+
+    def __init__(self, full: DataSet, batch_size: int, window: int,
+                 stride: int = 1):
+        if full.features_mask is not None or full.labels_mask is not None:
+            raise ValueError(
+                "MovingWindow does not window mask arrays — padded "
+                "timesteps would become real training data; slice "
+                "masked sequences to their valid lengths first"
+            )
+        feats = np.asarray(full.features)
+        labels = np.asarray(full.labels)
+        if feats.ndim != 3:
+            raise ValueError(
+                "MovingWindow needs [batch, features, time] sequences"
+            )
+        t = feats.shape[2]
+        if labels.ndim == 3 and labels.shape[2] != t:
+            raise ValueError(
+                f"labels time length {labels.shape[2]} != features "
+                f"time length {t}"
+            )
+        if window > t:
+            raise ValueError(f"window {window} > sequence length {t}")
+        xs, ys = [], []
+        for start in range(0, t - window + 1, stride):
+            xs.append(feats[:, :, start:start + window])
+            ys.append(
+                labels[:, :, start:start + window]
+                if labels.ndim == 3 else labels
+            )
+        self._features = np.concatenate(xs)
+        self._labels = np.concatenate(ys)
+        self.batch_size = batch_size
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._features)
+
+    def next(self) -> DataSet:
+        i = self._pos
+        j = min(i + self.batch_size, len(self._features))
+        self._pos = j
+        return DataSet(features=self._features[i:j],
+                       labels=self._labels[i:j])
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_examples(self) -> int:
+        return len(self._features)
+
+
+class INDArrayDataSetIterator(DataSetIterator):
+    """Batches from raw (features, labels) array pairs (reference
+    ``INDArrayDataSetIterator``)."""
+
+    def __init__(self, pairs, batch_size: int):
+        feats, labels = [], []
+        for i, (f, l) in enumerate(pairs):
+            f = np.asarray(f)
+            l = np.asarray(l)
+            f = f if f.ndim > 1 else f[None, :]
+            l = l if l.ndim > 1 else l[None, :]
+            if len(f) != len(l):
+                # per-pair check: totals can cancel out and misalign
+                # every later example's labels
+                raise ValueError(
+                    f"pair {i}: features have {len(f)} examples but "
+                    f"labels have {len(l)}"
+                )
+            feats.append(f)
+            labels.append(l)
+        if not feats:
+            raise ValueError("no (features, labels) pairs given")
+        self._features = np.concatenate(feats)
+        self._labels = np.concatenate(labels)
+        self.batch_size = batch_size
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._features)
+
+    def next(self) -> DataSet:
+        i = self._pos
+        j = min(i + self.batch_size, len(self._features))
+        self._pos = j
+        return DataSet(features=self._features[i:j],
+                       labels=self._labels[i:j])
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_examples(self) -> int:
+        return len(self._features)

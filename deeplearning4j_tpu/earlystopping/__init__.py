@@ -4,9 +4,11 @@ calculators + savers + ``EarlyStoppingTrainer``)."""
 
 from deeplearning4j_tpu.earlystopping.core import (  # noqa: F401
     BestScoreEpochTerminationCondition,
+    ClusterEarlyStoppingTrainer,
     DataSetLossCalculator,
     EarlyStoppingConfiguration,
     EarlyStoppingGraphTrainer,
+    EarlyStoppingParallelTrainer,
     EarlyStoppingResult,
     EarlyStoppingTrainer,
     InMemoryModelSaver,

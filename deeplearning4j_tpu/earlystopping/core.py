@@ -239,6 +239,18 @@ class EarlyStoppingTrainer:
         self.model = model
         self.train_iterator = train_iterator
 
+    def _train_epoch(self):
+        """One epoch; returns the tripped iteration-termination
+        condition or None. Subclasses replace the training mechanics
+        (parallel wrapper / cluster master) but share the loop."""
+        cfg = self.config
+        for ds in self.train_iterator:
+            self.model.fit_minibatch(ds)
+            for c in cfg.iteration_terminations:
+                if c.terminate(self.model.score_value):
+                    return c
+        return None
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         for c in cfg.epoch_terminations:
@@ -251,15 +263,7 @@ class EarlyStoppingTrainer:
         epoch = 0
         reason, details = "MaxEpochs", "exhausted"
         while True:
-            stop_iter = None
-            for ds in self.train_iterator:
-                self.model.fit_minibatch(ds)
-                for c in cfg.iteration_terminations:
-                    if c.terminate(self.model.score_value):
-                        stop_iter = c
-                        break
-                if stop_iter is not None:
-                    break
+            stop_iter = self._train_epoch()
             if hasattr(self.train_iterator, "reset"):
                 self.train_iterator.reset()
             if stop_iter is not None:
@@ -306,3 +310,50 @@ class EarlyStoppingTrainer:
 class EarlyStoppingGraphTrainer(EarlyStoppingTrainer):
     """Reference ``EarlyStoppingGraphTrainer`` — same loop over a
     ComputationGraph."""
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping over data-parallel replica training (reference
+    ``parallelism/EarlyStoppingParallelTrainer.java`` — wraps
+    ParallelWrapper instead of the single-model fit). Each epoch the
+    wrapper deals the iterator's batches to replicas and averages;
+    evaluation/termination runs on the synchronized model."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_iterator, workers: int = 2,
+                 averaging_frequency: int = 1):
+        super().__init__(config, model, train_iterator)
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        self.wrapper = ParallelWrapper(
+            model, workers=workers,
+            averaging_frequency=averaging_frequency,
+        )
+
+    def _train_epoch(self):
+        self.wrapper.fit(self.train_iterator)
+        for c in self.config.iteration_terminations:
+            if c.terminate(self.model.score_value):
+                return c
+        return None
+
+
+class ClusterEarlyStoppingTrainer(EarlyStoppingTrainer):
+    """Early stopping over cluster training (reference
+    ``spark/earlystopping/SparkEarlyStoppingTrainer.java`` — each
+    epoch runs through the TrainingMaster instead of per-batch
+    fitting)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net,
+                 training_master, train_data):
+        super().__init__(config, net, train_data)
+        self.training_master = training_master
+
+    def _train_epoch(self):
+        self.training_master.execute_training(
+            self.model, self.train_iterator
+        )
+        for c in self.config.iteration_terminations:
+            if c.terminate(self.model.score_value):
+                return c
+        return None

@@ -41,8 +41,7 @@ from deeplearning4j_tpu.nlp.vocab import (
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _ns_step(syn0, syn1neg, centers, contexts, negs, mask, alpha):
+def _ns_step_raw(syn0, syn1neg, centers, contexts, negs, mask, alpha):
     """Negative-sampling step (SkipGram: centers=input word ids,
     contexts=predicted word ids; CBOW passes precomputed context means
     through ``_ns_step_cbow`` instead)."""
@@ -67,8 +66,8 @@ def _ns_step(syn0, syn1neg, centers, contexts, negs, mask, alpha):
     return syn0 - alpha * g0, syn1neg - alpha * g1, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _hs_step(syn0, syn1, centers, codes, points, path_mask, mask, alpha):
+def _hs_step_raw(syn0, syn1, centers, codes, points, path_mask, mask,
+                 alpha):
     """Hierarchical-softmax step: codes/points are the context word's
     padded Huffman path ([B, L]); loss per node is
     -log σ((1-2·code)·(v_center · syn1[point]))."""
@@ -83,6 +82,38 @@ def _hs_step(syn0, syn1, centers, codes, points, path_mask, mask, alpha):
 
     loss, (g0, g1) = jax.value_and_grad(loss_fn)((syn0, syn1))
     return syn0 - alpha * g0, syn1 - alpha * g1, loss
+
+
+_ns_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_ns_step_raw)
+_hs_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_hs_step_raw)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _sg_scan_steps(syn0, syn1, syn1neg, centers_k, contexts_k, codes_k,
+                   points_k, pmask_k, negs_k, mask_k, alphas_k):
+    """k skip-gram batches fused into ONE dispatch via lax.scan (same
+    rationale as MultiLayerNetwork._build_multi_step: per-batch
+    host->device transfers+dispatches bound throughput). hs/ns legs
+    participate according to which table carries are non-None."""
+
+    def body(tables, per):
+        s0, s1, s1n = tables
+        c, o, cd, pt, pm, ng, m, a = per
+        loss = 0.0
+        if s1 is not None:
+            s0, s1, l1 = _hs_step_raw(s0, s1, c, cd, pt, pm, m, a)
+            loss = loss + l1
+        if s1n is not None:
+            s0, s1n, l2 = _ns_step_raw(s0, s1n, c, o, ng, m, a)
+            loss = loss + l2
+        return (s0, s1, s1n), loss
+
+    (syn0, syn1, syn1neg), losses = jax.lax.scan(
+        body, (syn0, syn1, syn1neg),
+        (centers_k, contexts_k, codes_k, points_k, pmask_k, negs_k,
+         mask_k, alphas_k),
+    )
+    return syn0, syn1, syn1neg, losses
 
 
 def _cbow_hidden(s0, ctx_ids, ctx_mask):
@@ -212,6 +243,7 @@ class SequenceVectors:
         self.batch_size = batch_size
         self.seed = seed
         self.algorithm = algorithm
+        self.scan_chunk = 16  # skip-gram batches fused per dispatch
         self.lookup = InMemoryLookupTable(
             cache, layer_size, seed=seed, use_hs=use_hierarchic_softmax,
             negative=negative,
@@ -324,6 +356,15 @@ class SequenceVectors:
                 n_items = len(c)
             if total_items is None:
                 total_items = max(n_items * self.epochs, 1)
+            if (
+                not cbow and self.scan_chunk > 1
+                and self.iterations == 1
+                and self._scan_path_ok()
+            ):
+                step = self._fit_epoch_scan(
+                    c, o, step, total_items, lr0, lr_min
+                )
+                continue
             for s in range(0, n_items, B):
                 mask = np.ones(B, np.float32)
                 if cbow:
@@ -350,6 +391,71 @@ class SequenceVectors:
                         self._apply_batch(cb, ob, mask, alpha, step)
                 step += 1
         self.lookup.invalidate_norms()
+
+    def _scan_path_ok(self) -> bool:
+        """The scan epoch bypasses the per-batch ``_apply_batch`` hook;
+        a subclass overriding it would silently lose its override, so
+        scanning requires either the base hook or an explicit
+        ``scan_path_compatible = True`` (set by subclasses that hook
+        placement via ``_put_stacked`` instead)."""
+        return (
+            type(self)._apply_batch is SequenceVectors._apply_batch
+            or getattr(self, "scan_path_compatible", False)
+        )
+
+    def _fit_epoch_scan(self, centers, contexts, step, total_items,
+                        lr0, lr_min) -> int:
+        """Skip-gram epoch in scan-fused dispatches: ``scan_chunk``
+        batches per XLA call, identical math/negative-sampling to the
+        per-batch path (same per-batch step seeds and alphas)."""
+        B = self.batch_size
+        K = self.scan_chunk
+        lk = self.lookup
+        n = len(centers)
+        for s0 in range(0, n, B * K):
+            cs = centers[s0:s0 + B * K]
+            os_ = contexts[s0:s0 + B * K]
+            k = (len(cs) + B - 1) // B
+            pad = k * B - len(cs)
+            mask = np.ones(k * B, np.float32)
+            if pad:
+                mask[len(cs):] = 0.0
+                cs = np.pad(cs, (0, pad))
+                os_ = np.pad(os_, (0, pad))
+            ck = cs.reshape(k, B)
+            ok = os_.reshape(k, B)
+            mk = mask.reshape(k, B)
+            alphas = np.empty(k, np.float32)
+            negs = (
+                np.empty((k, B, self.negative), np.int32)
+                if self.negative > 0 else None
+            )
+            for i in range(k):
+                frac = min(((step + i) * B) / total_items, 1.0)
+                alphas[i] = max(lr0 * (1 - frac), lr_min)
+                if negs is not None:
+                    negs[i] = self._sample_negatives(B, step + i)
+            if self.use_hs:
+                codes, points, pmask = self._path_arrays(ok.ravel())
+                ckd = jnp.asarray(codes).reshape(k, B, -1)
+                ptd = jnp.asarray(points).reshape(k, B, -1)
+                pmd = jnp.asarray(pmask).reshape(k, B, -1)
+            else:
+                ckd = ptd = pmd = None
+            lk.syn0, lk.syn1, lk.syn1neg, _ = _sg_scan_steps(
+                lk.syn0, lk.syn1, lk.syn1neg,
+                self._put_stacked(ck), self._put_stacked(ok),
+                ckd, ptd, pmd,
+                self._put_stacked(negs) if negs is not None else None,
+                self._put_stacked(mk), jnp.asarray(alphas),
+            )
+            step += k
+        return step
+
+    def _put_stacked(self, a):
+        """Placement hook for [k, B, ...] stacked batch arrays (the
+        mesh-sharded subclass shards the B axis)."""
+        return jnp.asarray(a)
 
     def _path_arrays(self, word_ids: np.ndarray):
         codes = jnp.asarray(self._codes[word_ids])

@@ -116,6 +116,19 @@ class _MeshBatchMixin:
     def _shard_batch(self, a):
         return jax.device_put(np.asarray(a), self._batch_sharding)
 
+    # the scan epoch is sharding-aware through _put_stacked, so the
+    # bypassed _apply_batch override is fine here
+    scan_path_compatible = True
+
+    def _put_stacked(self, a):
+        """[k, B, ...] scan-path arrays: shard the batch axis (axis 1)
+        over 'data'."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(
+            np.asarray(a), NamedSharding(self.mesh, P(None, "data"))
+        )
+
     def _replicate_tables(self) -> None:
         lk = self.lookup
         lk.syn0 = jax.device_put(lk.syn0, self._rep)

@@ -379,3 +379,47 @@ def test_model_guesser(tmp_path):
         f.write(b"\x00\x01\x02 not a model")
     with pytest.raises(ModelGuessingException):
         load_model_guess(gpath)
+
+
+def test_reconstruction_iterator():
+    from deeplearning4j_tpu.datasets import ReconstructionDataSetIterator
+
+    base = ListDataSetIterator(_batches(n=3, b=4))
+    it = ReconstructionDataSetIterator(base)
+    for ds in it:
+        np.testing.assert_array_equal(ds.features, ds.labels)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_moving_window_iterator():
+    from deeplearning4j_tpu.datasets import MovingWindowDataSetIterator
+
+    feats = np.arange(2 * 3 * 10, dtype=np.float32).reshape(2, 3, 10)
+    labels = np.ones((2, 2, 10), np.float32)
+    full = DataSet(features=feats, labels=labels)
+    it = MovingWindowDataSetIterator(full, batch_size=4, window=4,
+                                     stride=2)
+    # windows at t=0,2,4,6 -> 4 windows x 2 examples = 8
+    assert it.total_examples() == 8
+    ds = next(iter(it))
+    assert ds.features.shape == (4, 3, 4)
+    assert ds.labels.shape == (4, 2, 4)
+    # first window content check
+    np.testing.assert_array_equal(ds.features[0], feats[0, :, 0:4])
+    with pytest.raises(ValueError, match="window"):
+        MovingWindowDataSetIterator(full, batch_size=2, window=11)
+
+
+def test_indarray_iterator():
+    from deeplearning4j_tpu.datasets import INDArrayDataSetIterator
+
+    pairs = [
+        (np.ones((3, 2), np.float32), np.zeros((3, 1), np.float32)),
+        (np.ones(2, np.float32) * 2, np.ones(1, np.float32)),
+    ]
+    it = INDArrayDataSetIterator(pairs, batch_size=2)
+    assert it.total_examples() == 4
+    batches = list(it)
+    assert [b.features.shape[0] for b in batches] == [2, 2]
+    assert batches[-1].features[-1, 0] == 2.0

@@ -259,3 +259,56 @@ def test_paragraph_vectors_topics(algo):
     assert same > diff, (algo, same, diff)
     v = pv.get_vector("doc_0")
     assert v.shape == (20,)
+
+
+@pytest.mark.parametrize("hs,neg", [(False, 5), (True, 0), (True, 3)])
+def test_w2v_scan_fused_matches_per_batch(rng, hs, neg):
+    """The scan-fused skip-gram epoch must reproduce the per-batch
+    path exactly (same alphas, same negative draws per step)."""
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+    from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+
+    words = [f"w{i}" for i in range(25)]
+    sents = [
+        [words[rng.randint(0, 25)] for _ in range(10)]
+        for _ in range(40)
+    ]
+    cache = VocabConstructor(
+        min_word_frequency=1
+    ).build_vocab_from_tokens(sents)
+    ids = [
+        np.asarray([cache.index_of(w) for w in s], np.int32)
+        for s in sents
+    ]
+
+    class _Seq(SequenceVectors):
+        def __init__(self, cache, seqs, **kw):
+            super().__init__(cache, **kw)
+            self._seqs = seqs
+
+        def _sequences(self):
+            return iter(self._seqs)
+
+    kw = dict(layer_size=12, window=3, negative=neg,
+              use_hierarchic_softmax=hs, batch_size=32, epochs=2,
+              seed=9)
+    a = _Seq(cache, ids, **kw)
+    a.scan_chunk = 1  # per-batch path
+    a.fit()
+    b = _Seq(cache, ids, **kw)
+    b.scan_chunk = 4
+    b.fit()
+    np.testing.assert_allclose(
+        np.asarray(a.lookup.syn0), np.asarray(b.lookup.syn0),
+        rtol=1e-6, atol=1e-7,
+    )
+    if hs:
+        np.testing.assert_allclose(
+            np.asarray(a.lookup.syn1), np.asarray(b.lookup.syn1),
+            rtol=1e-6, atol=1e-7,
+        )
+    if neg > 0:
+        np.testing.assert_allclose(
+            np.asarray(a.lookup.syn1neg), np.asarray(b.lookup.syn1neg),
+            rtol=1e-6, atol=1e-7,
+        )

@@ -176,3 +176,58 @@ def test_early_stopping_score_improvement(rng):
     result = EarlyStoppingTrainer(cfg, net, train).fit()
     assert result.total_epochs < 200
     assert result.best_model_epoch >= 0
+
+
+def test_early_stopping_parallel_trainer(rng, tmp_path):
+    """Replica-averaged early stopping (reference
+    ``EarlyStoppingParallelTrainer``)."""
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingParallelTrainer,
+        InMemoryModelSaver,
+    )
+
+    x, y = blob_data(rng)
+    train = ListDataSetIterator(DataSet(features=x, labels=y).batch_by(20))
+    holdout = ListDataSetIterator([DataSet(features=x, labels=y)])
+    net = simple_net()
+    s0 = float(net.score(x=x, labels=y))
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(holdout),
+        epoch_terminations=[MaxEpochsTerminationCondition(3)],
+        model_saver=InMemoryModelSaver(),
+    )
+    result = EarlyStoppingParallelTrainer(
+        cfg, net, train, workers=2, averaging_frequency=1
+    ).fit()
+    assert result.total_epochs == 3
+    assert result.best_model_score < s0
+    assert result.best_model is not None
+
+
+def test_early_stopping_cluster_trainer(rng, tmp_path):
+    """Cluster-master early stopping (reference
+    ``SparkEarlyStoppingTrainer``)."""
+    from deeplearning4j_tpu.earlystopping import (
+        ClusterEarlyStoppingTrainer,
+        InMemoryModelSaver,
+    )
+    from deeplearning4j_tpu.parallel import (
+        ParameterAveragingTrainingMaster,
+    )
+
+    x, y = blob_data(rng)
+    train = DataSet(features=x, labels=y)
+    holdout = ListDataSetIterator([train])
+    net = simple_net()
+    s0 = float(net.score(x=x, labels=y))
+    master = ParameterAveragingTrainingMaster(
+        workers=2, batch_size_per_worker=10, averaging_frequency=1
+    )
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(holdout),
+        epoch_terminations=[MaxEpochsTerminationCondition(3)],
+        model_saver=InMemoryModelSaver(),
+    )
+    result = ClusterEarlyStoppingTrainer(cfg, net, master, train).fit()
+    assert result.total_epochs == 3
+    assert result.best_model_score < s0
