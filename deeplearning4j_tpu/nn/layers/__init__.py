@@ -46,3 +46,6 @@ from deeplearning4j_tpu.nn.layers.attention import (  # noqa: F401
     LayerNormalization,
     MultiHeadSelfAttention,
 )
+from deeplearning4j_tpu.nn.layers.moe import (  # noqa: F401
+    MixtureOfExperts,
+)

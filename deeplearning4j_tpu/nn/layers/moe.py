@@ -1,0 +1,101 @@
+"""Mixture-of-Experts layer for the layer-stack API (net-new vs the
+reference — SURVEY.md §2.4 has no EP/MoE; designed to slot into
+``MultiLayerNetwork``/``ComputationGraph`` like any feed-forward
+layer).
+
+Single-chip semantics use the dense Switch dispatch from
+:mod:`deeplearning4j_tpu.parallel.expert` (top-1 routing, per-batch
+capacity, dropped tokens pass through as zeros via the residual add).
+For mesh execution shard the expert-stacked params over an ``expert``
+axis with ``ExpertParallelMoE`` — same math, all_to_all token
+exchange.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import register_layer
+from deeplearning4j_tpu.nn.layers.feedforward import FeedForwardLayerSpec
+
+
+@register_layer
+@dataclass(frozen=True)
+class MixtureOfExperts(FeedForwardLayerSpec):
+    """Switch-style MoE FFN block: router -> top-1 expert two-layer
+    FFN -> combine, with a residual connection (so capacity-dropped
+    tokens keep their input representation). n_in == n_out."""
+
+    n_experts: int = 4
+    hidden_size: int = 0  # 0 -> 4 * n_in
+    capacity_factor: float = 1.25
+
+    def with_input_type(self, input_type):
+        import dataclasses
+
+        layer = super().with_input_type(input_type)
+        if not layer.n_out:  # residual block: width preserved
+            layer = dataclasses.replace(layer, n_out=layer.n_in)
+        if layer.n_out and layer.n_in and layer.n_out != layer.n_in:
+            raise ValueError(
+                "MixtureOfExperts is residual: n_out must equal n_in "
+                f"(got {layer.n_in} -> {layer.n_out})"
+            )
+        return layer
+
+    def _hidden(self) -> int:
+        return self.hidden_size or 4 * self.n_in
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        from deeplearning4j_tpu.parallel.expert import init_moe_params
+
+        p = init_moe_params(
+            key, self.n_in, self._hidden(), self.n_experts, dtype
+        )
+        return p
+
+    def regularizable_params(self) -> tuple:
+        return ("w1", "w2")
+
+    def apply(self, params, x, state, *, train=False, rng=None,
+              mask=None):
+        from deeplearning4j_tpu.parallel.expert import moe_ffn_reference
+
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        seq = x.ndim == 3
+        if seq:  # [b, f, t] recurrent layout -> tokens [b*t, f]
+            b, f, t = x.shape
+            tokens = x.transpose(0, 2, 1).reshape(b * t, f)
+            token_mask = (
+                mask.reshape(b * t) if mask is not None else None
+            )
+        else:
+            tokens = x
+            token_mask = mask
+        # padding tokens: no routing (capacity untouched), zero expert
+        # update through the residual, zeroed output like the sibling
+        # attention layer
+        out = tokens + moe_ffn_reference(
+            params, tokens, self.capacity_factor, token_mask
+        )
+        out = self.activate_fn()(out)
+        if token_mask is not None:
+            out = out * token_mask[:, None].astype(out.dtype)
+        if seq:
+            out = out.reshape(b, t, f).transpose(0, 2, 1)
+        return out, state
+
+    def aux_loss(self, params, x) -> jax.Array:
+        """Load-balancing auxiliary loss for custom training loops."""
+        from deeplearning4j_tpu.parallel.expert import (
+            aux_load_balance_loss,
+        )
+
+        tokens = (
+            x.transpose(0, 2, 1).reshape(-1, x.shape[1])
+            if x.ndim == 3 else x
+        )
+        return aux_load_balance_loss(tokens @ params["router"])

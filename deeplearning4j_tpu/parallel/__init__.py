@@ -24,6 +24,18 @@ from deeplearning4j_tpu.parallel.cluster_nlp import (  # noqa: F401
     ClusterWord2Vec,
     TextPipeline,
 )
+from deeplearning4j_tpu.parallel.expert import (  # noqa: F401
+    ExpertParallelMoE,
+    aux_load_balance_loss,
+    build_expert_mesh,
+    init_moe_params,
+    moe_ffn_reference,
+    switch_dispatch,
+)
+from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
+    GPipe,
+    build_pipe_mesh,
+)
 from deeplearning4j_tpu.parallel.sequence import (  # noqa: F401
     attention,
     build_seq_mesh,

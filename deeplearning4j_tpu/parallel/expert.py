@@ -1,0 +1,223 @@
+"""Expert parallelism: Switch-style top-1 mixture-of-experts with the
+experts sharded over an ``expert`` mesh axis and tokens exchanged via
+``lax.all_to_all``.
+
+Net-new capability vs the reference (SURVEY.md §2.4: no EP), designed
+TPU-first: routing builds a static-shape dispatch tensor
+(position-in-expert cumsum, capacity-clipped — the Switch Transformer
+dispatch), tokens hop to their expert's device with ONE all_to_all over
+ICI, each device runs only its local experts' FFN on [capacity] tokens,
+and a second all_to_all brings results home. Dropped tokens (over
+capacity) pass through as zeros, exactly like the reference
+formulation of Switch.
+
+``moe_ffn_reference`` is the single-device dense-dispatch semantics the
+sharded path must reproduce bit-for-bit; the load-balancing auxiliary
+loss is the standard E * sum(f_e * p_e).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.sequence import _shard_map
+
+
+def build_expert_mesh(n_devices: int = None, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), axis_names=("expert",))
+
+
+def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int,
+                    dtype=jnp.float32) -> dict:
+    kg, kw1, kb1, kw2, kb2 = jax.random.split(key, 5)
+    scale_in = 1.0 / jnp.sqrt(jnp.asarray(d_model, dtype))
+    scale_h = 1.0 / jnp.sqrt(jnp.asarray(d_hidden, dtype))
+    return {
+        "router": jax.random.normal(kg, (d_model, n_experts), dtype)
+        * scale_in,
+        "w1": jax.random.normal(
+            kw1, (n_experts, d_model, d_hidden), dtype) * scale_in,
+        "b1": jnp.zeros((n_experts, d_hidden), dtype),
+        "w2": jax.random.normal(
+            kw2, (n_experts, d_hidden, d_model), dtype) * scale_h,
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def switch_dispatch(logits: jax.Array, capacity: int,
+                    token_mask: jax.Array = None
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 dispatch tensors (Switch Transformer routing).
+
+    logits [n, E] -> (dispatch [n, E, C] one-hot, combine [n, E, C]
+    gate-weighted, probs [n, E]). Tokens past an expert's capacity C
+    are dropped (all-zero rows in dispatch). ``token_mask`` [n] marks
+    valid tokens; masked (padding) tokens neither consume capacity nor
+    receive expert output."""
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)              # [n]
+    gate = jnp.max(probs, axis=-1)                       # [n]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=logits.dtype)
+    if token_mask is not None:
+        onehot = onehot * token_mask[:, None].astype(onehot.dtype)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot            # [n, E]
+    pos = jnp.sum(pos, axis=-1) - 1.0                    # [n]
+    keep = pos < capacity
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=logits.dtype)          # [n, C]
+    dispatch = (
+        onehot[:, :, None] * pos_oh[:, None, :]
+        * keep[:, None, None].astype(logits.dtype)
+    )
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine, probs
+
+
+def _expert_ffn(w1, b1, w2, b2, x):
+    """[E_local, C_total, d] tokens through per-expert 2-layer FFN."""
+    h = jax.nn.relu(
+        jnp.einsum("ecd,edh->ech", x, w1) + b1[:, None, :]
+    )
+    return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+
+def moe_ffn_reference(params: dict, x: jax.Array,
+                      capacity_factor: float = 1.25,
+                      token_mask: jax.Array = None) -> jax.Array:
+    """Single-device dense-dispatch Switch MoE: the semantics the
+    sharded path must match (capacity drops included). Masked tokens
+    produce zero output and consume no capacity."""
+    n, d = x.shape
+    e = params["router"].shape[1]
+    capacity = max(int(np.ceil(n * capacity_factor / e)), 1)
+    logits = x @ params["router"]
+    dispatch, combine, _ = switch_dispatch(logits, capacity, token_mask)
+    expert_in = jnp.einsum("nd,nec->ecd", x, dispatch)   # [E, C, d]
+    expert_out = _expert_ffn(
+        params["w1"], params["b1"], params["w2"], params["b2"],
+        expert_in,
+    )
+    return jnp.einsum("ecd,nec->nd", expert_out, combine)
+
+
+def aux_load_balance_loss(logits: jax.Array) -> jax.Array:
+    """Switch load-balancing loss E * sum_e f_e * p_e (f_e = fraction
+    of tokens routed to e, p_e = mean router prob)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    e = probs.shape[-1]
+    assign = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype)
+    f = jnp.mean(assign, axis=0)
+    p = jnp.mean(probs, axis=0)
+    return e * jnp.sum(f * p)
+
+
+class ExpertParallelMoE:
+    """Mesh-sharded Switch MoE (the EP runtime): experts live
+    stacked on axis 0 sharded over 'expert'; tokens stay data-sharded
+    on the same axis and travel through two all_to_alls."""
+
+    def __init__(self, mesh: Mesh, n_experts: int,
+                 capacity_factor: float = 1.25,
+                 axis_name: str = "expert"):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n_devices = mesh.shape[axis_name]
+        if n_experts % self.n_devices:
+            raise ValueError(
+                f"{n_experts} experts not divisible over "
+                f"{self.n_devices} devices"
+            )
+        self.n_experts = n_experts
+        self.capacity_factor = capacity_factor
+        self._jit_applies: dict = {}  # token count -> compiled fn
+
+    def shard_params(self, params: dict) -> dict:
+        rep = NamedSharding(self.mesh, P())
+        exp = NamedSharding(self.mesh, P(self.axis_name))
+        out = {"router": jax.device_put(params["router"], rep)}
+        for k in ("w1", "b1", "w2", "b2"):
+            out[k] = jax.device_put(params[k], exp)
+        return out
+
+    def _build(self, n_tokens: int):
+        axis = self.axis_name
+        nd = self.n_devices
+        e_total = self.n_experts
+        e_local = e_total // nd
+        n_local = n_tokens // nd
+        capacity = max(
+            int(np.ceil(n_local * self.capacity_factor / e_total)), 1
+        )
+
+        def local(router, w1, b1, w2, b2, x):
+            # x [n_local, d]; router replicated; experts local [e_local,...]
+            logits = x @ router
+            dispatch, combine, _ = switch_dispatch(logits, capacity)
+            expert_in = jnp.einsum("nd,nec->ecd", x, dispatch)
+            # [E, C, d] -> exchange so each device holds, for its OWN
+            # e_local experts, the token slices from every peer:
+            # [E, C, d] = [nd * e_local, C, d] --all_to_all--> same
+            # shape, rows now (peer, local expert)
+            shuf = jax.lax.all_to_all(
+                expert_in.reshape(nd, e_local * capacity, -1),
+                axis, split_axis=0, concat_axis=0, tiled=False,
+            )  # [nd, e_local*C, d] rows = source peers
+            shuf = shuf.reshape(nd, e_local, capacity, -1)
+            shuf = shuf.transpose(1, 0, 2, 3).reshape(
+                e_local, nd * capacity, -1
+            )
+            out = _expert_ffn(w1, b1, w2, b2, shuf)
+            # reverse the exchange
+            out = out.reshape(e_local, nd, capacity, -1)
+            out = out.transpose(1, 0, 2, 3).reshape(
+                nd, e_local * capacity, -1
+            )
+            back = jax.lax.all_to_all(
+                out, axis, split_axis=0, concat_axis=0, tiled=False,
+            ).reshape(e_total, capacity, -1)
+            return jnp.einsum("ecd,nec->nd", back, combine)
+
+        sm = _shard_map()(
+            local, mesh=self.mesh,
+            in_specs=(P(), P(axis), P(axis), P(axis), P(axis),
+                      P(axis)),
+            out_specs=P(axis),
+            check_rep=False,
+        )
+
+        def apply(params, x):
+            return sm(
+                params["router"], params["w1"], params["b1"],
+                params["w2"], params["b2"], x,
+            )
+
+        return apply
+
+    def apply(self, params: dict, x) -> jax.Array:
+        """x [n_tokens, d], n_tokens divisible by the device count;
+        tokens sharded over 'expert' (placed if not already). One
+        compile per distinct token count, all kept."""
+        x = jnp.asarray(x)
+        n = x.shape[0]
+        if n % self.n_devices:
+            raise ValueError(
+                f"{n} tokens not divisible by {self.n_devices} devices"
+            )
+        fn = self._jit_applies.get(n)
+        if fn is None:
+            fn = jax.jit(self._build(n))
+            self._jit_applies[n] = fn
+        x = jax.device_put(
+            x, NamedSharding(self.mesh, P(self.axis_name))
+        )
+        return fn(params, x)

@@ -1,0 +1,173 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a
+``pipe`` mesh axis.
+
+Net-new capability vs the reference (SURVEY.md §2.4: the reference has
+no PP), built the TPU way: each device owns one stage's parameters,
+microbatches flow stage-to-stage over ICI via ``lax.ppermute``, and
+the whole schedule — fill, steady state, drain — is one ``lax.scan``
+inside ``shard_map``, so XLA overlaps each hop's transfer with the
+next microbatch's compute. Differentiable end-to-end (the transpose of
+``ppermute`` is the reverse permute), so ``jax.grad`` of a loss on the
+pipeline output yields per-stage parameter gradients with activations
+rematerialized per microbatch — GPipe's memory trade.
+
+Constraints (the classic homogeneous-pipeline shape): every stage maps
+[mb, d] -> [mb, d] with the same pytree structure of per-stage params
+stacked on a leading ``n_stages`` axis (transformer-block stacks fit
+naturally; put embedding/head outside or fold into first/last stage
+fns).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.sequence import _shard_map
+
+
+def build_pipe_mesh(n_stages: int, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n_stages:
+        raise ValueError(
+            f"{n_stages} stages need >= {n_stages} devices, have "
+            f"{len(devices)}"
+        )
+    arr = np.asarray(devices[:n_stages])
+    return Mesh(arr, axis_names=("pipe",))
+
+
+def _gpipe_local(stage_params, xs, stage_fn: Callable, axis_name: str,
+                 n_stages: int, n_micro: int):
+    """Per-device GPipe schedule (runs inside shard_map).
+
+    stage_params: this stage's params, leading axis already squeezed.
+    xs: [n_micro, mb, d] microbatches (replicated; only stage 0 reads).
+    Returns [n_micro, mb, d] outputs (non-zero on the last stage only).
+    """
+    idx = jax.lax.axis_index(axis_name)
+    mb, d = xs.shape[1], xs.shape[2]
+    total = n_micro + n_stages - 1  # fill + steady + drain
+    pad = jnp.zeros((total - n_micro, mb, d), xs.dtype)
+    xs_pad = jnp.concatenate([xs, pad], axis=0)
+    # one hop forward around the ring; the wrap link (last -> 0)
+    # carries garbage that stage 0 never reads
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def step(carry, x_t):
+        recv, outs, t = carry
+        inp = jnp.where(idx == 0, x_t, recv)
+        out = stage_fn(stage_params, inp)
+        recv_next = jax.lax.ppermute(out, axis_name, fwd)
+        # last stage emits microbatch k = t - (n_stages - 1)
+        k = t - (n_stages - 1)
+        valid = (k >= 0) & (k < n_micro) & (idx == n_stages - 1)
+        upd = jax.lax.dynamic_update_slice(
+            outs, out[None], (jnp.clip(k, 0, n_micro - 1), 0, 0)
+        )
+        outs = jnp.where(valid, upd, outs)
+        return (recv_next, outs, t + 1), None
+
+    outs0 = jnp.zeros((n_micro, mb, d), xs.dtype)
+    recv0 = jnp.zeros((mb, d), xs.dtype)
+    (_, outs, _), _ = jax.lax.scan(
+        step, (recv0, outs0, jnp.asarray(0, jnp.int32)), xs_pad
+    )
+    # replicate the last stage's outputs to every device
+    outs = outs * (idx == n_stages - 1).astype(outs.dtype)
+    return jax.lax.psum(outs, axis_name)
+
+
+class GPipe:
+    """Stage-partitioned trainer/applier (the PP runtime).
+
+    ``stage_fn(params_i, x) -> y`` applied per stage; ``stage_params``
+    pytree with leading ``n_stages`` axis on every leaf, sharded over
+    the ``pipe`` mesh axis so each device holds exactly its stage.
+    """
+
+    def __init__(self, mesh: Mesh, stage_fn: Callable,
+                 n_micro: int = 4, axis_name: str = "pipe"):
+        self.mesh = mesh
+        self.stage_fn = stage_fn
+        self.axis_name = axis_name
+        self.n_stages = mesh.shape[axis_name]
+        self.n_micro = n_micro
+        self._jit_apply = None
+        self._jit_steps: dict = {}  # per loss_fn identity
+
+    def shard_params(self, stage_params):
+        """Place the [n_stages, ...] param pytree stage-per-device."""
+        spec = NamedSharding(self.mesh, P(self.axis_name))
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.asarray(a), spec), stage_params
+        )
+
+    def _microbatch(self, x):
+        b = x.shape[0]
+        if b % self.n_micro:
+            raise ValueError(
+                f"batch {b} not divisible by n_micro={self.n_micro}"
+            )
+        return x.reshape(self.n_micro, b // self.n_micro, *x.shape[1:])
+
+    def _build_apply(self):
+        axis, n_stages, n_micro = (
+            self.axis_name, self.n_stages, self.n_micro
+        )
+        stage_fn = self.stage_fn
+
+        def local(params, xs):
+            squeezed = jax.tree_util.tree_map(lambda a: a[0], params)
+            return _gpipe_local(
+                squeezed, xs, stage_fn, axis, n_stages, n_micro
+            )
+
+        sm = _shard_map()(
+            local, mesh=self.mesh,
+            in_specs=(P(self.axis_name), P()), out_specs=P(),
+            check_rep=False,
+        )
+
+        def apply(params, x):
+            xs = self._microbatch(x)
+            outs = sm(params, xs)
+            return outs.reshape(x.shape[0], -1)
+
+        return apply
+
+    def apply(self, stage_params, x):
+        """Forward through the pipeline: x [batch, d] -> [batch, d]."""
+        if self._jit_apply is None:
+            self._jit_apply = jax.jit(self._build_apply())
+        return self._jit_apply(stage_params, jnp.asarray(x))
+
+    def train_step(self, stage_params, x, y, loss_fn: Callable,
+                   lr: float = 0.01):
+        """One SGD step of ``loss_fn(pipeline(x), y)`` — per-stage
+        grads stay on their stage's device. Compiled once per distinct
+        ``loss_fn`` (the closure is baked into the program)."""
+        jit_step = self._jit_steps.get(loss_fn)
+        if jit_step is None:
+            apply = self._build_apply()
+
+            def step(params, x, y, lr):
+                def objective(p):
+                    return loss_fn(apply(p, x), y)
+
+                loss, grads = jax.value_and_grad(objective)(params)
+                new = jax.tree_util.tree_map(
+                    lambda p, g: p - lr * g, params, grads
+                )
+                return new, loss
+
+            jit_step = jax.jit(step)
+            self._jit_steps[loss_fn] = jit_step
+        return jit_step(
+            stage_params, jnp.asarray(x), jnp.asarray(y),
+            jnp.asarray(lr, jnp.float32),
+        )
