@@ -22,7 +22,12 @@ from deeplearning4j_tpu.datasets.lfw import LFWDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.record_reader import (  # noqa: F401
     CSVRecordReader,
+    CSVSequenceRecordReader,
     CollectionRecordReader,
+    CollectionSequenceRecordReader,
     RecordReader,
     RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+    SequenceRecordReader,
+    SequenceRecordReaderDataSetIterator,
 )

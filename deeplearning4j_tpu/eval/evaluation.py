@@ -108,9 +108,16 @@ class Evaluation:
                     meta2 = [m for m, k in zip(meta2, keep) if k]
             self.eval(lab2, pred2, record_meta_data=meta2)
             return
-        self._ensure(labels.shape[1])
-        actual = labels.argmax(axis=1)
-        guess = predictions.argmax(axis=1)
+        if labels.ndim == 2 and labels.shape[1] == 1:
+            # single output column = binary with 0.5 threshold
+            # (reference eval() nCols == 1 branch)
+            self._ensure(2)
+            actual = (labels[:, 0] > 0.5).astype(np.int64)
+            guess = (predictions[:, 0] > 0.5).astype(np.int64)
+        else:
+            self._ensure(labels.shape[1])
+            actual = labels.argmax(axis=1)
+            guess = predictions.argmax(axis=1)
         if mask is not None:
             keep = np.asarray(mask).reshape(-1).astype(bool)
             actual, guess = actual[keep], guess[keep]
@@ -198,15 +205,47 @@ class Evaluation:
             self._meta[key].extend(preds)
         return self
 
-    def stats(self) -> str:
+    def _label(self, c: int) -> str:
+        if self.labels and c < len(self.labels):
+            return str(self.labels[c])
+        return str(c)
+
+    def stats(self, print_confusion: bool = True) -> str:
+        """Summary + per-class breakdown + confusion matrix (reference
+        ``Evaluation.stats()`` layout)."""
         lines = [
-            "==========================Scores========================",
+            "========================Evaluation======================",
+            f" Examples:  {self.confusion.total()}",
             f" Accuracy:  {self.accuracy():.4f}",
             f" Precision: {self.precision():.4f}",
             f" Recall:    {self.recall():.4f}",
             f" F1 Score:  {self.f1():.4f}",
-            "========================================================",
+            "",
+            " Per-class:",
         ]
+        for c in range(self.n_classes):
+            lines.append(
+                f"  {self._label(c):>12}: precision={self.precision(c):.4f} "
+                f"recall={self.recall(c):.4f} f1={self.f1(c):.4f} "
+                f"(n={self.confusion.actual_total(c)})"
+            )
+        if print_confusion:
+            lines += ["", " Confusion matrix (rows=actual, cols=predicted):"]
+            # identical prefix + field widths as the data rows so the
+            # column headers sit over their counts
+            header = " ".join(
+                f"{self._label(c):>8}" for c in range(self.n_classes)
+            )
+            lines.append(f"  {'':>12} {header}")
+            for a in range(self.n_classes):
+                row = " ".join(
+                    f"{self.confusion.get_count(a, p):>8d}"
+                    for p in range(self.n_classes)
+                )
+                lines.append(f"  {self._label(a):>12} {row}")
+        lines.append(
+            "========================================================"
+        )
         return "\n".join(lines)
 
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -23,6 +24,25 @@ from deeplearning4j_tpu.nlp.tokenization import (
 )
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor, VocabWord
 from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+
+
+@jax.jit
+def _infer_step(v, syn1neg, words, negs, alpha):
+    """One inference gradient step on a fresh doc vector, tables
+    frozen (module-level so it compiles once per doc-length shape —
+    syn1neg rides as a traced arg instead of a baked constant)."""
+
+    def loss(v_):
+        u_pos = syn1neg[words]                  # [n, D]
+        pos = jax.nn.log_sigmoid(u_pos @ v_)
+        u_neg = syn1neg[negs]                   # [n, K, D]
+        nvalid = (negs != words[:, None]).astype(v_.dtype)
+        neg = jnp.sum(
+            nvalid * jax.nn.log_sigmoid(-(u_neg @ v_)), axis=-1
+        )
+        return -jnp.mean(pos + neg)
+
+    return v - alpha * jax.grad(loss)(v)
 
 
 class ParagraphVectors(SequenceVectors):
@@ -133,6 +153,47 @@ class ParagraphVectors(SequenceVectors):
     def get_vector(self, label: str) -> Optional[np.ndarray]:
         row = self._label_index.get(label)
         return None if row is None else np.asarray(self.lookup.syn0[row])
+
+    def infer_vector(self, tokens, epochs: int = 10,
+                     learning_rate: float = 0.05,
+                     seed: int = 0) -> np.ndarray:
+        """Embed an UNSEEN document (reference
+        ``ParagraphVectors.inferVector``): gradient-descend a fresh
+        doc vector against the frozen word/output tables under the
+        DBOW objective — one jitted step per epoch over all of the
+        doc's words at once."""
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(tokens, str):
+            tokens = tokens.split()
+        ids = np.asarray(
+            [
+                self.cache.index_of(t) for t in tokens
+                if t in self.cache
+                and self.cache.index_of(t) < self._n_words
+            ],
+            np.int32,
+        )
+        rng = np.random.RandomState(seed)
+        v = jnp.asarray(
+            (rng.rand(self.layer_size) - 0.5) / self.layer_size,
+            jnp.float32,
+        )
+        if len(ids) == 0 or self.lookup.syn1neg is None:
+            return np.asarray(v)
+        words = jnp.asarray(ids)
+        for e in range(epochs):
+            negs = jnp.asarray(self._table[
+                rng.randint(0, len(self._table),
+                            (len(ids), self.negative))
+            ])
+            alpha = jnp.float32(
+                max(learning_rate * (1 - e / max(epochs, 1)),
+                    self.min_learning_rate)
+            )
+            v = _infer_step(v, self.lookup.syn1neg, words, negs, alpha)
+        return np.asarray(v)
 
     def similarity_to_label(self, a: str, b: str) -> float:
         ra, rb = self._label_index.get(a), self._label_index.get(b)

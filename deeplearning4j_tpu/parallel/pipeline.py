@@ -150,8 +150,12 @@ class GPipe:
                    lr: float = 0.01):
         """One SGD step of ``loss_fn(pipeline(x), y)`` — per-stage
         grads stay on their stage's device. Compiled once per distinct
-        ``loss_fn`` (the closure is baked into the program)."""
-        jit_step = self._jit_steps.get(loss_fn)
+        loss function BODY (keyed by ``__code__`` so inline lambdas
+        re-created every call still hit the cache; a loss whose
+        closure captures changing values must be passed as a stable
+        callable instead)."""
+        key = getattr(loss_fn, "__code__", loss_fn)
+        jit_step = self._jit_steps.get(key)
         if jit_step is None:
             apply = self._build_apply()
 
@@ -166,7 +170,7 @@ class GPipe:
                 return new, loss
 
             jit_step = jax.jit(step)
-            self._jit_steps[loss_fn] = jit_step
+            self._jit_steps[key] = jit_step
         return jit_step(
             stage_params, jnp.asarray(x), jnp.asarray(y),
             jnp.asarray(lr, jnp.float32),

@@ -78,3 +78,28 @@ def test_merge_carries_metadata():
     assert a.get_prediction_errors() == [Prediction(0, 1, "x")]
     assert a.get_predictions(1, 1) == [Prediction(1, 1, "y")]
     assert a.accuracy() == 0.5
+
+
+def test_binary_single_column_eval():
+    """Single output column -> binary confusion at threshold 0.5
+    (reference eval() nCols == 1 branch)."""
+    e = Evaluation()
+    labels = np.array([[1.0], [0.0], [1.0], [0.0]])
+    preds = np.array([[0.9], [0.2], [0.3], [0.7]])
+    e.eval(labels, preds)
+    assert e.n_classes == 2
+    assert e.accuracy() == 0.5
+    assert e.confusion.get_count(1, 1) == 1  # TP
+    assert e.confusion.get_count(0, 0) == 1  # TN
+    assert e.confusion.get_count(1, 0) == 1  # FN
+    assert e.confusion.get_count(0, 1) == 1  # FP
+
+
+def test_stats_per_class_and_confusion():
+    e = Evaluation(labels=["cat", "dog"])
+    e.eval(np.eye(2)[[0, 0, 1, 1]], np.eye(2)[[0, 1, 1, 1]])
+    out = e.stats()
+    assert "cat" in out and "dog" in out
+    assert "Per-class" in out
+    assert "Confusion matrix" in out
+    assert "Accuracy:  0.7500" in out

@@ -312,3 +312,40 @@ def test_w2v_scan_fused_matches_per_batch(rng, hs, neg):
             np.asarray(a.lookup.syn1neg), np.asarray(b.lookup.syn1neg),
             rtol=1e-6, atol=1e-7,
         )
+
+
+def test_paragraph_vectors_infer_unseen_doc():
+    """inferVector analog: an unseen document lands nearer to its
+    topic's training docs (reference ParagraphVectors.inferVector)."""
+    rng = np.random.RandomState(2)
+    topic_a = ["cat", "dog", "pet", "fur", "paw", "tail"]
+    topic_b = ["stock", "bond", "market", "trade", "price", "share"]
+    texts, labels = [], []
+    for i in range(40):
+        words = topic_a if i % 2 == 0 else topic_b
+        texts.append(" ".join(rng.choice(words, 12)))
+        labels.append(f"doc_{i}")
+    pv = (
+        ParagraphVectors.Builder()
+        .min_word_frequency(1).layer_size(20).window_size(3)
+        .epochs(60).seed(11).batch_size(128).learning_rate(2.0)
+        .sequence_learning_algorithm("DBOW")
+        .iterate(LabelAwareIterator.from_texts(texts, labels))
+        .build()
+    )
+    pv.fit()
+    v_a = pv.infer_vector("cat pet fur dog paw", epochs=20,
+                          learning_rate=1.0)
+    assert v_a.shape == (20,)
+
+    def cos(u, w):
+        return float(
+            u @ w / (np.linalg.norm(u) * np.linalg.norm(w) + 1e-12)
+        )
+
+    sim_a = cos(v_a, pv.get_vector("doc_0"))   # topic A doc
+    sim_b = cos(v_a, pv.get_vector("doc_1"))   # topic B doc
+    assert sim_a > sim_b, (sim_a, sim_b)
+    # unknown-words doc returns the (finite) init vector
+    v_empty = pv.infer_vector("zzz qqq")
+    assert np.isfinite(v_empty).all()
