@@ -34,3 +34,9 @@ try:  # graph engine lands with the ComputationGraph milestone
     from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401
 except ImportError:  # pragma: no cover
     ComputationGraph = None  # type: ignore[assignment]
+
+from deeplearning4j_tpu.exceptions import (  # noqa: F401
+    DL4JException,
+    DL4JInvalidConfigException,
+    DL4JInvalidInputException,
+)

@@ -223,6 +223,11 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         self._ensure()
         seqs = []
         while self._pending is not None and len(seqs) < self.batch_size:
+            if len(self._pending) == 0:
+                raise ValueError(
+                    f"sequence {len(seqs)} of this batch is empty "
+                    "(zero-length or header-only input)"
+                )
             seqs.append(self._featurize(self._pending))
             self._pending = next(self._it, None)
         if not seqs:
@@ -332,9 +337,15 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
             name, a, b, onehot = spec
             data = np.asarray(rows[name], np.float32)[:, a:b + 1]
             if onehot is not None:
+                cls = data[:, 0].astype(int)
+                if ((cls < 0) | (cls >= onehot)).any():
+                    bad = cls[(cls < 0) | (cls >= onehot)][0]
+                    raise ValueError(
+                        f"label {bad} outside [0, {onehot}) in "
+                        f"reader '{name}' column {a}"
+                    )
                 out = np.zeros((data.shape[0], onehot), np.float32)
-                out[np.arange(data.shape[0]),
-                    data[:, 0].astype(int)] = 1.0
+                out[np.arange(data.shape[0]), cls] = 1.0
                 return out
             return data
 

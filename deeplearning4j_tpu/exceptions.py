@@ -1,0 +1,20 @@
+"""Framework exception hierarchy (reference
+``deeplearning4j-nn/.../exception``: ``DL4JException`` and
+subclasses). Raised by configuration validation (residual-width
+checks in TransformerBlock/MixtureOfExperts, duplicate layer names);
+both config/input subclasses also subclass ValueError so generic
+handlers keep working."""
+
+
+class DL4JException(Exception):
+    """Base framework exception (reference ``DL4JException``)."""
+
+
+class DL4JInvalidConfigException(DL4JException, ValueError):
+    """Invalid network configuration (reference
+    ``DL4JInvalidConfigException``)."""
+
+
+class DL4JInvalidInputException(DL4JException, ValueError):
+    """Input incompatible with the network (reference
+    ``DL4JInvalidInputException``)."""

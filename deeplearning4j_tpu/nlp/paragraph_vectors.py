@@ -161,10 +161,15 @@ class ParagraphVectors(SequenceVectors):
         ``ParagraphVectors.inferVector``): gradient-descend a fresh
         doc vector against the frozen word/output tables under the
         DBOW objective — one jitted step per epoch over all of the
-        doc's words at once."""
-        import jax
-        import jax.numpy as jnp
-
+        doc's words at once. Requires negative sampling (the training
+        default); HS-only models raise — the batched-XLA design
+        documents NS as the inference objective."""
+        if self.lookup.syn1neg is None:
+            raise ValueError(
+                "infer_vector needs a negative-sampling model "
+                "(negative > 0); this model was trained with "
+                "hierarchical softmax only"
+            )
         if isinstance(tokens, str):
             tokens = tokens.split()
         ids = np.asarray(
@@ -180,7 +185,7 @@ class ParagraphVectors(SequenceVectors):
             (rng.rand(self.layer_size) - 0.5) / self.layer_size,
             jnp.float32,
         )
-        if len(ids) == 0 or self.lookup.syn1neg is None:
+        if len(ids) == 0:
             return np.asarray(v)
         words = jnp.asarray(ids)
         for e in range(epochs):

@@ -122,6 +122,152 @@ class MultiHeadSelfAttention(LayerSpec):
 
 @register_layer
 @dataclass(frozen=True)
+class TransformerBlock(LayerSpec):
+    """Pre-norm transformer block: LN -> multi-head self-attention ->
+    residual, LN -> FFN (or Switch-MoE) -> residual. Net-new vs the
+    reference, composing the attention/norm/MoE layers into the
+    standard long-context building block. Sequence layout follows the
+    recurrent stack: [batch, features, time], mask [batch, time].
+
+    ``n_experts > 0`` swaps the dense FFN for a Switch
+    mixture-of-experts (top-1, capacity-dropped tokens ride the
+    residual)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 4
+    ffn_hidden: int = 0   # 0 -> 4 * n_in
+    causal: bool = True
+    n_experts: int = 0    # 0 -> dense FFN; >0 -> Switch MoE
+    capacity_factor: float = 1.25
+    activation: str = "identity"
+    seq_axis: str = ""
+    seq_axis_size: int = 0
+
+    def input_kind(self) -> str:
+        return "recurrent"
+
+    def with_input_type(self, it: InputType) -> "TransformerBlock":
+        changes = {}
+        if self.n_in == 0:
+            changes["n_in"] = it.size or it.flat_size()
+        width = changes.get("n_in", self.n_in)
+        if self.n_out == 0:
+            changes["n_out"] = width
+        if (changes.get("n_out", self.n_out)) != width:
+            from deeplearning4j_tpu.exceptions import (
+                DL4JInvalidConfigException,
+            )
+
+            raise DL4JInvalidConfigException(
+                "TransformerBlock is residual: n_out must equal n_in"
+            )
+        return dataclasses.replace(self, **changes) if changes else self
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def regularizable_params(self) -> tuple:
+        return ("Wq", "Wk", "Wv", "Wo", "w_ff1", "w_ff2", "w1", "w2")
+
+    def _attn(self) -> MultiHeadSelfAttention:
+        return MultiHeadSelfAttention(
+            n_in=self.n_in, n_out=self.n_in, n_heads=self.n_heads,
+            causal=self.causal, seq_axis=self.seq_axis,
+            seq_axis_size=self.seq_axis_size,
+            weight_init=self.weight_init, dist=self.dist,
+        )
+
+    def _ln(self) -> "LayerNormalization":
+        return LayerNormalization(n_out=self.n_in)
+
+    def _moe(self):
+        from deeplearning4j_tpu.nn.layers.moe import MixtureOfExperts
+
+        return MixtureOfExperts(
+            n_in=self.n_in, n_out=self.n_in,
+            n_experts=self.n_experts,
+            hidden_size=self.ffn_hidden or 4 * self.n_in,
+            capacity_factor=self.capacity_factor,
+            activation="identity",
+        )
+
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        k_attn, k_ff1, k_ff2, k_moe = jax.random.split(key, 4)
+        d = self.n_in
+        h = self.ffn_hidden or 4 * d
+        p = {}
+        p.update(self._attn().init_params(k_attn, dtype))
+        p["ln1_gamma"] = jnp.ones((d,), dtype)
+        p["ln1_beta"] = jnp.zeros((d,), dtype)
+        p["ln2_gamma"] = jnp.ones((d,), dtype)
+        p["ln2_beta"] = jnp.zeros((d,), dtype)
+        if self.n_experts > 0:
+            p.update(self._moe().init_params(k_moe, dtype))
+        else:
+            p["w_ff1"] = init_weights(
+                k_ff1, (d, h), self.weight_init, fan_in=d, fan_out=h,
+                distribution=self.dist, dtype=dtype,
+            )
+            p["b_ff1"] = jnp.zeros((h,), dtype)
+            p["w_ff2"] = init_weights(
+                k_ff2, (h, d), self.weight_init, fan_in=h, fan_out=d,
+                distribution=self.dist, dtype=dtype,
+            )
+            p["b_ff2"] = jnp.zeros((d,), dtype)
+        return p
+
+    def _layernorm(self, x, gamma, beta, eps=1e-5):
+        mean = jnp.mean(x, axis=1, keepdims=True)
+        var = jnp.var(x, axis=1, keepdims=True)
+        return (x - mean) / jnp.sqrt(var + eps) * gamma[:, None] \
+            + beta[:, None]
+
+    def apply(self, params, x, state, *, train=False, rng=None,
+              mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        # attention sublayer (pre-norm)
+        h1 = self._layernorm(x, params["ln1_gamma"], params["ln1_beta"])
+        attn_params = {
+            k: params[k] for k in ("Wq", "Wk", "Wv", "Wo", "bo")
+        }
+        a, _ = self._attn().apply(
+            attn_params, h1, {}, train=False, rng=None, mask=mask
+        )
+        x = x + a
+        # FFN / MoE sublayer (pre-norm)
+        h2 = self._layernorm(x, params["ln2_gamma"], params["ln2_beta"])
+        if self.n_experts > 0:
+            from deeplearning4j_tpu.parallel.expert import (
+                moe_ffn_reference,
+            )
+
+            moe_params = {
+                k: params[k] for k in ("router", "w1", "b1", "w2", "b2")
+            }
+            b, fdim, t = h2.shape
+            tokens = h2.transpose(0, 2, 1).reshape(b * t, fdim)
+            token_mask = (
+                mask.reshape(b * t) if mask is not None else None
+            )
+            upd = moe_ffn_reference(
+                moe_params, tokens, self.capacity_factor, token_mask
+            )
+            upd = upd.reshape(b, t, fdim).transpose(0, 2, 1)
+            x = x + upd
+        else:
+            ht = jnp.transpose(h2, (0, 2, 1))           # [b, t, f]
+            ff = jax.nn.gelu(ht @ params["w_ff1"] + params["b_ff1"])
+            ff = ff @ params["w_ff2"] + params["b_ff2"]
+            ff = jnp.transpose(ff, (0, 2, 1))           # [b, f, t]
+            if mask is not None:
+                ff = ff * mask[:, None, :]
+            x = x + ff
+        return self.activate_fn()(x), state
+
+
+@register_layer
+@dataclass(frozen=True)
 class LayerNormalization(LayerSpec):
     """Layer norm over the feature axis for [b, f] or [b, f, t]
     tensors (companion to attention; the reference's only norm is

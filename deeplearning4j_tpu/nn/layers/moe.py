@@ -40,7 +40,11 @@ class MixtureOfExperts(FeedForwardLayerSpec):
         if not layer.n_out:  # residual block: width preserved
             layer = dataclasses.replace(layer, n_out=layer.n_in)
         if layer.n_out and layer.n_in and layer.n_out != layer.n_in:
-            raise ValueError(
+            from deeplearning4j_tpu.exceptions import (
+                DL4JInvalidConfigException,
+            )
+
+            raise DL4JInvalidConfigException(
                 "MixtureOfExperts is residual: n_out must equal n_in "
                 f"(got {layer.n_in} -> {layer.n_out})"
             )

@@ -60,7 +60,13 @@ class MultiLayerNetwork:
             conf.layer_name(i) for i in range(len(conf.layers))
         ]
         if len(set(self.layer_names)) != len(self.layer_names):
-            raise ValueError("Duplicate layer names in configuration")
+            from deeplearning4j_tpu.exceptions import (
+                DL4JInvalidConfigException,
+            )
+
+            raise DL4JInvalidConfigException(
+                "Duplicate layer names in configuration"
+            )
         self.params: Optional[Dict[str, Dict[str, jax.Array]]] = None
         self.state: Dict[str, dict] = {}
         self.updater_def = MultiLayerUpdaterDef({

@@ -30,6 +30,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.parallel.sequence import _shard_map
 
 
+def _loss_cache_key(fn):
+    """Cache key for a loss callable: (code, closure values) when
+    hashable — same-body lambdas share a compile, different captured
+    constants do not; falls back to the object itself."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn
+    cells = getattr(fn, "__closure__", None) or ()
+    try:
+        key = (code, tuple(c.cell_contents for c in cells))
+        hash(key)
+        return key
+    except (ValueError, TypeError):
+        return fn
+
+
 def build_pipe_mesh(n_stages: int, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     if len(devices) < n_stages:
@@ -150,11 +166,11 @@ class GPipe:
                    lr: float = 0.01):
         """One SGD step of ``loss_fn(pipeline(x), y)`` — per-stage
         grads stay on their stage's device. Compiled once per distinct
-        loss function BODY (keyed by ``__code__`` so inline lambdas
-        re-created every call still hit the cache; a loss whose
-        closure captures changing values must be passed as a stable
-        callable instead)."""
-        key = getattr(loss_fn, "__code__", loss_fn)
+        loss BODY + captured closure values, so inline lambdas
+        re-created each call hit the cache, while a lambda closing
+        over a CHANGED value correctly recompiles (the closure is
+        baked into the program as constants)."""
+        key = _loss_cache_key(loss_fn)
         jit_step = self._jit_steps.get(key)
         if jit_step is None:
             apply = self._build_apply()
