@@ -273,8 +273,14 @@ class MultiLayerUpdaterDef:
                 p = params[ln][pn]
                 p_lr = lr * bias_scale if pn in s.bias_params else lr
                 step, st = apply_updater(s, g, state[ln][pn], p_lr, t)
-                np_[pn] = p - step
-                ns_[pn] = st
+                # keep param AND state dtypes: the f32 lr would promote
+                # bf16 params/momenta (and break the scan path's fixed
+                # carry dtype)
+                np_[pn] = (p - step).astype(p.dtype)
+                ns_[pn] = tuple(
+                    a.astype(o.dtype)
+                    for a, o in zip(st, state[ln][pn])
+                )
             new_params[ln] = np_
             new_state[ln] = ns_
         return new_params, new_state

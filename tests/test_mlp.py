@@ -226,3 +226,43 @@ def test_scan_fused_fit_matches_per_step_rnn(rng):
                 np.asarray(a.params[ln][pn]),
                 np.asarray(b.params[ln][pn]), rtol=1e-6, atol=1e-7,
             )
+
+
+@pytest.mark.parametrize("updater", [
+    "SGD", "NESTEROVS", "ADAM", "RMSPROP", "ADADELTA", "ADAGRAD",
+])
+def test_bfloat16_dtype_policy_trains(rng, updater):
+    """conf.data_type('bfloat16'): params/compute in bf16 end to end —
+    the TPU-first dtype policy. Every updater rule must keep param AND
+    state dtypes stable through both fit paths (an f32 lr must not
+    promote the scan carry). Loss improvement is asserted only for the
+    rules that are numerically usable in PURE bf16 — Adam/RMSProp's
+    normalized ~lr-sized steps round away at bf16's 8-bit mantissa
+    (which is why production mixed precision keeps their state and
+    master weights in f32)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).learning_rate(0.3)
+        .data_type("bfloat16").updater(updater)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    assert net.params["0"]["W"].dtype == jnp.bfloat16
+    x = rng.rand(32, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    ds = DataSet(features=x, labels=y)
+    s0 = float(net.score(ds))
+    net.fit([ds] * 4, epochs=10)       # scan-fused path
+    net.fit_minibatch(ds)              # per-step path
+    assert net.params["0"]["W"].dtype == jnp.bfloat16
+    for st in net.updater_state["0"]["W"]:
+        assert st.dtype == jnp.bfloat16
+    assert np.isfinite(float(net.score_value))
+    if updater not in ("ADAM", "RMSPROP"):
+        assert float(net.score(ds)) < s0
