@@ -266,3 +266,38 @@ def test_bfloat16_dtype_policy_trains(rng, updater):
     assert np.isfinite(float(net.score_value))
     if updater not in ("ADAM", "RMSPROP"):
         assert float(net.score(ds)) < s0
+
+
+def test_integer_features_cast_on_device(rng):
+    """uint8 inputs (one-hot/pixel data) transfer natively and the
+    step casts them on device — results must equal float32 inputs on
+    both fit paths."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=5, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    ids = rng.randint(0, 5, 24)
+    x_u8 = np.eye(5, dtype=np.uint8)[ids]
+    y_u8 = np.eye(3, dtype=np.uint8)[rng.randint(0, 3, 24)]
+    x_f32 = x_u8.astype(np.float32)
+    y_f32 = y_u8.astype(np.float32)
+
+    a = build()
+    a.fit([DataSet(features=x_u8, labels=y_u8)] * 5)   # scan path
+    a.fit_minibatch(DataSet(features=x_u8, labels=y_u8))  # per-step
+    b = build()
+    b.fit([DataSet(features=x_f32, labels=y_f32)] * 5)
+    b.fit_minibatch(DataSet(features=x_f32, labels=y_f32))
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(a.params[ln][pn]), np.asarray(b.params[ln][pn])
+            )
