@@ -270,6 +270,12 @@ class MultiLayerNetwork:
             x, labels, mask, fmask, lrs, t, rng = per_step
             x = x.astype(multi_dtype)
             labels = labels.astype(multi_dtype)
+            # keep the cast-on-device contract symmetric with the
+            # per-step path, which converts masks to the compute dtype
+            mask = None if mask is None else mask.astype(multi_dtype)
+            fmask = (
+                None if fmask is None else fmask.astype(multi_dtype)
+            )
 
             def loss_fn(p):
                 s, new_state = self._score_pure(

@@ -1,0 +1,92 @@
+"""Character-level language model with stacked GravesLSTMs and TBPTT
+(reference analog: dl4j-examples GravesLSTMCharModellingExample),
+plus sampling from the trained model via ``rnn_time_step``.
+
+Run: python examples/char_rnn.py [--text path/to/corpus.txt]
+Without a corpus it trains on a small built-in passage.
+"""
+
+import argparse
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+FALLBACK = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+) * 40
+
+
+def batches_from_text(text, seq_len=60, batch=32):
+    chars = sorted(set(text))
+    idx = {c: i for i, c in enumerate(chars)}
+    v = len(chars)
+    ids = np.asarray([idx[c] for c in text], np.int64)
+    n_seq = (len(ids) - 1) // seq_len
+    xs, ys = [], []
+    for s in range(n_seq):
+        a = ids[s * seq_len:(s + 1) * seq_len]
+        b = ids[s * seq_len + 1:(s + 1) * seq_len + 1]
+        xs.append(np.eye(v, dtype=np.uint8)[a].T)  # [v, t]
+        ys.append(np.eye(v, dtype=np.uint8)[b].T)
+    out = []
+    for s in range(0, len(xs) - batch + 1, batch):
+        out.append(DataSet(
+            features=np.stack(xs[s:s + batch]),
+            labels=np.stack(ys[s:s + batch]),
+        ))
+    return out, chars
+
+
+def sample(net, chars, seed_char, n=200, temperature=0.8, rng=None):
+    rng = rng or np.random.RandomState(0)
+    v = len(chars)
+    idx = {c: i for i, c in enumerate(chars)}
+    net.rnn_clear_previous_state()
+    cur = idx[seed_char]
+    out = [seed_char]
+    for _ in range(n):
+        x = np.zeros((1, v, 1), np.float32)
+        x[0, cur, 0] = 1.0
+        probs = np.asarray(net.rnn_time_step(x))[0, :, 0]
+        probs = np.exp(np.log(np.maximum(probs, 1e-9)) / temperature)
+        probs /= probs.sum()
+        cur = rng.choice(v, p=probs)
+        out.append(chars[cur])
+    return "".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--text", default=None)
+    ap.add_argument("--epochs", type=int, default=150)
+    args = ap.parse_args()
+    text = (
+        open(args.text, encoding="utf-8").read()
+        if args.text else FALLBACK
+    )
+    data, chars = batches_from_text(text)
+    v = len(chars)
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(12345).learning_rate(0.005).updater("ADAM")
+        .list()
+        .layer(GravesLSTM(n_in=v, n_out=200, activation="tanh"))
+        .layer(GravesLSTM(n_in=200, n_out=200, activation="tanh"))
+        .layer(RnnOutputLayer(n_out=v, loss="MCXENT"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(data, epochs=args.epochs)
+    print(f"final score: {float(net.score_value):.4f}")
+    print("--- sample ---")
+    print(sample(net, chars, seed_char=chars[0]))
+
+
+if __name__ == "__main__":
+    main()

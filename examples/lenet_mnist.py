@@ -1,0 +1,63 @@
+"""LeNet-5 on MNIST — the classic first example (reference analog:
+dl4j-examples LenetMnistExample).
+
+Run: python examples/lenet_mnist.py
+Uses real MNIST when the IDX files are present (DL4J_TPU_MNIST_DIR);
+otherwise pass --synthetic to train on the opt-in synthetic set.
+"""
+
+import argparse
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--synthetic", action="store_true")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--examples", type=int, default=10000)
+    args = ap.parse_args()
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(123).learning_rate(0.001).updater("ADAM")
+        .list()
+        .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                activation="relu"))
+        .layer(SubsamplingLayer(pooling_type="MAX"))
+        .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                activation="relu"))
+        .layer(SubsamplingLayer(pooling_type="MAX"))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=10, loss="MCXENT"))
+        .set_input_type(InputType.convolutional_flat(28, 28, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(ScoreIterationListener(10))
+
+    train = MnistDataSetIterator(
+        args.batch, train=True, num_examples=args.examples,
+        allow_synthetic=args.synthetic,
+    )
+    test = MnistDataSetIterator(
+        args.batch, train=False,
+        num_examples=min(args.examples, 10000),
+        allow_synthetic=args.synthetic,
+    )
+    net.fit(train, epochs=args.epochs)
+    print(net.evaluate(test).stats())
+
+
+if __name__ == "__main__":
+    main()

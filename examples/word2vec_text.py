@@ -1,0 +1,54 @@
+"""Word2Vec on a text corpus (reference analog: dl4j-examples
+Word2VecRawTextExample): build vectors, query similarity/nearest
+words, save in Google-binary-compatible format.
+
+Run: python examples/word2vec_text.py [--text corpus.txt]
+"""
+
+import argparse
+
+from deeplearning4j_tpu.nlp import Word2Vec, write_binary
+from deeplearning4j_tpu.nlp.tokenization import (
+    CollectionSentenceIterator,
+    LineSentenceIterator,
+)
+
+FALLBACK = [
+    "the cat sat on the mat",
+    "the dog chased the cat",
+    "dogs and cats are pets",
+    "the market rallied as stocks rose",
+    "bond prices fell as the market traded lower",
+    "investors trade stocks and bonds",
+] * 50
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--text", default=None)
+    ap.add_argument("--out", default="/tmp/vectors.bin")
+    args = ap.parse_args()
+    it = (
+        LineSentenceIterator(args.text) if args.text
+        else CollectionSentenceIterator(FALLBACK)
+    )
+    w2v = (
+        Word2Vec.Builder()
+        .min_word_frequency(2)
+        .layer_size(100)
+        .window_size(5)
+        .negative_sample(5)
+        .epochs(5)
+        .iterate(it)
+        .build()
+    )
+    w2v.fit()
+    for w in ("cat", "market"):
+        if w2v.has_word(w):
+            print(f"nearest({w}):", w2v.words_nearest(w, 5))
+    write_binary(w2v, args.out)
+    print("saved", args.out)
+
+
+if __name__ == "__main__":
+    main()
