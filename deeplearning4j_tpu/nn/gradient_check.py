@@ -16,11 +16,32 @@ precision (the helper enables x64 only for its own scope via the
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+@contextmanager
+def f64_mode():
+    """x64 enabled AND pinned to the CPU backend when the default
+    backend is a TPU: TPUs have no native float64, so f64 central
+    differences run on host — the same discipline as the reference,
+    whose double-precision gradient checks run on the native CPU
+    backend. GPUs keep their native f64."""
+    from deeplearning4j_tpu.ops.dispatch import cpu_device
+
+    ctx_dev = (
+        cpu_device() if jax.default_backend() == "tpu" else None
+    )
+    with jax.enable_x64(True):
+        if ctx_dev is not None:
+            with jax.default_device(ctx_dev):
+                yield
+        else:
+            yield
 
 
 def check_gradients(
@@ -44,7 +65,7 @@ def check_gradients(
     reference checks every element; for large nets subsampling keeps
     the O(2·P) forward passes tractable — pass None for full parity).
     """
-    with jax.enable_x64(True):
+    with f64_mode():
         return _check_gradients_x64(
             model, x, labels, mask,
             eps=eps, max_rel_error=max_rel_error,

@@ -1,0 +1,41 @@
+"""Kernel/backend dispatch helpers shared by the Pallas ops, the
+gradient checker, and host-side analytics: one place decides which
+platform the next computation actually targets and how to pin work to
+the host CPU backend."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def effective_platform() -> str:
+    """Platform the next computation targets: honors a
+    ``jax.default_device`` override (which may hold a Device or a
+    platform string like ``"cpu"``), else the default backend."""
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        return dev if isinstance(dev, str) else dev.platform
+    return jax.default_backend()
+
+
+def cpu_device() -> Optional["jax.Device"]:
+    """The host CPU device, or None when the CPU backend is
+    unavailable (e.g. JAX_PLATFORMS pinned elsewhere)."""
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        return None
+
+
+def use_pallas() -> bool:
+    """Env-gated Pallas dispatch (DL4J_TPU_PALLAS=1/0/auto): kernels
+    engage only when the targeted platform is TPU."""
+    env = os.environ.get("DL4J_TPU_PALLAS", "auto").lower()
+    if env in ("1", "true", "on"):
+        return True
+    if env in ("0", "false", "off"):
+        return False
+    return effective_platform() == "tpu"

@@ -145,12 +145,9 @@ _flash_diff.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _use_pallas() -> bool:
-    env = os.environ.get("DL4J_TPU_PALLAS", "auto").lower()
-    if env in ("1", "true", "on"):
-        return True
-    if env in ("0", "false", "off"):
-        return False
-    return jax.default_backend() == "tpu"
+    from deeplearning4j_tpu.ops.dispatch import use_pallas
+
+    return use_pallas()
 
 
 _fallback_warned = False

@@ -124,9 +124,6 @@ lstm_cell_diff.defvjp(_cell_fwd, _cell_bwd)
 
 
 def use_pallas_lstm() -> bool:
-    env = os.environ.get("DL4J_TPU_PALLAS", "auto").lower()
-    if env in ("1", "true", "on"):
-        return True
-    if env in ("0", "false", "off"):
-        return False
-    return jax.default_backend() == "tpu"
+    from deeplearning4j_tpu.ops.dispatch import use_pallas
+
+    return use_pallas()

@@ -687,13 +687,25 @@ class UIServer:
         if arr.shape[1] == 2:
             coords = arr
         else:
+            import jax
+
+            from deeplearning4j_tpu.ops.dispatch import cpu_device
             from deeplearning4j_tpu.plot.tsne import Tsne
 
             n = arr.shape[0]
             perplexity = max(2.0, min(30.0, (n - 1) / 3.0))
-            coords = Tsne(
-                max_iter=250, perplexity=perplexity, seed=12345
-            ).fit(arr)
+            tsne = Tsne(max_iter=250, perplexity=perplexity, seed=12345)
+            # host-side analytics: run on the CPU backend so the UI
+            # thread never competes with training for the accelerator
+            # (and the small-N gradient dynamics stay in full f32)
+            cpu = (
+                cpu_device() if jax.default_backend() != "cpu" else None
+            )
+            if cpu is not None:
+                with jax.default_device(cpu):
+                    coords = tsne.fit(arr)
+            else:
+                coords = tsne.fit(arr)
         self._tsne = {
             "coords": np.asarray(coords, np.float32).tolist(),
             "labels": list(labels) if labels is not None else None,

@@ -38,3 +38,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(12345)
+
+
+def require_devices(n: int) -> None:
+    """Skip a multi-device test when the active backend has fewer
+    devices (the TPU profile runs on one real chip; the CPU profile
+    provisions 8 virtual devices — reference analog: Spark local-mode
+    tests sizing executors to the machine)."""
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(
+            f"needs {n} devices, have {len(jax.devices())} on "
+            f"{jax.default_backend()}"
+        )

@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import conftest
+
 from deeplearning4j_tpu.parallel.expert import (
     ExpertParallelMoE,
     aux_load_balance_loss,
@@ -97,6 +99,7 @@ def test_expert_parallel_matches_per_shard_reference(rng):
 
 
 def test_expert_parallel_validations(rng):
+    conftest.require_devices(2)
     mesh = build_expert_mesh()
     with pytest.raises(ValueError, match="divisible"):
         ExpertParallelMoE(mesh, n_experts=3)

@@ -240,9 +240,9 @@ def test_unknown_input_reference():
 
 def test_graph_gradients(rng):
     """Numeric vs analytic gradients through merge + multi-output."""
-    import jax
+    from deeplearning4j_tpu.nn.gradient_check import f64_mode
 
-    with jax.enable_x64(True):
+    with f64_mode():
         _graph_gradients_body(rng)
 
 
@@ -356,7 +356,9 @@ def _check_graph_gradients(g, inputs, labels, rng, lmasks=None,
     import jax
     import jax.numpy as jnp
 
-    with jax.enable_x64(True):
+    from deeplearning4j_tpu.nn.gradient_check import f64_mode
+
+    with f64_mode():
         f64 = lambda t: jax.tree_util.tree_map(
             lambda a: jnp.asarray(a, jnp.float64), t
         )

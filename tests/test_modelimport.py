@@ -163,7 +163,12 @@ class TestWeightImport:
         W1, b1, W2, b2 = self._write_mlp_h5(path, rng)
         net = import_sequential_model(path)
         x = rng.rand(5, 4).astype(np.float32)
-        out = np.asarray(net.output(x))
+        # full-f32 matmuls so the comparison against the numpy forward
+        # holds on TPU too (whose default matmul precision is bf16)
+        import jax
+
+        with jax.default_matmul_precision("float32"):
+            out = np.asarray(net.output(x))
         # manual forward: relu → softmax
         h = np.maximum(x @ W1 + b1, 0.0)
         logits = h @ W2 + b2

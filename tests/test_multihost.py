@@ -9,6 +9,8 @@ Spark local-mode the same way: no real cluster)."""
 import numpy as np
 import pytest
 
+import conftest
+
 import deeplearning4j_tpu.parallel.mesh as mesh_mod
 from deeplearning4j_tpu.parallel.mesh import (
     build_mesh,
@@ -73,6 +75,7 @@ def test_init_distributed_process_id_zero_explicit(recorder, monkeypatch):
 
 
 def test_process_local_batch_single_host():
+    conftest.require_devices(8)
     mesh = build_mesh(data=8, model=1)
     # single-process: this process owns all 8 devices
     assert process_local_batch(64, mesh) == 64
@@ -81,6 +84,7 @@ def test_process_local_batch_single_host():
 def test_process_local_batch_multi_host(monkeypatch):
     """Simulate 2 hosts x 4 devices: each host loads half the global
     batch (the per-executor AsyncDataSetIterator analog)."""
+    conftest.require_devices(8)
     mesh = build_mesh(data=8, model=1)
 
     class _Dev:

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import conftest
+
 from deeplearning4j_tpu.datasets.api import DataSet, ListDataSetIterator
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
@@ -42,6 +44,7 @@ def blob_data(rng, n=64):
 
 
 def test_mesh_shapes():
+    conftest.require_devices(8)
     mesh = build_mesh()
     assert mesh.shape["data"] == 8 and mesh.shape["model"] == 1
     mesh2 = build_mesh(model=2)
@@ -78,6 +81,7 @@ def test_dp_trainer_adam_and_listeners(rng):
 
 
 def test_dp_batch_divisibility_error(rng):
+    conftest.require_devices(2)
     x, y = blob_data(rng, n=30)  # 30 % 8 != 0
     net = make_net()
     trainer = DistributedTrainer(net, mesh=build_mesh())
@@ -88,6 +92,7 @@ def test_dp_batch_divisibility_error(rng):
 def test_tensor_parallel_matches_replicated(rng):
     """Column-parallel dense weights over the model axis must give the
     same results as pure replication (XLA inserts the collectives)."""
+    conftest.require_devices(2)
     x, y = blob_data(rng, n=32)
     a = make_net(seed=9)
     ta = DistributedTrainer(a, mesh=build_mesh(model=1))

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import conftest
+
 from deeplearning4j_tpu.parallel.pipeline import GPipe, build_pipe_mesh
 
 D = 8
@@ -36,6 +38,7 @@ def _serial(params, x):
 
 @pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 4), (8, 2)])
 def test_gpipe_forward_matches_serial(rng, n_stages, n_micro):
+    conftest.require_devices(n_stages)
     mesh = build_pipe_mesh(n_stages)
     pipe = GPipe(mesh, _stage_fn, n_micro=n_micro)
     params = pipe.shard_params(_make_params(rng, n_stages))
@@ -49,6 +52,7 @@ def test_gpipe_forward_matches_serial(rng, n_stages, n_micro):
 
 def test_gpipe_gradients_match_serial(rng):
     n_stages, n_micro = 4, 4
+    conftest.require_devices(4)
     mesh = build_pipe_mesh(n_stages)
     pipe = GPipe(mesh, _stage_fn, n_micro=n_micro)
     raw = _make_params(rng, n_stages)
@@ -74,6 +78,7 @@ def test_gpipe_gradients_match_serial(rng):
 
 def test_gpipe_train_step_reduces_loss(rng):
     n_stages = 4
+    conftest.require_devices(4)
     mesh = build_pipe_mesh(n_stages)
     pipe = GPipe(mesh, _stage_fn, n_micro=4)
     params = pipe.shard_params(_make_params(rng, n_stages))
@@ -92,6 +97,7 @@ def test_gpipe_train_step_reduces_loss(rng):
 
 
 def test_gpipe_validates_batch_divisibility(rng):
+    conftest.require_devices(2)
     mesh = build_pipe_mesh(2)
     pipe = GPipe(mesh, _stage_fn, n_micro=3)
     params = pipe.shard_params(_make_params(rng, 2))

@@ -59,7 +59,9 @@ def test_vae_pretrain_gradient_check(rng, dist):
         reconstruction_distribution=dist,
         num_samples=1,
     )
-    with jax.enable_x64(True):
+    from deeplearning4j_tpu.nn.gradient_check import f64_mode
+
+    with f64_mode():
         params = vae.init_params(jax.random.PRNGKey(0), jnp.float64)
         x = jnp.asarray(_batch(rng, n=6, d=5, binary=True), jnp.float64)
         key = jax.random.PRNGKey(42)
@@ -183,7 +185,9 @@ def test_vae_in_supervised_net_runs(rng):
 def test_autoencoder_gradient_check(rng):
     ae = AutoEncoder(n_in=6, n_out=4, corruption_level=0.0, loss="MSE",
                      activation="sigmoid")
-    with jax.enable_x64(True):
+    from deeplearning4j_tpu.nn.gradient_check import f64_mode
+
+    with f64_mode():
         params = ae.init_params(jax.random.PRNGKey(0), jnp.float64)
         x = jnp.asarray(_batch(rng, n=5, d=6), jnp.float64)
         loss_fn = lambda p: ae.pretrain_loss(p, x, None)

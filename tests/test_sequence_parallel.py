@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import conftest
+
 from deeplearning4j_tpu.parallel import (
     attention,
     build_seq_mesh,
@@ -27,6 +29,7 @@ class TestRingAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_single_device(self, causal):
         q, k, v = _qkv()
+        conftest.require_devices(4)
         mesh = build_seq_mesh(data=1, seq=4)
         out_ring = ring_self_attention_sharded(
             mesh, q, k, v, causal=causal
@@ -43,6 +46,7 @@ class TestRingAttention:
             (np.arange(16)[None, :] < np.array([[11], [16]])),
             jnp.float32,
         ).reshape(2, 16)
+        conftest.require_devices(4)
         mesh = build_seq_mesh(data=1, seq=4)
         out_ring = ring_self_attention_sharded(
             mesh, q, k, v, causal=False, mask=mask
@@ -64,6 +68,7 @@ class TestRingAttention:
         shard_map = _shard_map()
 
         q, k, v = _qkv(b=1, h=1, t=8, d=4, seed=3)
+        conftest.require_devices(4)
         mesh = build_seq_mesh(data=1, seq=4)
         spec = P(None, None, "seq", None)
 
@@ -89,6 +94,7 @@ class TestRingAttention:
 
     def test_long_sequence_8way(self):
         q, k, v = _qkv(b=1, h=4, t=64, d=16, seed=9)
+        conftest.require_devices(8)
         mesh = build_seq_mesh(data=1, seq=8)
         out = ring_self_attention_sharded(mesh, q, k, v, causal=True)
         ref = attention(q, k, v, causal=True)
