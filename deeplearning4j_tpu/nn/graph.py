@@ -57,6 +57,8 @@ class ComputationGraph:
         self._jit_multi_step = None
         self._solver = None  # lazily built for LBFGS/CG/line-search
         self.scan_chunk = 16  # minibatches fused per dispatch
+        # multi-epoch fits keep the dataset HBM-resident up to this size
+        self.device_cache_bytes = 4 << 30
         self._jit_output = None
         self._base_key = jax.random.PRNGKey(conf.seed)
 
@@ -223,9 +225,18 @@ class ComputationGraph:
         small-step throughput)."""
         updater = self.updater_def
 
+        multi_dtype = self._dtype()
+
         def body(carry, per_step):
             params, upd_state, state = carry
             inputs, labels, lmasks, fmasks, lrs, t, rng = per_step
+            cast = lambda v: (  # noqa: E731 — cast-on-device contract
+                None if v is None
+                else [None if a is None else a.astype(multi_dtype)
+                      for a in v]
+            )
+            inputs, labels = cast(inputs), cast(labels)
+            lmasks, fmasks = cast(lmasks), cast(fmasks)
 
             def loss_fn(p):
                 s, new_state = self._score_pure(
@@ -311,12 +322,12 @@ class ComputationGraph:
             self._flush_scan_chunk(buf)
         return n
 
-    def _flush_scan_chunk(self, batches: list) -> None:
-        if len(batches) == 1:
-            self.fit_minibatch(batches[0])
-            return
+    def _stack_chunk(self, batches: list):
+        """Stack k same-shaped minibatches into device-resident arrays
+        (integer inputs keep native width; cast on device)."""
+        from deeplearning4j_tpu.nn.multilayer import _to_device
+
         dtype = self._dtype()
-        k = len(batches)
         rows = [self._ds_arrays(b) for b in batches]
 
         def stack_lists(idx):
@@ -324,16 +335,25 @@ class ComputationGraph:
             if first is None:
                 return None
             return [
-                None if first[j] is None else jnp.asarray(
+                None if first[j] is None else _to_device(
                     np.stack([np.asarray(r[idx][j]) for r in rows]), dtype
                 )
                 for j in range(len(first))
             ]
 
-        xs = stack_lists(0)
-        ys = stack_lists(1)
-        fmasks = stack_lists(2)
-        lmasks = stack_lists(3)
+        return (
+            stack_lists(0), stack_lists(1), stack_lists(2),
+            stack_lists(3), len(batches),
+        )
+
+    def _flush_scan_chunk(self, batches: list) -> None:
+        if len(batches) == 1:
+            self.fit_minibatch(batches[0])
+            return
+        self._run_scan_chunk(self._stack_chunk(batches))
+
+    def _run_scan_chunk(self, stacked) -> None:
+        xs, ys, fmasks, lmasks, k = stacked
         it0 = self.iteration_count
         lr_rows = [
             self.updater_def.scheduled_lrs(it0 + i) for i in range(k)
@@ -378,9 +398,53 @@ class ComputationGraph:
             return
         self._fit_batches(data, epochs)
 
+    def _fit_epochs_device_cached(self, iterator, epochs: int) -> bool:
+        """Multi-epoch fit with HBM-resident batches (same design and
+        conditions as ``MultiLayerNetwork._fit_epochs_device_cached``:
+        transfer each fused chunk once, re-run the scanned step every
+        epoch)."""
+        from deeplearning4j_tpu.nn.multilayer import (
+            _build_scan_plan,
+            _nbytes,
+        )
+
+        if (
+            epochs <= 1
+            or not isinstance(iterator, (list, tuple))
+            or len(iterator) == 0
+            or not self._can_scan_steps()
+            or self.scan_chunk <= 1
+        ):
+            return False
+        total = 0
+        for ds in iterator:
+            if not hasattr(ds, "features"):
+                return False
+            features, labels, fmasks, lmasks = self._ds_arrays(ds)
+            for group in (features, labels, fmasks, lmasks):
+                for a in group or []:
+                    if a is not None:
+                        total += _nbytes(a)
+        if total > self.device_cache_bytes:
+            return False
+        plan = _build_scan_plan(
+            iterator, self._ds_scan_sig, self._stack_chunk,
+            self.scan_chunk,
+        )
+        for epoch in range(epochs):
+            for kind, item, _last in plan:
+                if kind == "chunk":
+                    self._run_scan_chunk(item)
+                else:
+                    self.fit_minibatch(item)
+            self.epoch_count += 1
+        return True
+
     def _fit_batches(self, iterator, epochs: int) -> None:
         if self.params is None:
             self.init()
+        if self._fit_epochs_device_cached(iterator, epochs):
+            return
         for epoch in range(epochs):
             if self._can_scan_steps() and self.scan_chunk > 1:
                 n = self._fit_epoch_scan(iter(iterator))

@@ -54,6 +54,38 @@ def _to_device(a, dtype):
     return jnp.asarray(a, dtype)
 
 
+def _nbytes(a) -> int:
+    nb = getattr(a, "nbytes", None)
+    return int(nb) if nb is not None else int(np.asarray(a).nbytes)
+
+
+def _build_scan_plan(seq, sig_fn, stack_fn, scan_chunk: int):
+    """Group consecutive same-signature minibatches into fused chunks
+    (the same boundaries ``_fit_epoch_scan`` produces). Returns a list
+    of ``("chunk", stacked_device_arrays, last_host_batch)`` /
+    ``("single", ds, ds)`` entries, shared by MultiLayerNetwork and
+    ComputationGraph."""
+    plan: List[Any] = []
+    buf: List[Any] = []
+    sig = None
+
+    def flush(batches):
+        if len(batches) == 1:
+            plan.append(("single", batches[0], batches[0]))
+        elif batches:
+            plan.append(("chunk", stack_fn(batches), batches[-1]))
+
+    for ds in seq:
+        s = sig_fn(ds)
+        if buf and (s != sig or len(buf) >= scan_chunk):
+            flush(buf)
+            buf = []
+        sig = s
+        buf.append(ds)
+    flush(buf)
+    return plan
+
+
 def _reg_penalty(layer, layer_params):
     """L1/L2 penalty for one layer (reference calcL1/calcL2)."""
     reg = 0.0
@@ -98,6 +130,9 @@ class MultiLayerNetwork:
         self._jit_multi_step = None
         self._solver = None  # lazily built for LBFGS/CG/line-search
         self.scan_chunk = 16  # minibatches fused per dispatch
+        # multi-epoch fits keep the dataset HBM-resident up to this
+        # size (v5e has 16 GiB HBM; leave room for params/activations)
+        self.device_cache_bytes = 4 << 30
         self._jit_output = None
         self._jit_rnn_step = None
         self._jit_pretrain_steps: Dict[int, Callable] = {}
@@ -364,27 +399,48 @@ class MultiLayerNetwork:
             self._flush_scan_chunk(buf)
         return n
 
-    def _flush_scan_chunk(self, batches: List[Any]) -> None:
-        if len(batches) == 1:
-            self.fit_minibatch(batches[0])
-            return
+    def _stack_chunk(self, batches: List[Any]):
+        """Stack k same-shaped minibatches into device-resident arrays
+        for one fused multi-step dispatch. Integer inputs keep their
+        native width (cast on device); already-device arrays stack on
+        device without a host round trip."""
         dtype = _dtype_of(self.conf)
-        k = len(batches)
 
         def stack(get):
             first = get(batches[0])
             if first is None:
                 return None
+            if all(isinstance(get(b), jax.Array) for b in batches):
+                stacked = jnp.stack([get(b) for b in batches])
+                return (
+                    stacked
+                    if stacked.dtype.kind in ("u", "i")
+                    and stacked.dtype.itemsize <= 2
+                    else stacked.astype(dtype)
+                )
             return _to_device(
                 np.stack([np.asarray(get(b)) for b in batches]), dtype
             )
 
-        xs = stack(lambda b: b.features)
-        ys = stack(lambda b: b.labels)
-        masks = stack(lambda b: getattr(b, "labels_mask", None))
-        fmasks = stack(lambda b: getattr(b, "features_mask", None))
+        return (
+            stack(lambda b: b.features),
+            stack(lambda b: b.labels),
+            stack(lambda b: getattr(b, "labels_mask", None)),
+            stack(lambda b: getattr(b, "features_mask", None)),
+            len(batches),
+        )
+
+    def _flush_scan_chunk(self, batches: List[Any]) -> None:
+        if len(batches) == 1:
+            self.fit_minibatch(batches[0])
+            return
         if self._wants_last_features():
             self._last_features = batches[-1].features
+        self._run_scan_chunk(self._stack_chunk(batches))
+
+    def _run_scan_chunk(self, stacked) -> None:
+        """One fused k-step dispatch from pre-stacked device arrays."""
+        xs, ys, masks, fmasks, k = stacked
         it0 = self.iteration_count
         lr_rows = [
             self.updater_def.scheduled_lrs(it0 + i) for i in range(k)
@@ -445,6 +501,8 @@ class MultiLayerNetwork:
             self.pretrain(iterator)
         if not self.conf.backprop:
             return
+        if self._fit_epochs_device_cached(iterator, epochs):
+            return
         for epoch in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):
@@ -469,6 +527,65 @@ class MultiLayerNetwork:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(self)
             self.epoch_count += 1
+
+    def _fit_epochs_device_cached(self, iterator, epochs: int) -> bool:
+        """Multi-epoch fit over a materialized dataset with the batches
+        kept HBM-resident across epochs.
+
+        The reference re-reads host data every epoch and re-copies it
+        over PCIe (`MultipleEpochsIterator` + the per-op JNI hop,
+        SURVEY.md §3.1); on TPU the host->device link is the scarce
+        resource, so when the data is a fixed sequence that fits in
+        device memory we transfer each fused chunk ONCE and re-run the
+        scanned train step over the cached arrays every epoch. lr
+        schedules/iteration counts are recomputed per chunk per epoch,
+        so training semantics are identical to the streaming path.
+        Returns False (caller streams as before) for single epochs,
+        iterator input, TBPTT/solver paths, or datasets larger than
+        ``self.device_cache_bytes``.
+        """
+        if (
+            epochs <= 1
+            or not isinstance(iterator, (list, tuple))
+            or len(iterator) == 0
+            or not self._can_scan_steps()
+            or self.scan_chunk <= 1
+        ):
+            return False
+        total = 0
+        for ds in iterator:
+            if not hasattr(ds, "features"):
+                return False
+            for a in (
+                ds.features, ds.labels,
+                getattr(ds, "labels_mask", None),
+                getattr(ds, "features_mask", None),
+            ):
+                if a is not None:
+                    total += _nbytes(a)
+        if total > self.device_cache_bytes:
+            return False
+        plan = _build_scan_plan(
+            iterator, self._ds_scan_sig, self._stack_chunk,
+            self.scan_chunk,
+        )
+        for epoch in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            self._reset_recurrent_state()
+            for kind, item, last in plan:
+                if kind == "chunk":
+                    if self._wants_last_features():
+                        self._last_features = last.features
+                    self._run_scan_chunk(item)
+                else:
+                    self.fit_minibatch(item)
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+            self.epoch_count += 1
+        return True
 
     def fit_minibatch(self, ds) -> float:
         """One minibatch through ``conf.iterations`` optimizer steps

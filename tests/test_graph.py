@@ -348,6 +348,45 @@ def test_graph_scan_fused_fit_matches_per_step(rng):
             )
 
 
+def test_graph_device_cached_epochs_match_streaming(rng):
+    """CG multi-epoch fit over a list (HBM-resident batches) must match
+    one-epoch-at-a-time streaming bitwise."""
+    def build():
+        conf = (
+            NeuralNetConfiguration.Builder().seed(9).learning_rate(0.05)
+            .updater("RMSPROP")
+            .graph_builder()
+            .add_inputs("a")
+            .add_layer("d", DenseLayer(n_in=3, n_out=5,
+                                       activation="tanh"), "a")
+            .add_layer("out", OutputLayer(n_in=5, n_out=2), "d")
+            .set_outputs("out")
+            .build()
+        )
+        return ComputationGraph(conf).init()
+
+    batches = [
+        MultiDataSet(
+            features=[rng.rand(6, 3).astype(np.float32)],
+            labels=[np.eye(2, dtype=np.float32)[rng.randint(0, 2, 6)]],
+        )
+        for _ in range(4)
+    ]
+    a = build()
+    a.scan_chunk = 3
+    for _ in range(3):
+        a.fit(batches, epochs=1)
+    b = build()
+    b.scan_chunk = 3
+    b.fit(batches, epochs=3)
+    assert a.iteration_count == b.iteration_count == 12
+    for vn in a.params:
+        for pn in a.params[vn]:
+            np.testing.assert_array_equal(
+                np.asarray(a.params[vn][pn]), np.asarray(b.params[vn][pn])
+            )
+
+
 def _check_graph_gradients(g, inputs, labels, rng, lmasks=None,
                            n_per_param=4, eps=1e-6, tol=1e-3):
     """Central differences vs jax.grad for a ComputationGraph in f64

@@ -190,6 +190,74 @@ def test_scan_fused_fit_matches_per_step(rng):
             )
 
 
+def test_device_cached_epochs_match_streaming(rng):
+    """Multi-epoch fit over a list keeps batches HBM-resident and
+    re-runs the scanned step per epoch; the trajectory must be bitwise
+    identical to fitting one epoch at a time (streaming transfers)."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.Builder().seed(7).learning_rate(0.05)
+            .updater("ADAM")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="relu",
+                              dropout=0.2))
+            .layer(OutputLayer(n_out=3))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    batches = [
+        DataSet(
+            features=rng.rand(10, 6).astype(np.float32),
+            labels=np.eye(3, dtype=np.float32)[rng.randint(0, 3, 10)],
+        )
+        for _ in range(5)
+    ]
+    a = build()
+    a.scan_chunk = 4
+    for _ in range(3):
+        a.fit(batches, epochs=1)  # cached path requires epochs > 1
+    b = build()
+    b.scan_chunk = 4
+    b.fit(batches, epochs=3)
+    assert a.iteration_count == b.iteration_count == 15
+    assert b.epoch_count == 3
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(a.params[ln][pn]), np.asarray(b.params[ln][pn])
+            )
+
+
+def test_device_cached_epochs_respect_cache_limit(rng):
+    """Datasets larger than device_cache_bytes stream per epoch (no
+    caching) and still train correctly."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(3).learning_rate(0.05)
+        .updater("SGD")
+        .list()
+        .layer(DenseLayer(n_in=6, n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.device_cache_bytes = 1  # force the streaming fallback
+    batches = [
+        DataSet(
+            features=rng.rand(10, 6).astype(np.float32),
+            labels=np.eye(3, dtype=np.float32)[rng.randint(0, 3, 10)],
+        )
+        for _ in range(4)
+    ]
+    net.fit(batches, epochs=2)
+    assert net.iteration_count == 8
+    assert np.isfinite(float(net.score_value))
+
+
 def test_scan_fused_fit_matches_per_step_rnn(rng):
     """RNN under standard backprop: recurrent carry resets each
     minibatch, so the scan path must match the per-step path exactly."""
