@@ -265,32 +265,49 @@ class SequenceVectors:
     def _gen_pairs(self, epoch_seed: int):
         """(centers, contexts) int32 arrays for one epoch: reduced
         window sampling + frequent-word subsampling (reference
-        SkipGram.learnSequence)."""
+        SkipGram.learnSequence).
+
+        Vectorized over the WHOLE corpus, not per sentence: all
+        sequences are concatenated with a sentence-id array, so pair
+        generation is ~2*window numpy slices total instead of per
+        sentence — the host-side analog of batching for the MXU (the
+        per-sentence loop dominated fit() wall-clock before)."""
         rng = np.random.RandomState(epoch_seed)
+        total = self.cache.total_word_count
+        seqs = [np.asarray(ids, np.int32) for ids in self._sequences()]
+        seqs = [s for s in seqs if len(s) > 0]
+        if not seqs:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        all_ids = np.concatenate(seqs)
+        lens = np.array([len(s) for s in seqs], np.int32)
+        sent = np.repeat(np.arange(len(lens), dtype=np.int32), lens)
+        if self.sample > 0:
+            keep = subsample_mask(
+                all_ids, self._counts, total, self.sample, rng
+            )
+            all_ids = all_ids[keep]
+            sent = sent[keep]
+            lens = np.bincount(sent, minlength=len(lens)).astype(np.int32)
+        starts = np.repeat(np.cumsum(lens, dtype=np.int64).astype(np.int32)
+                           - lens, lens)
+        pos = np.arange(len(all_ids), dtype=np.int32) - starts
+        slen = np.repeat(lens, lens)            # own sentence's length
+        n = len(all_ids)
+        if n < 2:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        # reduced window: each center draws b ~ U{1..window}; pairs are
+        # (center, center±off) for off <= b, clipped to the sentence
+        b = rng.randint(1, self.window + 1, n)
         centers: List[np.ndarray] = []
         contexts: List[np.ndarray] = []
-        total = self.cache.total_word_count
-        for ids in self._sequences():
-            ids = np.asarray(ids, np.int64)
-            if self.sample > 0:
-                keep = subsample_mask(
-                    ids, self._counts, total, self.sample, rng
-                )
-                ids = ids[keep]
-            n = len(ids)
-            if n < 2:
-                continue
-            # vectorized reduced-window pair generation
-            b = rng.randint(1, self.window + 1, n)
-            for off in range(1, self.window + 1):
-                sel = b >= off
-                idx = np.nonzero(sel)[0]
-                left = idx[idx >= off]
-                centers.append(ids[left]); contexts.append(ids[left - off])
-                right = idx[idx < n - off]
-                centers.append(ids[right]); contexts.append(ids[right + off])
-        if not centers:
-            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        for off in range(1, self.window + 1):
+            idx = np.nonzero(b >= off)[0]
+            left = idx[pos[idx] >= off]
+            centers.append(all_ids[left])
+            contexts.append(all_ids[left - off])
+            right = idx[pos[idx] < slen[idx] - off]
+            centers.append(all_ids[right])
+            contexts.append(all_ids[right + off])
         c = np.concatenate(centers).astype(np.int32)
         o = np.concatenate(contexts).astype(np.int32)
         perm = rng.permutation(len(c))

@@ -403,34 +403,15 @@ class ComputationGraph:
         conditions as ``MultiLayerNetwork._fit_epochs_device_cached``:
         transfer each fused chunk once, re-run the scanned step every
         epoch)."""
-        from deeplearning4j_tpu.nn.multilayer import (
-            _build_scan_plan,
-            _nbytes,
-        )
+        from deeplearning4j_tpu.nn.multilayer import _cached_epoch_plan
 
-        if (
-            epochs <= 1
-            or not isinstance(iterator, (list, tuple))
-            or len(iterator) == 0
-            or not self._can_scan_steps()
-            or self.scan_chunk <= 1
-        ):
+        def arrays_of(ds):
+            for group in self._ds_arrays(ds):
+                yield from group or []
+
+        plan = _cached_epoch_plan(self, iterator, epochs, arrays_of)
+        if plan is None:
             return False
-        total = 0
-        for ds in iterator:
-            if not hasattr(ds, "features"):
-                return False
-            features, labels, fmasks, lmasks = self._ds_arrays(ds)
-            for group in (features, labels, fmasks, lmasks):
-                for a in group or []:
-                    if a is not None:
-                        total += _nbytes(a)
-        if total > self.device_cache_bytes:
-            return False
-        plan = _build_scan_plan(
-            iterator, self._ds_scan_sig, self._stack_chunk,
-            self.scan_chunk,
-        )
         for epoch in range(epochs):
             for kind, item, _last in plan:
                 if kind == "chunk":

@@ -59,6 +59,36 @@ def _nbytes(a) -> int:
     return int(nb) if nb is not None else int(np.asarray(a).nbytes)
 
 
+def _cached_epoch_plan(model, iterator, epochs: int, arrays_of):
+    """Shared eligibility gate + HBM size accounting + plan building
+    for the device-cached multi-epoch fit path (MultiLayerNetwork and
+    ComputationGraph). ``arrays_of(ds)`` yields every array the stacked
+    chunks will hold. Returns the scan plan, or None when the caller
+    must stream (single epoch, iterator input, non-scannable config, or
+    dataset larger than ``model.device_cache_bytes``)."""
+    if (
+        epochs <= 1
+        or not isinstance(iterator, (list, tuple))
+        or len(iterator) == 0
+        or not model._can_scan_steps()
+        or model.scan_chunk <= 1
+    ):
+        return None
+    total = 0
+    for ds in iterator:
+        if not hasattr(ds, "features"):
+            return None
+        for a in arrays_of(ds):
+            if a is not None:
+                total += _nbytes(a)
+    if total > model.device_cache_bytes:
+        return None
+    return _build_scan_plan(
+        iterator, model._ds_scan_sig, model._stack_chunk,
+        model.scan_chunk,
+    )
+
+
 def _build_scan_plan(seq, sig_fn, stack_fn, scan_chunk: int):
     """Group consecutive same-signature minibatches into fused chunks
     (the same boundaries ``_fit_epoch_scan`` produces). Returns a list
@@ -544,31 +574,16 @@ class MultiLayerNetwork:
         iterator input, TBPTT/solver paths, or datasets larger than
         ``self.device_cache_bytes``.
         """
-        if (
-            epochs <= 1
-            or not isinstance(iterator, (list, tuple))
-            or len(iterator) == 0
-            or not self._can_scan_steps()
-            or self.scan_chunk <= 1
-        ):
-            return False
-        total = 0
-        for ds in iterator:
-            if not hasattr(ds, "features"):
-                return False
-            for a in (
+        plan = _cached_epoch_plan(
+            self, iterator, epochs,
+            lambda ds: (
                 ds.features, ds.labels,
                 getattr(ds, "labels_mask", None),
                 getattr(ds, "features_mask", None),
-            ):
-                if a is not None:
-                    total += _nbytes(a)
-        if total > self.device_cache_bytes:
-            return False
-        plan = _build_scan_plan(
-            iterator, self._ds_scan_sig, self._stack_chunk,
-            self.scan_chunk,
+            ),
         )
+        if plan is None:
+            return False
         for epoch in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):
