@@ -105,49 +105,13 @@ def bench_lenet(batch=256, chunk=30, epochs=8) -> float:
 
 
 def _vgg16_conf():
-    """VGG-16 (conv 2-2-3-3-3 + 3 dense) as a ComputationGraph over
-    CIFAR-10 NCHW 3x32x32 (BASELINE.md config #2)."""
-    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
-    from deeplearning4j_tpu.nn.layers import (
-        ConvolutionLayer,
-        DenseLayer,
-        OutputLayer,
-        SubsamplingLayer,
-    )
+    """VGG-16 ComputationGraph over CIFAR-10 (BASELINE.md config #2).
+    Pure bf16 — the MXU-native precision; plain-momentum SGD is
+    numerically usable in bf16 (unlike Adam's tiny normalized steps).
+    The reference comparator is fp32 cuDNN."""
+    from deeplearning4j_tpu.zoo import vgg16
 
-    b = (
-        NeuralNetConfiguration.Builder().seed(42).learning_rate(0.01)
-        .updater("NESTEROVS")
-        # bf16 is the MXU-native precision; plain-momentum SGD is
-        # numerically usable in pure bf16 (unlike Adam's tiny
-        # normalized steps), so the TPU-first VGG config computes and
-        # stores in bf16 — the reference comparator is fp32 cuDNN
-        .data_type("bfloat16")
-        .graph_builder()
-        .add_inputs("in")
-    )
-    prev = "in"
-    idx = 0
-    for block, (n_layers, width) in enumerate(
-        [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
-    ):
-        for _ in range(n_layers):
-            name = f"conv{idx}"
-            b.add_layer(name, ConvolutionLayer(
-                n_out=width, kernel_size=(3, 3), padding=(1, 1),
-                activation="relu",
-            ), prev)
-            prev = name
-            idx += 1
-        pname = f"pool{block}"
-        b.add_layer(pname, SubsamplingLayer(pooling_type="MAX"), prev)
-        prev = pname
-    b.add_layer("fc0", DenseLayer(n_out=512, activation="relu"), prev)
-    b.add_layer("fc1", DenseLayer(n_out=512, activation="relu"), "fc0")
-    b.add_layer("out", OutputLayer(n_out=10, loss="MCXENT"), "fc1")
-    b.set_outputs("out")
-    b.set_input_types(InputType.convolutional(32, 32, 3))
-    return b.build()
+    return vgg16(dtype="bfloat16")
 
 
 def bench_vgg16(batch=64, chunk=4, epochs=6) -> float:
@@ -188,20 +152,12 @@ def bench_vgg16(batch=64, chunk=4, epochs=6) -> float:
 def bench_lstm_char_rnn(batch=32, seq=50, vocab=77, hidden=200,
                         chunk=10, epochs=8) -> float:
     from deeplearning4j_tpu.datasets.api import DataSet
-    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
-    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.zoo import graves_lstm_char_rnn
 
-    conf = (
-        NeuralNetConfiguration.Builder().seed(42).learning_rate(0.1)
-        .updater("RMSPROP")
-        .list()
-        .layer(GravesLSTM(n_in=vocab, n_out=hidden, activation="tanh"))
-        .layer(GravesLSTM(n_in=hidden, n_out=hidden, activation="tanh"))
-        .layer(RnnOutputLayer(n_out=vocab, loss="MCXENT"))
-        .build()
-    )
-    net = MultiLayerNetwork(conf).init()
+    net = MultiLayerNetwork(
+        graves_lstm_char_rnn(vocab=vocab, hidden=hidden)
+    ).init()
     net.scan_chunk = chunk
     rng = np.random.RandomState(0)
     batches = []

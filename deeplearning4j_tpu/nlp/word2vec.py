@@ -319,40 +319,47 @@ class SequenceVectors:
         window feed one averaged prediction)."""
         rng = np.random.RandomState(epoch_seed)
         W = self.window
-        t_list, c_list, m_list = [], [], []
         total = self.cache.total_word_count
         offsets = [o for o in range(-W, W + 1) if o != 0]
-        for ids in self._sequences():
-            ids = np.asarray(ids, np.int64)
-            if self.sample > 0:
-                keep = subsample_mask(
-                    ids, self._counts, total, self.sample, rng
-                )
-                ids = ids[keep]
-            n = len(ids)
-            if n < 2:
-                continue
-            b = rng.randint(1, W + 1, n)
-            padded = np.pad(ids, (W, W))
-            pos = np.arange(n)
-            cols, masks = [], []
-            for off in offsets:
-                cols.append(padded[W + off:W + off + n])
-                masks.append(
-                    (pos + off >= 0) & (pos + off < n) & (np.abs(off) <= b)
-                )
-            ctx = np.stack(cols, 1).astype(np.int32)
-            cm = np.stack(masks, 1)
-            keep_rows = cm.any(axis=1)
-            t_list.append(ids[keep_rows].astype(np.int32))
-            c_list.append(ctx[keep_rows])
-            m_list.append(cm[keep_rows].astype(np.float32))
-        if not t_list:
+        # corpus-wide vectorization, same technique as _gen_pairs
+        seqs = [np.asarray(ids, np.int32) for ids in self._sequences()]
+        seqs = [s for s in seqs if len(s) > 0]
+        if not seqs:
             z = np.zeros((0, 2 * W), np.int32)
             return np.zeros(0, np.int32), z, z.astype(np.float32)
-        t = np.concatenate(t_list)
-        c = np.concatenate(c_list)
-        m = np.concatenate(m_list)
+        all_ids = np.concatenate(seqs)
+        lens = np.array([len(s) for s in seqs], np.int32)
+        sent = np.repeat(np.arange(len(lens), dtype=np.int32), lens)
+        if self.sample > 0:
+            keep = subsample_mask(
+                all_ids, self._counts, total, self.sample, rng
+            )
+            all_ids = all_ids[keep]
+            sent = sent[keep]
+            lens = np.bincount(sent, minlength=len(lens)).astype(np.int32)
+        n = len(all_ids)
+        if n < 2:
+            z = np.zeros((0, 2 * W), np.int32)
+            return np.zeros(0, np.int32), z, z.astype(np.float32)
+        starts = np.repeat(np.cumsum(lens, dtype=np.int64).astype(np.int32)
+                           - lens, lens)
+        pos = np.arange(n, dtype=np.int32) - starts
+        slen = np.repeat(lens, lens)
+        b = rng.randint(1, W + 1, n)
+        padded = np.pad(all_ids, (W, W))
+        cols, masks = [], []
+        for off in offsets:
+            cols.append(padded[W + off:W + off + n])
+            masks.append(
+                (pos + off >= 0) & (pos + off < slen)
+                & (np.abs(off) <= b)
+            )
+        ctx = np.stack(cols, 1).astype(np.int32)
+        cm = np.stack(masks, 1)
+        keep_rows = cm.any(axis=1)
+        t = all_ids[keep_rows].astype(np.int32)
+        c = ctx[keep_rows]
+        m = cm[keep_rows].astype(np.float32)
         perm = rng.permutation(len(t))
         return t[perm], c[perm], m[perm]
 

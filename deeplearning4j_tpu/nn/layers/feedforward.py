@@ -99,7 +99,12 @@ class LossLayer(LayerSpec):
 @register_layer
 @dataclass(frozen=True)
 class ActivationLayer(LayerSpec):
-    """Pure activation (reference ``nn/conf/layers/ActivationLayer``)."""
+    """Pure activation (reference ``nn/conf/layers/ActivationLayer``).
+    Shape-agnostic: consumes any input family unchanged (e.g. the ReLU
+    after a residual ElementWiseVertex add in conv stacks)."""
+
+    def input_kind(self) -> str:
+        return "any"
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         return self.activate_fn()(x), state
@@ -113,6 +118,9 @@ class DropoutLayer(LayerSpec):
     SURVEY.md §2.1); provided for config convenience and Keras import."""
 
     activation: str = "identity"
+
+    def input_kind(self) -> str:
+        return "any"
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         return self.maybe_dropout(x, train=train, rng=rng), state

@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.zoo.models import (  # noqa: F401
+    alexnet,
+    graves_lstm_char_rnn,
+    lenet,
+    resnet50,
+    vgg16,
+)

@@ -1,0 +1,244 @@
+"""Model zoo: builder functions for the benchmark/model families the
+framework targets (BASELINE.md configs; the reference ships these as
+hand-built examples — e.g. LeNet in `deeplearning4j-core` examples and
+the Spark ResNet-style CNNs — rather than a zoo module, so these
+builders are the capability equivalent).
+
+Every function returns a built configuration (MultiLayerConfiguration
+or ComputationGraphConfiguration); callers wrap it in
+``MultiLayerNetwork``/``ComputationGraph`` and ``.init()`` it. All
+configs are TPU-shaped: static shapes, conv stacks that XLA tiles onto
+the MXU, optional pure-bf16 compute via ``data_type``.
+"""
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+
+
+def lenet(height=28, width=28, channels=1, n_classes=10, *,
+          dense_width=512, updater="ADAM", learning_rate=0.01, seed=42,
+          dtype="float32"):
+    """LeNet-5 (BASELINE.md config #1; reference
+    ``nn/multilayer/MultiLayerNetwork.java`` + ``nn/layers/convolution``
+    stack)."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(learning_rate).updater(updater)
+        .data_type(dtype)
+        .list()
+        .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                activation="relu"))
+        .layer(SubsamplingLayer(pooling_type="MAX"))
+        .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                activation="relu"))
+        .layer(SubsamplingLayer(pooling_type="MAX"))
+        .layer(DenseLayer(n_out=dense_width, activation="relu"))
+        .layer(OutputLayer(n_out=n_classes, loss="MCXENT"))
+        .set_input_type(
+            InputType.convolutional_flat(height, width, channels)
+        )
+        .build()
+    )
+
+
+def alexnet(height=224, width=224, channels=3, n_classes=1000, *,
+            updater="NESTEROVS", learning_rate=0.01, seed=42,
+            dtype="float32"):
+    """AlexNet (the reference era's standard large CNN; conv stack per
+    Krizhevsky et al. 2012, grouped convs dropped — XLA fuses the
+    full-width convs onto the MXU instead)."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(learning_rate).updater(updater)
+        .data_type(dtype)
+        .list()
+        .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11),
+                                stride=(4, 4), padding=(2, 2),
+                                activation="relu"))
+        .layer(SubsamplingLayer(pooling_type="MAX", kernel_size=(3, 3),
+                                stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5),
+                                padding=(2, 2), activation="relu"))
+        .layer(SubsamplingLayer(pooling_type="MAX", kernel_size=(3, 3),
+                                stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                padding=(1, 1), activation="relu"))
+        .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                padding=(1, 1), activation="relu"))
+        .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3),
+                                padding=(1, 1), activation="relu"))
+        .layer(SubsamplingLayer(pooling_type="MAX", kernel_size=(3, 3),
+                                stride=(2, 2)))
+        .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        .layer(OutputLayer(n_out=n_classes, loss="MCXENT"))
+        .set_input_type(InputType.convolutional(height, width, channels))
+        .build()
+    )
+
+
+def vgg16(height=32, width=32, channels=3, n_classes=10, *,
+          dense_width=512, updater="NESTEROVS", learning_rate=0.01,
+          seed=42, dtype="bfloat16"):
+    """VGG-16 as a ComputationGraph (BASELINE.md config #2; reference
+    DAG engine ``nn/graph/ComputationGraph.java``). Defaults to pure
+    bf16 — MXU-native, and plain-momentum SGD is bf16-safe."""
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(learning_rate).updater(updater)
+        .data_type(dtype)
+        .graph_builder()
+        .add_inputs("in")
+    )
+    prev = "in"
+    idx = 0
+    for block, (n_layers, width_) in enumerate(
+        [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    ):
+        for _ in range(n_layers):
+            name = f"conv{idx}"
+            b.add_layer(name, ConvolutionLayer(
+                n_out=width_, kernel_size=(3, 3), padding=(1, 1),
+                activation="relu",
+            ), prev)
+            prev = name
+            idx += 1
+        pname = f"pool{block}"
+        b.add_layer(pname, SubsamplingLayer(pooling_type="MAX"), prev)
+        prev = pname
+    b.add_layer("fc0", DenseLayer(n_out=dense_width, activation="relu"),
+                prev)
+    b.add_layer("fc1", DenseLayer(n_out=dense_width, activation="relu"),
+                "fc0")
+    b.add_layer("out", OutputLayer(n_out=n_classes, loss="MCXENT"), "fc1")
+    b.set_outputs("out")
+    b.set_input_types(InputType.convolutional(height, width, channels))
+    return b.build()
+
+
+def _resnet_bottleneck(b, name, in_name, width, *, stride=1,
+                       project=False):
+    """conv1x1 -> conv3x3 -> conv1x1 (4*width) + identity/projection
+    shortcut, joined by an ElementWiseVertex Add and a ReLU."""
+    b.add_layer(f"{name}_c1", ConvolutionLayer(
+        n_out=width, kernel_size=(1, 1), activation="identity",
+    ), in_name)
+    b.add_layer(f"{name}_bn1", BatchNormalization(activation="relu"),
+                f"{name}_c1")
+    b.add_layer(f"{name}_c2", ConvolutionLayer(
+        n_out=width, kernel_size=(3, 3), stride=(stride, stride),
+        padding=(1, 1), activation="identity",
+    ), f"{name}_bn1")
+    b.add_layer(f"{name}_bn2", BatchNormalization(activation="relu"),
+                f"{name}_c2")
+    b.add_layer(f"{name}_c3", ConvolutionLayer(
+        n_out=4 * width, kernel_size=(1, 1), activation="identity",
+    ), f"{name}_bn2")
+    b.add_layer(f"{name}_bn3", BatchNormalization(activation="identity"),
+                f"{name}_c3")
+    shortcut = in_name
+    if project:
+        b.add_layer(f"{name}_proj", ConvolutionLayer(
+            n_out=4 * width, kernel_size=(1, 1),
+            stride=(stride, stride), activation="identity",
+        ), in_name)
+        b.add_layer(f"{name}_projbn",
+                    BatchNormalization(activation="identity"),
+                    f"{name}_proj")
+        shortcut = f"{name}_projbn"
+    b.add_vertex(f"{name}_add", ElementWiseVertex(op="Add"),
+                 f"{name}_bn3", shortcut)
+    b.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                f"{name}_add")
+    return f"{name}_relu"
+
+
+def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
+             updater="NESTEROVS", learning_rate=0.1, seed=42,
+             dtype="bfloat16", cifar_stem=False):
+    """ResNet-50 v1 as a ComputationGraph (BASELINE.md config #5 —
+    the data-parallel scaling model; residual Add via the reference's
+    ``ElementWiseVertex``, bottleneck stacks [3, 4, 6, 3]).
+
+    ``cifar_stem=True`` swaps the 7x7/s2 stem + maxpool for a 3x3/s1
+    conv (the standard CIFAR adaptation) so 32x32 inputs keep spatial
+    extent through the stages."""
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(learning_rate).updater(updater)
+        .data_type(dtype)
+        .graph_builder()
+        .add_inputs("in")
+    )
+    if cifar_stem:
+        b.add_layer("stem", ConvolutionLayer(
+            n_out=64, kernel_size=(3, 3), padding=(1, 1),
+            activation="identity",
+        ), "in")
+        b.add_layer("stem_bn", BatchNormalization(activation="relu"),
+                    "stem")
+        prev = "stem_bn"
+    else:
+        b.add_layer("stem", ConvolutionLayer(
+            n_out=64, kernel_size=(7, 7), stride=(2, 2), padding=(3, 3),
+            activation="identity",
+        ), "in")
+        b.add_layer("stem_bn", BatchNormalization(activation="relu"),
+                    "stem")
+        b.add_layer("stem_pool", SubsamplingLayer(
+            pooling_type="MAX", kernel_size=(3, 3), stride=(2, 2),
+            padding=(1, 1),
+        ), "stem_bn")
+        prev = "stem_pool"
+    widths = [64, 128, 256, 512]
+    depths = [3, 4, 6, 3]
+    for stage, (w, d) in enumerate(zip(widths, depths)):
+        for block in range(d):
+            stride = 2 if (block == 0 and stage > 0) else 1
+            prev = _resnet_bottleneck(
+                b, f"s{stage}b{block}", prev, w,
+                stride=stride, project=(block == 0),
+            )
+    # global average pool: AVG-pool over the full remaining extent
+    final_hw = (height // 32, width // 32) if not cifar_stem else \
+        (height // 8, width // 8)
+    b.add_layer("gap", SubsamplingLayer(
+        pooling_type="AVG", kernel_size=final_hw, stride=final_hw,
+    ), prev)
+    b.add_layer("out", OutputLayer(n_out=n_classes, loss="MCXENT"), "gap")
+    b.set_outputs("out")
+    b.set_input_types(InputType.convolutional(height, width, channels))
+    return b.build()
+
+
+def graves_lstm_char_rnn(vocab=77, hidden=200, n_layers=2, *,
+                         updater="RMSPROP", learning_rate=0.1, seed=42,
+                         tbptt_length=None, dtype="float32"):
+    """Stacked GravesLSTM character model (BASELINE.md config #3;
+    reference ``nn/layers/recurrent/LSTMHelpers.java``)."""
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(learning_rate).updater(updater)
+        .data_type(dtype)
+        .list()
+    )
+    n_in = vocab
+    for _ in range(n_layers):
+        b.layer(GravesLSTM(n_in=n_in, n_out=hidden, activation="tanh"))
+        n_in = hidden
+    b.layer(RnnOutputLayer(n_out=vocab, loss="MCXENT"))
+    if tbptt_length:
+        b.backprop_type("TruncatedBPTT")
+        b.t_bptt_forward_length(tbptt_length)
+        b.t_bptt_backward_length(tbptt_length)
+    return b.build()

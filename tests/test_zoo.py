@@ -1,0 +1,88 @@
+"""Model-zoo builders: shapes, parameter counts, and a train step per
+family (reference analog: the hand-built example configs exercised in
+deeplearning4j-core tests)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.zoo import (
+    alexnet,
+    graves_lstm_char_rnn,
+    lenet,
+    resnet50,
+    vgg16,
+)
+
+
+def _n_params(params) -> int:
+    total = 0
+    for layer in params.values():
+        for p in layer.values():
+            total += int(np.prod(np.asarray(p).shape))
+    return total
+
+
+def test_lenet_trains(rng):
+    net = MultiLayerNetwork(lenet()).init()
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+    s0 = net.fit_minibatch(DataSet(features=x, labels=y))
+    assert np.isfinite(float(s0))
+    out = np.asarray(net.output(x))
+    assert out.shape == (8, 10)
+    np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-4)
+
+
+def test_alexnet_param_count():
+    """AlexNet (ungrouped convs) is ~61-62M params; the exact count
+    pins the conv/dense wiring."""
+    net = MultiLayerNetwork(alexnet()).init()
+    n = _n_params(net.params)
+    assert 55e6 < n < 70e6, n
+
+
+def test_vgg16_cifar_trains(rng):
+    g = ComputationGraph(vgg16(dtype="float32")).init()
+    x = rng.rand(4, 3, 32, 32).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4)]
+    s = g.fit_minibatch(MultiDataSet(features=[x], labels=[y]))
+    assert np.isfinite(float(s))
+    out = np.asarray(g.output(x)[0])
+    assert out.shape == (4, 10)
+
+
+def test_resnet50_param_count_imagenet():
+    """ResNet-50 v1 has ~25.5M params; the count pins the bottleneck
+    stacks [3,4,6,3], projections, and the fc head."""
+    g = ComputationGraph(resnet50(dtype="float32")).init()
+    n = _n_params(g.params)
+    assert 24e6 < n < 27e6, n
+
+
+def test_resnet50_cifar_trains(rng):
+    g = ComputationGraph(
+        resnet50(height=32, width=32, n_classes=10, cifar_stem=True,
+                 dtype="float32")
+    ).init()
+    x = rng.rand(2, 3, 32, 32).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 2)]
+    s = g.fit_minibatch(MultiDataSet(features=[x], labels=[y]))
+    assert np.isfinite(float(s))
+    out = np.asarray(g.output(x)[0])
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-3)
+
+
+def test_char_rnn_trains(rng):
+    net = MultiLayerNetwork(
+        graves_lstm_char_rnn(vocab=11, hidden=16)
+    ).init()
+    ids = rng.randint(0, 11, (4, 12))
+    x = np.eye(11, dtype=np.float32)[ids].transpose(0, 2, 1)
+    y = np.eye(11, dtype=np.float32)[
+        np.roll(ids, -1, 1)
+    ].transpose(0, 2, 1)
+    s = net.fit_minibatch(DataSet(features=x, labels=y))
+    assert np.isfinite(float(s))
