@@ -262,6 +262,40 @@ class SequenceVectors:
     def _sequences(self) -> Iterable[np.ndarray]:
         raise NotImplementedError
 
+    def _flatten_corpus(self, rng):
+        """Concatenate every sequence into corpus-wide arrays for
+        vectorized window generation: (all_ids, pos-in-sentence,
+        own-sentence-length, reduced-window draw b ~ U{1..window}) —
+        after frequent-word subsampling. Returns None for an
+        empty/too-short corpus. Shared by the SkipGram and CBOW pair
+        generators (the per-sentence Python loop this replaces
+        dominated fit() wall-clock)."""
+        total = self.cache.total_word_count
+        seqs = [np.asarray(ids, np.int32) for ids in self._sequences()]
+        seqs = [s for s in seqs if len(s) > 0]
+        if not seqs:
+            return None
+        all_ids = np.concatenate(seqs)
+        lens = np.array([len(s) for s in seqs], np.int32)
+        sent = np.repeat(np.arange(len(lens), dtype=np.int32), lens)
+        if self.sample > 0:
+            keep = subsample_mask(
+                all_ids, self._counts, total, self.sample, rng
+            )
+            all_ids = all_ids[keep]
+            sent = sent[keep]
+            lens = np.bincount(sent, minlength=len(lens)).astype(np.int32)
+        n = len(all_ids)
+        if n < 2:
+            return None
+        starts = np.repeat(
+            np.cumsum(lens, dtype=np.int64).astype(np.int32) - lens, lens
+        )
+        pos = np.arange(n, dtype=np.int32) - starts
+        slen = np.repeat(lens, lens)
+        b = rng.randint(1, self.window + 1, n)
+        return all_ids, pos, slen, b
+
     def _gen_pairs(self, epoch_seed: int):
         """(centers, contexts) int32 arrays for one epoch: reduced
         window sampling + frequent-word subsampling (reference
@@ -273,31 +307,10 @@ class SequenceVectors:
         sentence — the host-side analog of batching for the MXU (the
         per-sentence loop dominated fit() wall-clock before)."""
         rng = np.random.RandomState(epoch_seed)
-        total = self.cache.total_word_count
-        seqs = [np.asarray(ids, np.int32) for ids in self._sequences()]
-        seqs = [s for s in seqs if len(s) > 0]
-        if not seqs:
+        flat = self._flatten_corpus(rng)
+        if flat is None:
             return np.zeros(0, np.int32), np.zeros(0, np.int32)
-        all_ids = np.concatenate(seqs)
-        lens = np.array([len(s) for s in seqs], np.int32)
-        sent = np.repeat(np.arange(len(lens), dtype=np.int32), lens)
-        if self.sample > 0:
-            keep = subsample_mask(
-                all_ids, self._counts, total, self.sample, rng
-            )
-            all_ids = all_ids[keep]
-            sent = sent[keep]
-            lens = np.bincount(sent, minlength=len(lens)).astype(np.int32)
-        starts = np.repeat(np.cumsum(lens, dtype=np.int64).astype(np.int32)
-                           - lens, lens)
-        pos = np.arange(len(all_ids), dtype=np.int32) - starts
-        slen = np.repeat(lens, lens)            # own sentence's length
-        n = len(all_ids)
-        if n < 2:
-            return np.zeros(0, np.int32), np.zeros(0, np.int32)
-        # reduced window: each center draws b ~ U{1..window}; pairs are
-        # (center, center±off) for off <= b, clipped to the sentence
-        b = rng.randint(1, self.window + 1, n)
+        all_ids, pos, slen, b = flat
         centers: List[np.ndarray] = []
         contexts: List[np.ndarray] = []
         for off in range(1, self.window + 1):
@@ -319,33 +332,13 @@ class SequenceVectors:
         window feed one averaged prediction)."""
         rng = np.random.RandomState(epoch_seed)
         W = self.window
-        total = self.cache.total_word_count
         offsets = [o for o in range(-W, W + 1) if o != 0]
-        # corpus-wide vectorization, same technique as _gen_pairs
-        seqs = [np.asarray(ids, np.int32) for ids in self._sequences()]
-        seqs = [s for s in seqs if len(s) > 0]
-        if not seqs:
+        flat = self._flatten_corpus(rng)
+        if flat is None:
             z = np.zeros((0, 2 * W), np.int32)
             return np.zeros(0, np.int32), z, z.astype(np.float32)
-        all_ids = np.concatenate(seqs)
-        lens = np.array([len(s) for s in seqs], np.int32)
-        sent = np.repeat(np.arange(len(lens), dtype=np.int32), lens)
-        if self.sample > 0:
-            keep = subsample_mask(
-                all_ids, self._counts, total, self.sample, rng
-            )
-            all_ids = all_ids[keep]
-            sent = sent[keep]
-            lens = np.bincount(sent, minlength=len(lens)).astype(np.int32)
+        all_ids, pos, slen, b = flat
         n = len(all_ids)
-        if n < 2:
-            z = np.zeros((0, 2 * W), np.int32)
-            return np.zeros(0, np.int32), z, z.astype(np.float32)
-        starts = np.repeat(np.cumsum(lens, dtype=np.int64).astype(np.int32)
-                           - lens, lens)
-        pos = np.arange(n, dtype=np.int32) - starts
-        slen = np.repeat(lens, lens)
-        b = rng.randint(1, W + 1, n)
         padded = np.pad(all_ids, (W, W))
         cols, masks = [], []
         for off in offsets:

@@ -376,6 +376,8 @@ class ComputationGraphConfiguration:
     seed: int = 12345
     iterations: int = 1
     dtype: str = "float32"
+    # mixed precision: compute dtype while params stay in ``dtype``
+    compute_dtype: Optional[str] = None
     backprop: bool = True
     pretrain: bool = False
     backprop_type: str = "Standard"
@@ -427,6 +429,7 @@ class ComputationGraphConfiguration:
             "seed": self.seed,
             "iterations": self.iterations,
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
             "backprop": self.backprop,
             "pretrain": self.pretrain,
             "backprop_type": self.backprop_type,
@@ -459,6 +462,7 @@ class ComputationGraphConfiguration:
             seed=d.get("seed", 12345),
             iterations=d.get("iterations", 1),
             dtype=d.get("dtype", "float32"),
+            compute_dtype=d.get("compute_dtype"),
             backprop=d.get("backprop", True),
             pretrain=d.get("pretrain", False),
             backprop_type=d.get("backprop_type", "Standard"),
@@ -574,6 +578,7 @@ class GraphBuilder:
             seed=self._parent._seed,
             iterations=self._parent._iterations,
             dtype=self._parent._dtype,
+            compute_dtype=self._parent._compute_dtype,
             backprop=self._backprop,
             pretrain=self._pretrain,
             backprop_type=self._backprop_type,

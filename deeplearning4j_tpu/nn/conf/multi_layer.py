@@ -58,6 +58,10 @@ class MultiLayerConfiguration:
     seed: int = 12345
     iterations: int = 1
     dtype: str = "float32"
+    # mixed precision: forward/backward compute dtype (e.g. "bfloat16"
+    # for the MXU) while params/updater state stay in ``dtype`` master
+    # precision; None = compute in ``dtype``
+    compute_dtype: Optional[str] = None
     backprop: bool = True
     pretrain: bool = False
     backprop_type: str = "Standard"  # Standard | TruncatedBPTT
@@ -83,6 +87,7 @@ class MultiLayerConfiguration:
             "seed": self.seed,
             "iterations": self.iterations,
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
             "backprop": self.backprop,
             "pretrain": self.pretrain,
             "backprop_type": self.backprop_type,
@@ -106,6 +111,7 @@ class MultiLayerConfiguration:
             seed=d.get("seed", 12345),
             iterations=d.get("iterations", 1),
             dtype=d.get("dtype", "float32"),
+            compute_dtype=d.get("compute_dtype"),
             backprop=d.get("backprop", True),
             pretrain=d.get("pretrain", False),
             backprop_type=d.get("backprop_type", "Standard"),
@@ -281,6 +287,7 @@ class ListBuilder:
             seed=self._parent._seed,
             iterations=self._parent._iterations,
             dtype=self._parent._dtype,
+            compute_dtype=self._parent._compute_dtype,
             backprop=self._backprop,
             pretrain=self._pretrain,
             backprop_type=self._backprop_type,
@@ -304,6 +311,7 @@ class NeuralNetConfiguration:
             self._seed = 12345
             self._iterations = 1
             self._dtype = "float32"
+            self._compute_dtype = None
             self._optimization_algo = "STOCHASTIC_GRADIENT_DESCENT"
             self._max_num_line_search_iterations = 5
             self._minimize = True
@@ -321,6 +329,15 @@ class NeuralNetConfiguration:
 
         def data_type(self, dtype: str):
             self._dtype = dtype
+            return self
+
+        def compute_data_type(self, dtype):
+            """Mixed precision: run forward/backward in ``dtype`` (bf16
+            on the MXU) while params/updater state keep the master
+            ``data_type``. The TPU-era replacement for the reference's
+            all-or-nothing FP16 backend switch (which disabled its cuDNN
+            helpers entirely, ``ConvolutionLayer.java:163``)."""
+            self._compute_dtype = dtype
             return self
 
         def optimization_algo(self, algo: str):

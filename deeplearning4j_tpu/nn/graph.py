@@ -80,7 +80,20 @@ class ComputationGraph:
         dtype = self._dtype()
         conf = self.conf
         if params is not None:
-            self.params = params
+            # checkpoint npz round-trips drop empty entries; param-less
+            # layer vertices get their {} slot back, but a missing
+            # PARAMETERIZED vertex is checkpoint corruption — fail here
+            restored = {}
+            for n in self.layer_vertex_names:
+                if n in params:
+                    restored[n] = params[n]
+                elif conf.vertices[n].init_params(self._base_key, dtype):
+                    raise ValueError(
+                        f"checkpoint has no params for vertex '{n}'"
+                    )
+                else:
+                    restored[n] = {}
+            self.params = restored
         else:
             keys = jax.random.split(
                 self._base_key, max(len(self.layer_vertex_names), 1)
@@ -102,7 +115,23 @@ class ComputationGraph:
                         train: bool, rng, fmasks=None):
         """Walk the topo order; returns ({vertex: value}, preouts,
         new_state). ``fmasks``: per-graph-input [b, t] masks."""
+        from deeplearning4j_tpu.nn.multilayer import (
+            _cast_floats,
+            _compute_dtype_of,
+        )
+
         conf = self.conf
+        cdt = _compute_dtype_of(conf)
+        if cdt != self._dtype():
+            # mixed precision (same contract as MultiLayerNetwork):
+            # master params keep the storage dtype, compute runs in cdt
+            params = _cast_floats(params, cdt)
+            inputs = [_cast_floats(x, cdt) for x in inputs]
+            if fmasks is not None:
+                fmasks = [
+                    None if m is None else _cast_floats(m, cdt)
+                    for m in fmasks
+                ]
         values: Dict[str, Any] = dict(zip(conf.inputs, inputs))
         masks: Dict[str, Any] = {}
         if fmasks is not None:

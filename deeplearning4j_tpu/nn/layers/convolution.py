@@ -219,7 +219,11 @@ class BatchNormalization(LayerSpec):
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
             }
         else:
-            mean, var = state["mean"], state["var"]
+            # running stats live in master precision; normalize in the
+            # activation dtype so mixed-precision inference stays in
+            # the compute dtype instead of promoting downstream to f32
+            mean = state["mean"].astype(x.dtype)
+            var = state["var"].astype(x.dtype)
             new_state = state
         xhat = (x - mean.reshape(bshape)) * lax.rsqrt(
             var.reshape(bshape) + self.eps

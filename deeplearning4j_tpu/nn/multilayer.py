@@ -54,6 +54,26 @@ def _to_device(a, dtype):
     return jnp.asarray(a, dtype)
 
 
+def _compute_dtype_of(conf) -> jnp.dtype:
+    """Forward/backward compute dtype: ``conf.compute_dtype`` when set
+    (mixed precision — bf16 on the MXU with f32 master params), else
+    the storage dtype."""
+    return jnp.dtype(getattr(conf, "compute_dtype", None) or conf.dtype)
+
+
+def _cast_floats(tree, dtype):
+    """Cast floating leaves of a pytree to ``dtype`` (ints — embedding
+    indices, native-width inputs — pass through untouched)."""
+    return jax.tree_util.tree_map(
+        lambda a: (
+            a.astype(dtype)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact)
+            else a
+        ),
+        tree,
+    )
+
+
 def _nbytes(a) -> int:
     nb = getattr(a, "nbytes", None)
     return int(nb) if nb is not None else int(np.asarray(a).nbytes)
@@ -189,7 +209,22 @@ class MultiLayerNetwork:
     def init(self, params: Optional[dict] = None) -> "MultiLayerNetwork":
         dtype = _dtype_of(self.conf)
         if params is not None:
-            self.params = params
+            # checkpoint npz round-trips drop empty entries; param-less
+            # layers (pooling, activation) get their {} slot back, but
+            # a missing PARAMETERIZED layer is checkpoint corruption —
+            # fail here, not at a KeyError deep inside the first trace
+            restored = {}
+            for name, layer in zip(self.layer_names, self.conf.layers):
+                if name in params:
+                    restored[name] = params[name]
+                elif layer.init_params(self._base_key, dtype):
+                    raise ValueError(
+                        f"checkpoint has no params for layer '{name}' "
+                        f"({type(layer).__name__})"
+                    )
+                else:
+                    restored[name] = {}
+            self.params = restored
         else:
             keys = jax.random.split(
                 self._base_key, max(len(self.conf.layers), 1)
@@ -226,6 +261,14 @@ class MultiLayerNetwork:
         ``fmask``: [batch, time] features mask threaded to recurrent
         layers (reference ``setLayerMaskArrays``)."""
         conf = self.conf
+        cdt = _compute_dtype_of(conf)
+        if cdt != _dtype_of(conf):
+            # mixed precision: master params stay in the storage dtype
+            # (grads flow back through the cast, so the updater applies
+            # them in master precision); compute runs in cdt
+            params = _cast_floats(params, cdt)
+            x = _cast_floats(x, cdt)
+            fmask = _cast_floats(fmask, cdt) if fmask is not None else None
         ctx = self._ctx_for(x)
         n = len(conf.layers) if upto is None else upto + 1
         new_state = dict(state)

@@ -27,14 +27,14 @@ from deeplearning4j_tpu.nn.layers import (
 
 def lenet(height=28, width=28, channels=1, n_classes=10, *,
           dense_width=512, updater="ADAM", learning_rate=0.01, seed=42,
-          dtype="float32"):
+          dtype="float32", compute_dtype=None):
     """LeNet-5 (BASELINE.md config #1; reference
     ``nn/multilayer/MultiLayerNetwork.java`` + ``nn/layers/convolution``
     stack)."""
     return (
         NeuralNetConfiguration.Builder()
         .seed(seed).learning_rate(learning_rate).updater(updater)
-        .data_type(dtype)
+        .data_type(dtype).compute_data_type(compute_dtype)
         .list()
         .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
                                 activation="relu"))
@@ -53,14 +53,14 @@ def lenet(height=28, width=28, channels=1, n_classes=10, *,
 
 def alexnet(height=224, width=224, channels=3, n_classes=1000, *,
             updater="NESTEROVS", learning_rate=0.01, seed=42,
-            dtype="float32"):
+            dtype="float32", compute_dtype=None):
     """AlexNet (the reference era's standard large CNN; conv stack per
     Krizhevsky et al. 2012, grouped convs dropped — XLA fuses the
     full-width convs onto the MXU instead)."""
     return (
         NeuralNetConfiguration.Builder()
         .seed(seed).learning_rate(learning_rate).updater(updater)
-        .data_type(dtype)
+        .data_type(dtype).compute_data_type(compute_dtype)
         .list()
         .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11),
                                 stride=(4, 4), padding=(2, 2),
@@ -89,14 +89,15 @@ def alexnet(height=224, width=224, channels=3, n_classes=1000, *,
 
 def vgg16(height=32, width=32, channels=3, n_classes=10, *,
           dense_width=512, updater="NESTEROVS", learning_rate=0.01,
-          seed=42, dtype="bfloat16"):
+          seed=42, dtype="float32", compute_dtype=None):
     """VGG-16 as a ComputationGraph (BASELINE.md config #2; reference
-    DAG engine ``nn/graph/ComputationGraph.java``). Defaults to pure
-    bf16 — MXU-native, and plain-momentum SGD is bf16-safe."""
+    DAG engine ``nn/graph/ComputationGraph.java``). For MXU-native
+    speed pass ``dtype="bfloat16"`` (pure bf16 — momentum SGD is
+    bf16-safe) or ``compute_dtype="bfloat16"`` (f32 master weights)."""
     b = (
         NeuralNetConfiguration.Builder()
         .seed(seed).learning_rate(learning_rate).updater(updater)
-        .data_type(dtype)
+        .data_type(dtype).compute_data_type(compute_dtype)
         .graph_builder()
         .add_inputs("in")
     )
@@ -165,7 +166,7 @@ def _resnet_bottleneck(b, name, in_name, width, *, stride=1,
 
 def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
              updater="NESTEROVS", learning_rate=0.1, seed=42,
-             dtype="bfloat16", cifar_stem=False):
+             dtype="float32", compute_dtype=None, cifar_stem=False):
     """ResNet-50 v1 as a ComputationGraph (BASELINE.md config #5 —
     the data-parallel scaling model; residual Add via the reference's
     ``ElementWiseVertex``, bottleneck stacks [3, 4, 6, 3]).
@@ -173,10 +174,18 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
     ``cifar_stem=True`` swaps the 7x7/s2 stem + maxpool for a 3x3/s1
     conv (the standard CIFAR adaptation) so 32x32 inputs keep spatial
     extent through the stages."""
+    div = 8 if cifar_stem else 32
+    if height % div or width % div:
+        raise ValueError(
+            f"resnet50 input extent must be divisible by {div} "
+            f"(total stride{' with cifar_stem' if cifar_stem else ''}); "
+            f"got {height}x{width} — the global average pool would "
+            "silently drop edge cells otherwise"
+        )
     b = (
         NeuralNetConfiguration.Builder()
         .seed(seed).learning_rate(learning_rate).updater(updater)
-        .data_type(dtype)
+        .data_type(dtype).compute_data_type(compute_dtype)
         .graph_builder()
         .add_inputs("in")
     )
@@ -223,13 +232,14 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
 
 def graves_lstm_char_rnn(vocab=77, hidden=200, n_layers=2, *,
                          updater="RMSPROP", learning_rate=0.1, seed=42,
-                         tbptt_length=None, dtype="float32"):
+                         tbptt_length=None, dtype="float32",
+                         compute_dtype=None):
     """Stacked GravesLSTM character model (BASELINE.md config #3;
     reference ``nn/layers/recurrent/LSTMHelpers.java``)."""
     b = (
         NeuralNetConfiguration.Builder()
         .seed(seed).learning_rate(learning_rate).updater(updater)
-        .data_type(dtype)
+        .data_type(dtype).compute_data_type(compute_dtype)
         .list()
     )
     n_in = vocab

@@ -180,3 +180,34 @@ def test_invalid_geometry_raises():
             .set_input_type(InputType.convolutional(5, 5, 1))
             .build()
         )
+
+
+def test_batchnorm_mixed_precision_eval_stays_in_compute_dtype(rng):
+    """Under compute_data_type('bfloat16'), BN's f32 running stats must
+    not promote eval activations back to f32 — every layer's output
+    stays in the compute dtype for inference too."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.layers import BatchNormalization, DenseLayer
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).learning_rate(0.01)
+        .compute_data_type("bfloat16").updater("ADAM")
+        .list()
+        .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                activation="relu"))
+        .layer(BatchNormalization())
+        .layer(DenseLayer(n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3))
+        .set_input_type(InputType.convolutional(8, 8, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    assert net.state["1"]["mean"].dtype == jnp.float32  # master stats
+    x = jnp.asarray(rng.rand(2, 1, 8, 8).astype(np.float32))
+    _, _, _, acts = net._forward_pure(
+        net.params, net.state, x, train=False, rng=None, collect=True
+    )
+    assert all(a.dtype == jnp.bfloat16 for a in acts), [
+        str(a.dtype) for a in acts
+    ]

@@ -231,3 +231,22 @@ def test_early_stopping_cluster_trainer(rng, tmp_path):
     result = ClusterEarlyStoppingTrainer(cfg, net, master, train).fit()
     assert result.total_epochs == 3
     assert result.best_model_score < s0
+
+
+def test_checkpoint_round_trip_with_paramless_layers(rng, tmp_path):
+    """Pooling/activation layers have no params; the npz coefficient
+    store drops their empty entries, and restore must recreate them
+    (regression: restored LeNet raised KeyError on the pool layer)."""
+    from deeplearning4j_tpu.zoo import lenet
+
+    net = MultiLayerNetwork(lenet(dense_width=32)).init()
+    x = rng.rand(4, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4)]
+    net.fit_minibatch(DataSet(features=x, labels=y))
+    p = str(tmp_path / "lenet.zip")
+    write_model(net, p)
+    net2 = restore_multi_layer_network(p)
+    np.testing.assert_allclose(
+        np.asarray(net2.output(x)), np.asarray(net.output(x)),
+        rtol=2e-6, atol=2e-6,
+    )
