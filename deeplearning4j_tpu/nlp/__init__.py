@@ -24,6 +24,15 @@ from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
     register_tokenizer_factory,
     tokenizer_factory,
 )
+from deeplearning4j_tpu.nlp import cjk  # noqa: F401 — registers ja/ko
+from deeplearning4j_tpu.nlp.treeparser import (  # noqa: F401
+    Tree,
+    TreeParser,
+    TreeVectorizer,
+    porter_stem,
+    pos_tag,
+    segment_sentences,
+)
 from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
     BagOfWordsVectorizer,
     TfidfVectorizer,

@@ -143,10 +143,9 @@ register_tokenizer_factory("default", DefaultTokenizerFactory)
 register_tokenizer_factory("ngram", NGramTokenizerFactory)
 register_tokenizer_factory("regex", RegexTokenizerFactory)
 register_tokenizer_factory("char", CharTokenizerFactory)
-# CJK entries default to character segmentation; replace via
-# register_tokenizer_factory with a real analyzer when available.
-register_tokenizer_factory("japanese", CharTokenizerFactory)
-register_tokenizer_factory("korean", CharTokenizerFactory)
+# CJK entries are registered by deeplearning4j_tpu.nlp.cjk (script-
+# class segmentation); replace via register_tokenizer_factory with a
+# real morphological analyzer when available.
 
 
 # ---------------------------------------------------------------------------

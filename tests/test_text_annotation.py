@@ -1,0 +1,97 @@
+"""CJK tokenizers + text-annotation periphery (reference:
+deeplearning4j-nlp-japanese/-korean wrappers, deeplearning4j-nlp-uima
+annotators and treeparser)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import cjk  # noqa: F401 — registers factories
+from deeplearning4j_tpu.nlp.tokenization import tokenizer_factory
+from deeplearning4j_tpu.nlp.treeparser import (
+    Tree,
+    TreeParser,
+    TreeVectorizer,
+    binarize,
+    collapse_unaries,
+    porter_stem,
+    pos_tag,
+    segment_sentences,
+)
+
+
+def test_japanese_script_segmentation():
+    tf = tokenizer_factory("japanese")
+    toks = tf.create("私はTPUで学習します。").get_tokens()
+    assert toks == ["私", "は", "TPU", "で", "学習", "します"]
+
+
+def test_korean_eojeol_tokenization():
+    tf = tokenizer_factory("korean")
+    toks = tf.create("한국어 토큰화, 테스트 ABC123!").get_tokens()
+    assert toks == ["한국어", "토큰화", "테스트", "ABC", "123"]
+
+
+def test_registry_lists_cjk():
+    assert tokenizer_factory("japanese") is not None
+    assert tokenizer_factory("korean") is not None
+
+
+def test_sentence_segmentation_holds_abbreviations():
+    s = segment_sentences(
+        "Dr. Smith arrived at 5 p.m. yesterday. He met J. Doe. Done!"
+    )
+    assert s[-1] == "Done!"
+    assert any("Smith" in x for x in s)
+    assert len(s) == 3
+
+
+def test_porter_stemmer_classic_cases():
+    cases = {
+        "caresses": "caress", "ponies": "poni", "relational": "relat",
+        "hopping": "hop", "happy": "happi", "running": "run",
+        "argument": "argument", "adjustable": "adjust",
+    }
+    for w, want in cases.items():
+        assert porter_stem(w) == want, (w, porter_stem(w), want)
+
+
+def test_pos_tagger_basic():
+    tags = pos_tag(["The", "quick", "dogs", "ran", "quickly"])
+    assert tags[0] == "DT"
+    assert tags[2] == "NNS"
+    assert tags[4] == "RB"
+
+
+def test_tree_parse_binarize_collapse():
+    tree = TreeParser().parse("The big dog chased the cat")
+    assert tree.label == "S"
+    assert tree.tokens() == ["The", "big", "dog", "chased", "the", "cat"]
+    b = binarize(tree)
+
+    def max_arity(t):
+        if t.is_leaf():
+            return 0
+        return max([len(t.children)] + [max_arity(c) for c in t.children])
+
+    assert max_arity(b) <= 2
+    assert b.tokens() == tree.tokens()
+    c = collapse_unaries(
+        Tree(label="S", children=[Tree(label="NP", children=[
+            Tree(label="NN", children=[Tree(value="dog", label="dog")])
+        ])])
+    )
+    # unary chain S->NP collapsed; preterminal->leaf kept
+    assert c.depth() < 3 or c.tokens() == ["dog"]
+    assert c.tokens() == ["dog"]
+
+
+def test_tree_vectorizer_attaches_vectors():
+    vecs = {"dog": np.ones(4, np.float32)}
+    tv = TreeVectorizer(lambda w: vecs.get(w), layer_size=4)
+    trees = tv.trees_with_vectors("The dogs ran. A cat sat.")
+    assert len(trees) == 2
+    leaves = trees[0].yield_leaves()
+    assert all(leaf.vector is not None and leaf.vector.shape == (4,)
+               for leaf in leaves)
+    # "dogs" stems to "dog" -> known vector
+    by_word = {leaf.value: leaf.vector for leaf in leaves}
+    np.testing.assert_array_equal(by_word["dogs"], np.ones(4))
