@@ -42,7 +42,12 @@ def _script_class(ch: str) -> str:
         or 0xF900 <= cp <= 0xFAFF
     ):
         return "kanji"
-    if 0xAC00 <= cp <= 0xD7AF or 0x1100 <= cp <= 0x11FF:
+    if (
+        0xAC00 <= cp <= 0xD7AF       # syllables
+        or 0x1100 <= cp <= 0x11FF    # jamo
+        or 0x3130 <= cp <= 0x318F    # compatibility jamo (e.g. ㅋㅋ)
+        or 0xA960 <= cp <= 0xA97F    # jamo extended-A
+    ):
         return "hangul"
     if ch.isspace():
         return "space"
@@ -91,28 +96,23 @@ class JapaneseTokenizerFactory:
         self.keep_punct = keep_punct
 
     def create(self, text: str) -> Tokenizer:
-        toks = segment_by_script(text, keep_punct=self.keep_punct)
-        if self.preprocessor is not None:
-            toks = [self.preprocessor(t) for t in toks]
-        return Tokenizer(toks)
+        # Tokenizer's preprocessor seam also drops emptied tokens
+        # (e.g. a digit-only token a CommonPreprocessor maps to "")
+        return Tokenizer(
+            segment_by_script(text, keep_punct=self.keep_punct),
+            self.preprocessor,
+        )
 
 
-class KoreanTokenizerFactory:
+class KoreanTokenizerFactory(JapaneseTokenizerFactory):
     """Eojeol (whitespace) tokenization with punctuation stripped
     (twitter-korean-text wrapper analog, ``KoreanTokenizer.java:35``).
-    Mixed-script eojeols split on script boundaries so hangul runs
-    separate from embedded latin/digits."""
+    Korean is whitespace-delimited, which script-class segmentation
+    already honors; mixed-script eojeols split on script boundaries so
+    hangul runs separate from embedded latin/digits."""
 
     def __init__(self, preprocessor=None):
-        self.preprocessor = preprocessor
-
-    def create(self, text: str) -> Tokenizer:
-        toks: List[str] = []
-        for chunk in text.split():
-            toks.extend(segment_by_script(chunk))
-        if self.preprocessor is not None:
-            toks = [self.preprocessor(t) for t in toks]
-        return Tokenizer(toks)
+        super().__init__(preprocessor, keep_punct=False)
 
 
 register_tokenizer_factory("japanese", JapaneseTokenizerFactory)

@@ -285,7 +285,8 @@ def binarize(tree: Tree) -> Tree:
     """Right-binarize n-ary nodes (reference
     ``BinarizeTreeTransformer``)."""
     if tree.is_leaf():
-        return tree
+        return tree.clone()  # fresh leaves: vectorizing the binarized
+        # tree must not mutate the input tree's nodes
     kids = [binarize(c) for c in tree.children]
     while len(kids) > 2:
         right = Tree(label=f"@{tree.label}", children=kids[-2:])
@@ -298,7 +299,12 @@ def collapse_unaries(tree: Tree) -> Tree:
     """Collapse unary chains X->Y->... (reference
     ``CollapseUnaries``), keeping preterminal->leaf."""
     t = tree
-    while len(t.children) == 1 and not t.children[0].is_leaf():
+    while (
+        len(t.children) == 1
+        and not t.children[0].is_leaf()
+        and not t.is_preterminal()
+        and not t.children[0].is_preterminal()
+    ):
         t = t.children[0]
     return Tree(label=tree.label, children=[
         collapse_unaries(c) for c in t.children
@@ -364,9 +370,11 @@ class TreeVectorizer:
     def vectorize(self, tree: Tree) -> Tree:
         for leaf in tree.yield_leaves():
             word = leaf.value or ""
-            if self.stem:
-                word = porter_stem(word)
             v = self.lookup(word)
+            if v is None and self.stem:
+                # vocabularies hold surface forms; only fall back to
+                # the Porter stem ("happi") when the word itself misses
+                v = self.lookup(porter_stem(word))
             leaf.vector = (
                 np.zeros(self.layer_size, np.float32)
                 if v is None else np.asarray(v, np.float32)

@@ -135,8 +135,8 @@ def test_words_nearest_sum_analogy():
 def test_tokenizer_registry_spi():
     tf = tokenizer_factory("default")
     assert tf.create("a b c").get_tokens() == ["a", "b", "c"]
-    cj = tokenizer_factory("japanese")  # char-level stand-in
-    assert cj.create("日本語 テスト").get_tokens() == list("日本語テスト")
+    cj = tokenizer_factory("japanese")  # script-class segmentation
+    assert cj.create("日本語 テスト").get_tokens() == ["日本語", "テスト"]
     rx = tokenizer_factory("regex", pattern=r"[,;]")
     assert rx.create("a,b;c").get_tokens() == ["a", "b", "c"]
 
