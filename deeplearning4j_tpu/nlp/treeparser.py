@@ -302,7 +302,6 @@ def collapse_unaries(tree: Tree) -> Tree:
     while (
         len(t.children) == 1
         and not t.children[0].is_leaf()
-        and not t.is_preterminal()
         and not t.children[0].is_preterminal()
     ):
         t = t.children[0]

@@ -429,6 +429,10 @@ class SequenceVectors:
         K = self.scan_chunk
         lk = self.lookup
         n = len(centers)
+        # word ids transfer at native width (uint16 for vocabs under
+        # 64k — half the host->device bytes); the on-device gather
+        # accepts either and values are identical
+        idt = np.uint16 if len(self._counts) < 2 ** 16 else np.int32
         for s0 in range(0, n, B * K):
             cs = centers[s0:s0 + B * K]
             os_ = contexts[s0:s0 + B * K]
@@ -439,12 +443,12 @@ class SequenceVectors:
                 mask[len(cs):] = 0.0
                 cs = np.pad(cs, (0, pad))
                 os_ = np.pad(os_, (0, pad))
-            ck = cs.reshape(k, B)
-            ok = os_.reshape(k, B)
+            ck = cs.reshape(k, B).astype(idt, copy=False)
+            ok = os_.reshape(k, B).astype(idt, copy=False)
             mk = mask.reshape(k, B)
             alphas = np.empty(k, np.float32)
             negs = (
-                np.empty((k, B, self.negative), np.int32)
+                np.empty((k, B, self.negative), idt)
                 if self.negative > 0 else None
             )
             for i in range(k):

@@ -1,0 +1,56 @@
+"""ResNet-50 (CIFAR stem) with mixed precision — zoo builder + the
+f32-master/bf16-compute policy (reference analog: dl4j-examples deep
+CNN examples; the policy replaces the reference's all-or-nothing FP16
+backend switch).
+
+Run: python examples/resnet_cifar_mixed_precision.py [--steps N]
+Trains on the opt-in synthetic CIFAR-10 set when the binaries are
+absent (DL4J_TPU_CIFAR_DIR points at cifar-10-batches-bin otherwise).
+"""
+
+import argparse
+import warnings
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+from deeplearning4j_tpu.zoo import resnet50
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+
+    conf = resnet50(
+        height=32, width=32, n_classes=10, cifar_stem=True,
+        learning_rate=0.05,
+        dtype="float32",            # master params stay f32
+        compute_dtype="bfloat16",   # forward/backward on the MXU in bf16
+    )
+    g = ComputationGraph(conf).init()
+    n_params = sum(
+        int(np.prod(np.asarray(p).shape))
+        for layer in g.params.values() for p in layer.values()
+    )
+    print(f"ResNet-50 (CIFAR stem): {n_params/1e6:.1f}M params, "
+          "f32 master / bf16 compute")
+
+    perf = PerformanceListener(frequency=4, report=True)
+    g.set_listeners(perf)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        it = CifarDataSetIterator(
+            args.batch, num_examples=args.batch * args.steps,
+            allow_synthetic=True, seed=0,
+        )
+    for ds in it:
+        score = g.fit_minibatch(ds)
+    print(f"final score: {float(score):.4f}")
+
+
+if __name__ == "__main__":
+    main()
