@@ -166,15 +166,19 @@ def _resnet_bottleneck(b, name, in_name, width, *, stride=1,
 
 def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
              updater="NESTEROVS", learning_rate=0.1, seed=42,
-             dtype="float32", compute_dtype=None, cifar_stem=False):
+             dtype="float32", compute_dtype=None, cifar_stem=False,
+             depths=(3, 4, 6, 3), base_width=64):
     """ResNet-50 v1 as a ComputationGraph (BASELINE.md config #5 —
     the data-parallel scaling model; residual Add via the reference's
-    ``ElementWiseVertex``, bottleneck stacks [3, 4, 6, 3]).
+    ``ElementWiseVertex``, bottleneck stacks ``depths`` — default
+    [3, 4, 6, 3]; shrink ``depths``/``base_width`` for test-scale
+    variants).
 
     ``cifar_stem=True`` swaps the 7x7/s2 stem + maxpool for a 3x3/s1
     conv (the standard CIFAR adaptation) so 32x32 inputs keep spatial
     extent through the stages."""
-    div = 8 if cifar_stem else 32
+    # total stride: stem (1 or 4, incl. maxpool) x 2 per later stage
+    div = (1 if cifar_stem else 4) * (2 ** (len(depths) - 1))
     if height % div or width % div:
         raise ValueError(
             f"resnet50 input extent must be divisible by {div} "
@@ -191,7 +195,7 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
     )
     if cifar_stem:
         b.add_layer("stem", ConvolutionLayer(
-            n_out=64, kernel_size=(3, 3), padding=(1, 1),
+            n_out=base_width, kernel_size=(3, 3), padding=(1, 1),
             activation="identity",
         ), "in")
         b.add_layer("stem_bn", BatchNormalization(activation="relu"),
@@ -199,8 +203,8 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
         prev = "stem_bn"
     else:
         b.add_layer("stem", ConvolutionLayer(
-            n_out=64, kernel_size=(7, 7), stride=(2, 2), padding=(3, 3),
-            activation="identity",
+            n_out=base_width, kernel_size=(7, 7), stride=(2, 2),
+            padding=(3, 3), activation="identity",
         ), "in")
         b.add_layer("stem_bn", BatchNormalization(activation="relu"),
                     "stem")
@@ -209,8 +213,7 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
             padding=(1, 1),
         ), "stem_bn")
         prev = "stem_pool"
-    widths = [64, 128, 256, 512]
-    depths = [3, 4, 6, 3]
+    widths = [base_width * 2 ** i for i in range(len(depths))]
     for stage, (w, d) in enumerate(zip(widths, depths)):
         for block in range(d):
             stride = 2 if (block == 0 and stage > 0) else 1
@@ -219,8 +222,7 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
                 stride=stride, project=(block == 0),
             )
     # global average pool: AVG-pool over the full remaining extent
-    final_hw = (height // 32, width // 32) if not cifar_stem else \
-        (height // 8, width // 8)
+    final_hw = (height // div, width // div)
     b.add_layer("gap", SubsamplingLayer(
         pooling_type="AVG", kernel_size=final_hw, stride=final_hw,
     ), prev)

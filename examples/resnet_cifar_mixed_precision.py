@@ -11,8 +11,6 @@ absent (DL4J_TPU_CIFAR_DIR points at cifar-10-batches-bin otherwise).
 import argparse
 import warnings
 
-import numpy as np
-
 from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.optimize.listeners import PerformanceListener
@@ -32,11 +30,7 @@ def main():
         compute_dtype="bfloat16",   # forward/backward on the MXU in bf16
     )
     g = ComputationGraph(conf).init()
-    n_params = sum(
-        int(np.prod(np.asarray(p).shape))
-        for layer in g.params.values() for p in layer.values()
-    )
-    print(f"ResNet-50 (CIFAR stem): {n_params/1e6:.1f}M params, "
+    print(f"ResNet-50 (CIFAR stem): {g.num_params()/1e6:.1f}M params, "
           "f32 master / bf16 compute")
 
     perf = PerformanceListener(frequency=4, report=True)

@@ -243,3 +243,47 @@ def test_parallel_wrapper_updates_batchnorm_state(rng):
     pw.fit(ListDataSetIterator(DataSet(features=x, labels=y).batch_by(8)))
     m1 = np.asarray(net.state["1"]["mean"])
     assert not np.allclose(m0, m1)
+
+
+def test_dp_resnet_residual_architecture(rng):
+    """Data-parallel training of a scaled-down ResNet (BASELINE.md
+    config #5 pairs ResNet with DP): residual Adds + BN + projection
+    shortcuts must shard over the data axis and match single-device
+    training bitwise."""
+    conftest.require_devices(4)
+    from deeplearning4j_tpu.datasets.api import MultiDataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.parallel import DistributedTrainer, build_mesh
+    from deeplearning4j_tpu.zoo import resnet50
+
+    def build():
+        return ComputationGraph(resnet50(
+            height=8, width=8, channels=1, n_classes=3, cifar_stem=True,
+            depths=(1, 1), base_width=4, learning_rate=0.05,
+        )).init()
+
+    x = rng.rand(8, 1, 8, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+    mds = MultiDataSet(features=[x], labels=[y])
+
+    single = build()
+    for _ in range(2):
+        s_single = single.fit_minibatch(mds)
+
+    dp = build()
+    mesh = build_mesh(data=4, model=1, devices=jax.devices()[:4])
+    tr = DistributedTrainer(dp, mesh=mesh)
+    for _ in range(2):
+        s_dp = tr.fit_minibatch(mds)
+
+    assert np.isfinite(float(s_dp))
+    np.testing.assert_allclose(
+        float(s_single), float(s_dp), rtol=1e-5, atol=1e-6
+    )
+    for vn in single.params:
+        for pn in single.params[vn]:
+            np.testing.assert_allclose(
+                np.asarray(single.params[vn][pn]),
+                np.asarray(dp.params[vn][pn]),
+                rtol=1e-5, atol=1e-6,
+            )
