@@ -60,6 +60,8 @@ class ComputationGraph:
         # multi-epoch fits keep the dataset HBM-resident up to this size
         self.device_cache_bytes = 4 << 30
         self._jit_output = None
+        self._jit_rnn_step = None
+        self._rnn_state: Dict[str, Any] = {}  # streaming rnnTimeStep
         self._base_key = jax.random.PRNGKey(conf.seed)
 
     @property
@@ -550,6 +552,70 @@ class ComputationGraph:
         dtype = self._dtype()
         arr = [jnp.asarray(x, dtype) for x in inputs]
         return self._jit_output(self.params, self.state, arr)
+
+    def feed_forward(self, *inputs, train: bool = False) -> Dict[str, Any]:
+        """Activations of EVERY vertex by name (reference
+        ``ComputationGraph.feedForward`` returns the activation map)."""
+        if self.params is None:
+            self.init()
+        dtype = self._dtype()
+        arr = [jnp.asarray(x, dtype) for x in inputs]
+        # train=True must apply dropout like the fit path does
+        rng = (
+            jax.random.fold_in(self._base_key, self.iteration_count)
+            if train else None
+        )
+        values, _, _ = self._forward_values(
+            self.params, self.state, arr, train=train, rng=rng
+        )
+        return values
+
+    def rnn_time_step(self, *inputs) -> List[jax.Array]:
+        """Feed one (or a few) timesteps per input, carrying recurrent
+        state across calls (reference ``ComputationGraph.rnnTimeStep``,
+        ``ComputationGraph.java:1748``). Inputs [b, size] or
+        [b, size, t]; returns the output vertices' activations with the
+        same time-axis convention as the inputs."""
+        if self.params is None:
+            self.init()
+        for n in self.layer_vertex_names:
+            lc = self.conf.vertices[n].layer_conf
+            if not lc.can_stream():
+                raise ValueError(
+                    f"Vertex '{n}' ({type(lc).__name__}) cannot be used "
+                    "with rnn_time_step — it needs the full sequence "
+                    "(reference throws UnsupportedOperationException)"
+                )
+        dtype = self._dtype()
+        arr = [jnp.asarray(x, dtype) for x in inputs]
+        # each [b, size] input gets a singleton time axis independently;
+        # outputs come back 2-d only when EVERY input arrived 2-d
+        was_2d = [x.ndim == 2 for x in arr]
+        squeeze = bool(arr) and all(was_2d)
+        arr = [x[:, :, None] if w else x for x, w in zip(arr, was_2d)]
+        merged = dict(self.state)
+        for name, carry in self._rnn_state.items():
+            merged[name] = {**merged.get(name, {}), **carry}
+        if self._jit_rnn_step is None:
+            def rnn_step(params, state, inputs):
+                values, _, new_state = self._forward_values(
+                    params, state, inputs, train=False, rng=None
+                )
+                return [values[n] for n in self.conf.outputs], new_state
+            self._jit_rnn_step = jax.jit(rnn_step)
+        outs, new_state = self._jit_rnn_step(self.params, merged, arr)
+        for n in self.layer_vertex_names:
+            if self.conf.vertices[n].layer_conf.is_recurrent():
+                self._rnn_state[n] = {
+                    k: new_state[n][k] for k in ("h", "c")
+                    if k in new_state[n]
+                }
+        return [o[:, :, 0] if squeeze and o.ndim == 3 else o
+                for o in outs]
+
+    def rnn_clear_previous_state(self) -> None:
+        """Reference ``rnnClearPreviousState``."""
+        self._rnn_state = {}
 
     def score(self, ds) -> float:
         dtype = self._dtype()

@@ -348,6 +348,39 @@ def test_graph_scan_fused_fit_matches_per_step(rng):
             )
 
 
+def test_graph_rnn_time_step_matches_full_forward(rng):
+    """CG streaming inference: rnn_time_step one step at a time must
+    equal the full-sequence forward (reference
+    ``ComputationGraph.rnnTimeStep``, ``ComputationGraph.java:1748``)."""
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(4).learning_rate(0.1)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("lstm", GravesLSTM(n_in=3, n_out=6,
+                                      activation="tanh"), "in")
+        .add_layer("out", RnnOutputLayer(n_in=6, n_out=2), "lstm")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    x = rng.rand(2, 3, 5).astype(np.float32)
+    full = np.asarray(g.output(x)[0])
+    g.rnn_clear_previous_state()
+    outs = [
+        np.asarray(g.rnn_time_step(x[:, :, t])[0])
+        for t in range(x.shape[2])
+    ]
+    stepped = np.stack(outs, axis=2)
+    np.testing.assert_allclose(full, stepped, rtol=1e-4, atol=1e-5)
+    # carried state changes the continuation; clearing resets it
+    more = np.asarray(g.rnn_time_step(x[:, :, 0])[0])
+    g.rnn_clear_previous_state()
+    fresh = np.asarray(g.rnn_time_step(x[:, :, 0])[0])
+    assert not np.allclose(more, fresh)
+
+
 def test_graph_device_cached_epochs_match_streaming(rng):
     """CG multi-epoch fit over a list (HBM-resident batches) must match
     one-epoch-at-a-time streaming bitwise."""
