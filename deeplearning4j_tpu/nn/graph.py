@@ -62,6 +62,9 @@ class ComputationGraph:
         self._jit_output = None
         self._jit_rnn_step = None
         self._rnn_state: Dict[str, Any] = {}  # streaming rnnTimeStep
+        self._jit_pretrain_steps: Dict[str, Any] = {}
+        self._jit_pretrain_inputs: Dict[str, Any] = {}
+        self._pretrain_done = False
         self._base_key = jax.random.PRNGKey(conf.seed)
 
     @property
@@ -109,6 +112,7 @@ class ComputationGraph:
             for n in self.layer_vertex_names
         }
         self.updater_state = self.updater_def.init(self.params)
+        self._pretrain_done = False  # fresh params => pretrain again
         return self
 
     # ------------------------------------------------------------------
@@ -302,6 +306,7 @@ class ComputationGraph:
     def _can_scan_steps(self) -> bool:
         return (
             self.conf.iterations == 1
+            and self.conf.backprop_type != "TruncatedBPTT"
             and getattr(
                 self.conf, "optimization_algo",
                 "STOCHASTIC_GRADIENT_DESCENT",
@@ -452,9 +457,131 @@ class ComputationGraph:
             self.epoch_count += 1
         return True
 
+    def pretrain(self, data, epochs: int = 1) -> None:
+        """Greedy layer-wise unsupervised pretraining of every
+        pretrainable layer vertex (VAE/RBM/AutoEncoder), in topological
+        order, each on the activations the frozen graph feeds it
+        (reference ``ComputationGraph.pretrain``,
+        ``ComputationGraph.java:509``)."""
+        from deeplearning4j_tpu.nn.multilayer import _reg_penalty
+        from deeplearning4j_tpu.nn.updaters import MultiLayerUpdaterDef
+
+        if self.params is None:
+            self.init()
+        if hasattr(data, "features"):
+            data = [data]
+        elif not isinstance(data, (list, tuple)) and not hasattr(
+            data, "reset"
+        ):
+            data = list(data)
+        dtype = self._dtype()
+        for topo_idx, n in enumerate(self.topo):
+            v = self.conf.vertices.get(n)
+            if not isinstance(v, LayerVertex):
+                continue
+            layer = v.layer_conf
+            if not layer.is_pretrainable():
+                continue
+            upd_def = MultiLayerUpdaterDef({n: layer.updater_settings()})
+            upd_state = upd_def.init({n: self.params[n]})
+            if n not in self._jit_pretrain_steps:
+                def make_step(n=n, layer=layer, upd_def=upd_def):
+                    def step(lparams, upd_state, xin, lrs, t, rng):
+                        def loss_fn(p):
+                            return layer.pretrain_loss(
+                                p, xin, rng
+                            ) + _reg_penalty(layer, p)
+
+                        loss, grads = jax.value_and_grad(loss_fn)(lparams)
+                        new_p, new_upd = upd_def.update(
+                            {n: grads}, upd_state, {n: lparams}, lrs, t
+                        )
+                        return new_p[n], new_upd, loss
+
+                    return jax.jit(step, donate_argnums=(0, 1))
+
+                def make_input(n=n, v=v):
+                    from deeplearning4j_tpu.nn.conf.preprocessors import (
+                        ShapeContext,
+                    )
+
+                    def input_fn(params, state, inputs):
+                        values, _, _ = self._forward_values(
+                            params, state, inputs, train=False, rng=None
+                        )
+                        x = values[self.conf.vertex_inputs[n][0]]
+                        if v.preprocessor is not None:
+                            t = x.shape[2] if x.ndim == 3 else -1
+                            x = v.preprocessor.preprocess(
+                                x, ShapeContext(batch=x.shape[0], time=t)
+                            )
+                        return x
+
+                    return jax.jit(input_fn)
+
+                self._jit_pretrain_steps[n] = make_step()
+                self._jit_pretrain_inputs[n] = make_input()
+            step = self._jit_pretrain_steps[n]
+            jit_input = self._jit_pretrain_inputs[n]
+            it = 0
+            # the frozen lower graph never changes while vertex n
+            # trains: for materialized data, compute each batch's input
+            # activation once and reuse it across all epochs
+            xin_cache = (
+                [
+                    jit_input(self.params, self.state, [
+                        jnp.asarray(f, dtype)
+                        for f in _as_list(ds.features)
+                    ])
+                    for ds in data
+                ]
+                if isinstance(data, (list, tuple)) else None
+            )
+            for _ in range(epochs):
+                batches = (
+                    xin_cache if xin_cache is not None else (
+                        jit_input(self.params, self.state, [
+                            jnp.asarray(f, dtype)
+                            for f in _as_list(ds.features)
+                        ])
+                        for ds in data
+                    )
+                )
+                for xin in batches:
+                    for _ in range(self.conf.iterations):
+                        lrs = {
+                            k: jnp.asarray(val, jnp.float32)
+                            for k, val in upd_def.scheduled_lrs(it).items()
+                        }
+                        t = jnp.asarray(it + 1, jnp.float32)
+                        rng = jax.random.fold_in(
+                            jax.random.fold_in(
+                                self._base_key, 7919 + topo_idx
+                            ),
+                            it,
+                        )
+                        (
+                            self.params[n], upd_state, loss,
+                        ) = step(
+                            self.params[n], upd_state, xin, lrs, t, rng
+                        )
+                        self._last_score = loss
+                        it += 1
+                if hasattr(data, "reset"):
+                    data.reset()
+        self._pretrain_done = True
+
     def _fit_batches(self, iterator, epochs: int) -> None:
         if self.params is None:
             self.init()
+        if self.conf.pretrain and not self._pretrain_done:
+            if not hasattr(iterator, "reset") and not isinstance(
+                iterator, (list, tuple)
+            ):
+                iterator = list(iterator)
+            self.pretrain(iterator)
+        if not self.conf.backprop:
+            return
         if self._fit_epochs_device_cached(iterator, epochs):
             return
         for epoch in range(epochs):
@@ -509,6 +636,11 @@ class ComputationGraph:
         lmasks = [
             jnp.asarray(m, dtype) if m is not None else None for m in lmasks
         ] or None
+        fwd = self.conf.tbptt_fwd_length
+        if self.conf.backprop_type == "TruncatedBPTT" and any(
+            x.ndim == 3 and x.shape[2] > fwd for x in inputs
+        ):
+            return self._fit_tbptt(inputs, labels, lmasks, fmasks)
         score = None
         for _ in range(self.conf.iterations):
             lrs = self.updater_def.scheduled_lrs(self.iteration_count)
@@ -528,6 +660,76 @@ class ComputationGraph:
                 listener.iteration_done(self, self.iteration_count)
             self._reset_recurrent_state()
         return score  # 0-d device array; float() to sync
+
+    def _fit_tbptt(self, inputs, labels, lmasks, fmasks) -> float:
+        """Truncated BPTT for the DAG engine: slice every time-bearing
+        array into ``tbptt_fwd_length`` chunks and carry recurrent
+        state between chunks via the layer-state pytree (reference
+        ``ComputationGraph.doTruncatedBPTT``; MLN analog
+        ``MultiLayerNetwork.doTruncatedBPTT:1210``). Non-time inputs
+        ride along unchanged each chunk."""
+        fwd = self.conf.tbptt_fwd_length
+        t_lens = {x.shape[2] for x in inputs if x.ndim == 3}
+        for group in (labels, lmasks, fmasks):
+            for v in group or []:
+                if v is not None and v.ndim == 3:
+                    t_lens.add(v.shape[2])
+        if len(t_lens) > 1:
+            raise ValueError(
+                "TruncatedBPTT requires every time-series input/label "
+                f"to share one sequence length; got {sorted(t_lens)} "
+                "(chunking mixed lengths would re-feed the shorter "
+                "series each chunk with stale recurrent carry)"
+            )
+        t_total = t_lens.pop()
+
+        def cut3(vs, s, e):
+            if vs is None:
+                return None
+            return [
+                v[:, :, s:e]
+                if v is not None and v.ndim == 3 and v.shape[2] == t_total
+                else v
+                for v in vs
+            ]
+
+        def cut_mask(vs, s, e):
+            if vs is None:
+                return None
+            return [
+                m[:, s:e]
+                if m is not None and m.ndim == 2 and m.shape[1] == t_total
+                else m
+                for m in vs
+            ]
+
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
+        self._reset_recurrent_state()
+        score = None
+        for start in range(0, t_total, fwd):
+            end = min(start + fwd, t_total)
+            lrs = self.updater_def.scheduled_lrs(self.iteration_count)
+            t = jnp.asarray(self.iteration_count + 1, jnp.float32)
+            rng = jax.random.fold_in(
+                self._base_key, self.iteration_count
+            )
+            (
+                self.params, self.updater_state, self.state, score,
+            ) = self._jit_step(
+                self.params, self.updater_state, self.state,
+                cut3(inputs, start, end), cut3(labels, start, end),
+                cut_mask(lmasks, start, end),
+                cut_mask(fmasks, start, end),
+                {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
+                t, rng,
+            )
+            self.iteration_count += 1
+            self._last_score = score
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration_count)
+        self._reset_recurrent_state()
+        return score
 
     def _reset_recurrent_state(self) -> None:
         for n in self.layer_vertex_names:

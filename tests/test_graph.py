@@ -558,3 +558,93 @@ def test_graph_gradients_multi_output_weighted(rng):
     y1 = np.eye(2)[rng.randint(0, 2, 5)]
     y2 = rng.randn(5, 3)
     _check_graph_gradients(g, [x], [y1, y2], rng)
+
+
+def test_graph_tbptt_carries_state(rng):
+    """CG TruncatedBPTT: a long sequence splits into fwd-length chunks,
+    one optimizer step each, with recurrent state carried between
+    chunks (reference ComputationGraph.doTruncatedBPTT)."""
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+
+    def build(tbptt):
+        b = (
+            NeuralNetConfiguration.Builder().seed(11).learning_rate(0.05)
+            .updater("SGD")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=5,
+                                          activation="tanh"), "in")
+            .add_layer("out", RnnOutputLayer(n_in=5, n_out=2), "lstm")
+            .set_outputs("out")
+        )
+        if tbptt:
+            b.backprop_type("TruncatedBPTT")
+            b.t_bptt_forward_length(4)
+            b.t_bptt_backward_length(4)
+        return ComputationGraph(b.build()).init()
+
+    x = rng.rand(2, 3, 12).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[
+        rng.randint(0, 2, (2, 12))
+    ].transpose(0, 2, 1)
+    mds = MultiDataSet(features=[x], labels=[y])
+
+    g = build(tbptt=True)
+    s = g.fit_minibatch(mds)
+    assert np.isfinite(float(s))
+    assert g.iteration_count == 3  # 12 / 4 chunks, one step each
+
+    # TBPTT must differ from standard whole-sequence backprop
+    # (3 updates with carried state vs 1 update over the full graph)
+    g2 = build(tbptt=False)
+    g2.fit_minibatch(mds)
+    w_t = np.asarray(g.params["lstm"]["W"])
+    w_s = np.asarray(g2.params["lstm"]["W"])
+    assert not np.allclose(w_t, w_s)
+
+    # and training for a few batches reduces the loss
+    s0 = float(g.score(mds))
+    for _ in range(15):
+        g.fit_minibatch(mds)
+    assert float(g.score(mds)) < s0
+
+
+def test_graph_pretrain_autoencoder_vertex(rng):
+    """CG layer-wise pretraining: an AutoEncoder vertex trains on the
+    activations the frozen graph feeds it (reference
+    ComputationGraph.pretrain, ComputationGraph.java:509)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.layers import AutoEncoder
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(5).learning_rate(0.5)
+        .updater("SGD")
+        .graph_builder()
+        .pretrain(True).backprop(True)
+        .add_inputs("in")
+        .add_layer("ae", AutoEncoder(n_in=8, n_out=4,
+                                     corruption_level=0.0, loss="MSE",
+                                     activation="sigmoid"), "in")
+        .add_layer("out", OutputLayer(n_in=4, n_out=2), "ae")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    x = rng.rand(16, 8).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+    mds = MultiDataSet(features=[x], labels=[y])
+
+    w0 = np.asarray(g.params["ae"]["W"]).copy()
+    before = float(g.conf.vertices["ae"].layer_conf.pretrain_loss(
+        g.params["ae"], jnp.asarray(x), None
+    ))
+    g.pretrain([mds], epochs=150)
+    after = float(g.conf.vertices["ae"].layer_conf.pretrain_loss(
+        g.params["ae"], jnp.asarray(x), None
+    ))
+    assert after < before * 0.9, (before, after)
+    assert not np.allclose(w0, np.asarray(g.params["ae"]["W"]))
+    # supervised fit proceeds after pretraining (conf.pretrain wiring)
+    s = g.fit_minibatch(mds)
+    assert np.isfinite(float(s))
