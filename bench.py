@@ -152,14 +152,20 @@ def bench_vgg16(batch=128, chunk=4, epochs=6) -> float:
 # ---------------------------------------------------------------------------
 
 
-def bench_lstm_char_rnn(batch=32, seq=50, vocab=77, hidden=200,
-                        chunk=10, epochs=8) -> float:
+def bench_lstm_char_rnn(batch=32, seq=200, vocab=77, hidden=200,
+                        tbptt=50, chunk=10, epochs=8) -> float:
+    """Trains with REAL truncated BPTT (the mode BASELINE.md config #3
+    names): length-200 segments chunked at tbptt=50 with the recurrent
+    carry threading through a single fused scan per epoch (reset flags
+    zero the carry at minibatch boundaries), HBM-cached across
+    epochs."""
     from deeplearning4j_tpu.datasets.api import DataSet
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.zoo import graves_lstm_char_rnn
 
     net = MultiLayerNetwork(
-        graves_lstm_char_rnn(vocab=vocab, hidden=hidden)
+        graves_lstm_char_rnn(vocab=vocab, hidden=hidden,
+                             tbptt_length=tbptt)
     ).init()
     net.scan_chunk = chunk
     rng = np.random.RandomState(0)

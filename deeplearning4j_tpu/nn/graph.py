@@ -526,17 +526,24 @@ class ComputationGraph:
             it = 0
             # the frozen lower graph never changes while vertex n
             # trains: for materialized data, compute each batch's input
-            # activation once and reuse it across all epochs
-            xin_cache = (
-                [
-                    jit_input(self.params, self.state, [
+            # activation once and reuse it across all epochs — bounded
+            # by device_cache_bytes like every other caching path
+            xin_cache = None
+            if isinstance(data, (list, tuple)):
+                from deeplearning4j_tpu.nn.multilayer import _nbytes
+
+                xin_cache = []
+                cached_bytes = 0
+                for ds in data:
+                    xin = jit_input(self.params, self.state, [
                         jnp.asarray(f, dtype)
                         for f in _as_list(ds.features)
                     ])
-                    for ds in data
-                ]
-                if isinstance(data, (list, tuple)) else None
-            )
+                    cached_bytes += _nbytes(xin)
+                    if cached_bytes > self.device_cache_bytes:
+                        xin_cache = None  # too big: recompute per epoch
+                        break
+                    xin_cache.append(xin)
             for _ in range(epochs):
                 batches = (
                     xin_cache if xin_cache is not None else (

@@ -172,6 +172,16 @@ class GravesLSTM(LayerSpec):
         z = jnp.zeros((batch, self.n_out), dtype)
         return z, z
 
+    def init_stream_state(self, batch: int, dtype) -> dict:
+        """Zero h/c carry as a state pytree — what ``apply`` returns
+        between streaming/TBPTT chunks. Distinct buffers: jitted steps
+        donate the state, and one array donated twice is an XLA
+        error."""
+        return {
+            "h": jnp.zeros((batch, self.n_out), dtype),
+            "c": jnp.zeros((batch, self.n_out), dtype),
+        }
+
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
         if "h" in state:

@@ -178,6 +178,7 @@ class MultiLayerNetwork:
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep state
         self._jit_step = None
         self._jit_multi_step = None
+        self._jit_tbptt_multi_step = None
         self._solver = None  # lazily built for LBFGS/CG/line-search
         self.scan_chunk = 16  # minibatches fused per dispatch
         # multi-epoch fits keep the dataset HBM-resident up to this
@@ -419,6 +420,160 @@ class MultiLayerNetwork:
 
         return jax.jit(multi_step, donate_argnums=(0, 1, 2))
 
+    def _build_tbptt_multi_step(self) -> Callable:
+        """TBPTT chunks fused into ONE XLA dispatch: like
+        ``_build_multi_step`` but the recurrent carry THREADS through
+        the ``lax.scan`` (the reference's host-side chunk loop,
+        ``doTruncatedBPTT:1210``, pays a dispatch per chunk). The
+        caller primes the recurrent state with zero h/c so the scan
+        carry has a fixed pytree structure; ``resets`` (one 0/1 flag
+        per step) zero the carry at minibatch boundaries so MANY
+        minibatches' chunk stacks ride in a single dispatch."""
+        updater = self.updater_def
+        multi_dtype = _dtype_of(self.conf)
+        recurrent_names = [
+            name for name, layer in zip(self.layer_names, self.conf.layers)
+            if layer.is_recurrent()
+        ]
+
+        def body(carry, per_step):
+            params, upd_state, state = carry
+            x, labels, mask, fmask, lrs, t, rng, reset = per_step
+            x = x.astype(multi_dtype)
+            labels = labels.astype(multi_dtype)
+            mask = None if mask is None else mask.astype(multi_dtype)
+            fmask = (
+                None if fmask is None else fmask.astype(multi_dtype)
+            )
+            state = dict(state)
+            keep = 1.0 - reset
+            for name in recurrent_names:
+                # reset==1 at a new minibatch's first chunk; v*0 is
+                # bitwise the zeros the primed initial state holds
+                state[name] = {
+                    k2: v * keep.astype(v.dtype)
+                    for k2, v in state[name].items()
+                }
+
+            def loss_fn(p):
+                s, new_state = self._score_pure(
+                    p, state, x, labels, mask, rng, train=True,
+                    fmask=fmask,
+                )
+                return s, new_state
+
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            new_params, new_upd = updater.update(
+                grads, upd_state, params, lrs, t
+            )
+            return (new_params, new_upd, new_state), score
+
+        def multi_step(params, upd_state, state, xs, ys, masks, fmasks,
+                       lr_stack, it0, base_key, resets):
+            k = xs.shape[0]
+            ts = (it0 + 1 + jnp.arange(k)).astype(jnp.float32)
+            rngs = jax.vmap(
+                lambda i: jax.random.fold_in(base_key, i)
+            )(it0 + jnp.arange(k))
+            (params, upd_state, state), scores = jax.lax.scan(
+                body, (params, upd_state, state),
+                (xs, ys, masks, fmasks, lr_stack, ts, rngs, resets),
+            )
+            return params, upd_state, state, scores
+
+        return jax.jit(multi_step, donate_argnums=(0, 1, 2))
+
+    def _can_fuse_tbptt(self, x, y, fwd: int) -> bool:
+        """The fused single-dispatch TBPTT applies when chunks tile the
+        sequence exactly, labels are per-timestep, every recurrent
+        layer exposes an h/c streaming carry, and listeners accept
+        batched iteration callbacks."""
+        return (
+            self.conf.iterations == 1
+            and x.ndim == 3
+            and x.shape[2] % fwd == 0
+            and y.ndim == 3
+            and y.shape[2] == x.shape[2]
+            and all(
+                layer.can_stream()
+                and getattr(layer, "init_stream_state", None) is not None
+                for layer in self.conf.layers
+                if layer.is_recurrent()
+            )
+            and all(
+                getattr(l, "supports_batched_iterations", False)
+                for l in self.listeners
+            )
+        )
+
+    def _stack_tbptt(self, x, y, mask, fmask):
+        """Split one minibatch's device arrays into stacked TBPTT
+        chunks for the fused scan: [b, n, k*fwd] -> [k, b, n, fwd]."""
+        fwd = self.conf.tbptt_fwd_length
+        b = x.shape[0]
+        k = x.shape[2] // fwd
+
+        def chunk3(v):
+            return jnp.moveaxis(
+                v.reshape(v.shape[0], v.shape[1], k, fwd), 2, 0
+            )
+
+        def chunk2(m):
+            return (
+                None if m is None
+                else jnp.moveaxis(m.reshape(b, k, fwd), 1, 0)
+            )
+
+        resets = jnp.zeros(k, jnp.float32).at[0].set(1.0)
+        return (
+            chunk3(x), chunk3(y), chunk2(mask), chunk2(fmask), resets,
+            k, b,
+        )
+
+    def _fit_tbptt_fused(self, x, y, mask, fmask) -> float:
+        return self._run_tbptt_stacked(
+            self._stack_tbptt(x, y, mask, fmask)
+        )
+
+    def _run_tbptt_stacked(self, stacked) -> float:
+        xs, ys, masks, fmasks, resets, k, b = stacked
+        cdt = _compute_dtype_of(self.conf)
+        state = dict(self.state)
+        for name, layer in zip(self.layer_names, self.conf.layers):
+            if layer.is_recurrent():
+                state[name] = layer.init_stream_state(b, cdt)
+        it0 = self.iteration_count
+        lr_rows = [
+            self.updater_def.scheduled_lrs(it0 + i) for i in range(k)
+        ]
+        lr_stack = {
+            ln: jnp.asarray([row[ln] for row in lr_rows], jnp.float32)
+            for ln in self.updater_def.settings
+        }
+        if self._jit_tbptt_multi_step is None:
+            self._jit_tbptt_multi_step = self._build_tbptt_multi_step()
+        (
+            self.params, self.updater_state, new_state, scores,
+        ) = self._jit_tbptt_multi_step(
+            self.params, self.updater_state, state,
+            xs, ys, masks, fmasks,
+            lr_stack, jnp.asarray(it0, jnp.int32), self._base_key,
+            resets,
+        )
+        self.state = new_state
+        self.iteration_count += k
+        self._last_score = scores[-1]
+        if self.listeners:
+            for i in range(k):
+                self._last_score = scores[i]
+                for listener in self.listeners:
+                    listener.iteration_done(self, it0 + i + 1)
+            self._last_score = scores[-1]
+        self._reset_recurrent_state()
+        return self._last_score
+
     def _can_scan_steps(self) -> bool:
         """Scan-fused fitting applies when per-minibatch semantics are
         stateless: standard backprop (recurrent carry resets each
@@ -614,17 +769,20 @@ class MultiLayerNetwork:
         schedules/iteration counts are recomputed per chunk per epoch,
         so training semantics are identical to the streaming path.
         Returns False (caller streams as before) for single epochs,
-        iterator input, TBPTT/solver paths, or datasets larger than
+        iterator input, solver paths, TBPTT configs the fused scan
+        can't express, or datasets larger than
         ``self.device_cache_bytes``.
         """
-        plan = _cached_epoch_plan(
-            self, iterator, epochs,
-            lambda ds: (
-                ds.features, ds.labels,
-                getattr(ds, "labels_mask", None),
-                getattr(ds, "features_mask", None),
-            ),
-        )
+        plan = self._tbptt_cached_plan(iterator, epochs)
+        if plan is None:
+            plan = _cached_epoch_plan(
+                self, iterator, epochs,
+                lambda ds: (
+                    ds.features, ds.labels,
+                    getattr(ds, "labels_mask", None),
+                    getattr(ds, "features_mask", None),
+                ),
+            )
         if plan is None:
             return False
         for epoch in range(epochs):
@@ -637,6 +795,10 @@ class MultiLayerNetwork:
                     if self._wants_last_features():
                         self._last_features = last.features
                     self._run_scan_chunk(item)
+                elif kind == "tbptt":
+                    if self._wants_last_features():
+                        self._last_features = last.features
+                    self._run_tbptt_stacked(item)
                 else:
                     self.fit_minibatch(item)
             for listener in self.listeners:
@@ -644,6 +806,81 @@ class MultiLayerNetwork:
                     listener.on_epoch_end(self)
             self.epoch_count += 1
         return True
+
+    def _tbptt_cached_plan(self, iterator, epochs: int):
+        """HBM-resident multi-epoch plan for fused-TBPTT configs: each
+        minibatch's chunk stack transfers once and replays every epoch
+        through the single-dispatch TBPTT scan. Returns None (caller
+        tries the standard plan / streams) when the config or data is
+        ineligible."""
+        if (
+            epochs <= 1
+            or not isinstance(iterator, (list, tuple))
+            or len(iterator) == 0
+            or not all(hasattr(ds, "features") for ds in iterator)
+            or self.conf.backprop_type != "TruncatedBPTT"
+            or self.conf.iterations != 1
+            or self.conf.optimization_algo
+            != "STOCHASTIC_GRADIENT_DESCENT"
+            or not all(
+                getattr(l, "supports_batched_iterations", False)
+                for l in self.listeners
+            )
+        ):
+            return None
+        fwd = self.conf.tbptt_fwd_length
+        total = 0
+        for ds in iterator:
+            x = np.asarray(ds.features)
+            y = np.asarray(ds.labels)
+            if x.ndim != 3 or x.shape[2] <= fwd or not (
+                self._can_fuse_tbptt(x, y, fwd)
+            ):
+                return None
+            for a in (
+                ds.features, ds.labels,
+                getattr(ds, "labels_mask", None),
+                getattr(ds, "features_mask", None),
+            ):
+                if a is not None:
+                    total += _nbytes(a)
+        if total > self.device_cache_bytes:
+            return None
+        dtype = _dtype_of(self.conf)
+        stacks = []
+        for ds in iterator:
+            x = _to_device(ds.features, dtype)
+            y = _to_device(ds.labels, dtype)
+            mask = getattr(ds, "labels_mask", None)
+            fmask = getattr(ds, "features_mask", None)
+            mask = None if mask is None else jnp.asarray(mask, dtype)
+            fmask = None if fmask is None else jnp.asarray(fmask, dtype)
+            stacks.append((self._stack_tbptt(x, y, mask, fmask), ds))
+        # fuse consecutive same-shape minibatches into ONE dispatch:
+        # reset flags zero the recurrent carry at each batch boundary,
+        # so the whole epoch can be a single scan. Reuses the shared
+        # grouping policy over (stack, ds) items.
+        def merge(items):
+            parts = [st for st, _ in items]
+            return tuple(
+                jnp.concatenate([p[i] for p in parts])
+                if parts[0][i] is not None else None
+                for i in range(5)
+            ) + (sum(p[5] for p in parts), parts[0][6])
+
+        grouped = _build_scan_plan(
+            stacks,
+            sig_fn=lambda item: tuple(
+                None if a is None else a.shape for a in item[0][:4]
+            ),
+            stack_fn=merge,
+            scan_chunk=self.scan_chunk,
+        )
+        return [
+            ("tbptt", item[0], item[1]) if kind == "single"
+            else ("tbptt", item, last[1])
+            for kind, item, last in grouped
+        ]
 
     def fit_minibatch(self, ds) -> float:
         """One minibatch through ``conf.iterations`` optimizer steps
@@ -734,6 +971,8 @@ class MultiLayerNetwork:
         ``doTruncatedBPTT:1210``, state carry ``:1259-1276``). The
         carry rides the layer-state pytree through the jitted step."""
         fwd = self.conf.tbptt_fwd_length
+        if self._can_fuse_tbptt(x, y, fwd):
+            return self._fit_tbptt_fused(x, y, mask, fmask)
         t_total = x.shape[2]
         self._reset_recurrent_state()
         score = 0.0

@@ -143,3 +143,88 @@ def test_rnn_json_round_trip():
     net = rnn_net(bidirectional=True)
     back = MultiLayerConfiguration.from_json(net.conf.to_json())
     assert back == net.conf
+
+
+def test_tbptt_fused_matches_chunk_loop(rng):
+    """The single-dispatch fused TBPTT (all chunks in one lax.scan with
+    the recurrent carry threading through) must be bitwise identical to
+    the host-side chunk loop — same per-chunk seeds, lrs, and state
+    carry."""
+    x, y = seq_data(rng, t=15)  # 15 / fwd 5 = 3 exact chunks
+    ds = DataSet(features=x.astype(np.float32),
+                 labels=y.astype(np.float32))
+
+    fused = rnn_net(tbptt=5)
+    assert fused._can_fuse_tbptt(
+        np.asarray(ds.features), np.asarray(ds.labels), 5
+    )
+    for _ in range(4):
+        fused.fit(ds)
+
+    loop = rnn_net(tbptt=5)
+    loop._can_fuse_tbptt = lambda *a: False  # force the chunk loop
+    for _ in range(4):
+        loop.fit(ds)
+
+    assert fused.iteration_count == loop.iteration_count == 12
+    for ln in fused.params:
+        for pn in fused.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(fused.params[ln][pn]),
+                np.asarray(loop.params[ln][pn]),
+            )
+
+
+def test_tbptt_fused_with_masks(rng):
+    """Fused TBPTT slices [b, t] masks into per-chunk blocks; a fully
+    masked tail must not contribute to the loss (parity with the
+    mask-aware chunk loop)."""
+    x, y = seq_data(rng, t=10)
+    mask = np.ones((4, 10), np.float32)
+    mask[:, 7:] = 0.0
+    ds = DataSet(features=x.astype(np.float32),
+                 labels=y.astype(np.float32),
+                 features_mask=mask, labels_mask=mask)
+
+    fused = rnn_net(tbptt=5)
+    loop = rnn_net(tbptt=5)
+    loop._can_fuse_tbptt = lambda *a: False
+    for _ in range(3):
+        fused.fit(ds)
+        loop.fit(ds)
+    for ln in fused.params:
+        for pn in fused.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(fused.params[ln][pn]),
+                np.asarray(loop.params[ln][pn]),
+            )
+
+
+def test_tbptt_device_cached_epochs_match_streaming(rng):
+    """Multi-epoch TBPTT fit over a list: all batches' chunk stacks
+    merge into one dispatch per epoch (reset flags zero the carry at
+    batch boundaries) and must match one-epoch-at-a-time fitting
+    bitwise."""
+    def batches():
+        out = []
+        r = np.random.RandomState(7)
+        for _ in range(3):
+            x = r.randn(4, 3, 10).astype(np.float32)
+            y = np.zeros((4, 2, 10), np.float32)
+            y[:, 0, :] = 1.0
+            out.append(DataSet(features=x, labels=y))
+        return out
+
+    data = batches()
+    a = rnn_net(tbptt=5)
+    for _ in range(3):
+        a.fit(data, epochs=1)
+    b = rnn_net(tbptt=5)
+    b.fit(data, epochs=3)  # cached+merged path
+    assert a.iteration_count == b.iteration_count == 18  # 3*3ep*2chunks
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(a.params[ln][pn]),
+                np.asarray(b.params[ln][pn]),
+            )
