@@ -1241,7 +1241,10 @@ class MultiLayerNetwork:
 
         e = Evaluation()
         for ds in iterator:
-            out = self.output(ds.features)
+            out = self.output(
+                ds.features,
+                features_mask=getattr(ds, "features_mask", None),
+            )
             m = getattr(ds, "labels_mask", None)
             if m is None:
                 m = getattr(ds, "features_mask", None)

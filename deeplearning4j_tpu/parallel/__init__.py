@@ -10,6 +10,7 @@ from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     replicated,
 )
 from deeplearning4j_tpu.parallel.cluster import (  # noqa: F401
+    ClusterComputationGraph,
     ClusterDl4jMultiLayer,
     ParameterAveragingTrainingMaster,
     PathDataSetIterator,

@@ -207,6 +207,32 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             for i in range(0, n, b)
         ]
 
+    def _batches_of_multi(self, mds):
+        """Slice one big MultiDataSet into worker minibatches, every
+        input/label/mask slot included."""
+        from deeplearning4j_tpu.datasets.api import MultiDataSet
+
+        b = self.batch_size_per_worker
+        n = mds.num_examples()
+
+        def cuts(group, i):
+            if group is None:
+                return None
+            return [
+                None if a is None else np.asarray(a)[i:i + b]
+                for a in group
+            ]
+
+        return [
+            MultiDataSet(
+                features=cuts(mds.features, i),
+                labels=cuts(mds.labels, i),
+                features_masks=cuts(mds.features_masks, i),
+                labels_masks=cuts(mds.labels_masks, i),
+            )
+            for i in range(0, n, b)
+        ]
+
     # -- TrainingMaster --------------------------------------------------
 
     def execute_training(self, net, data) -> None:
@@ -248,9 +274,13 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             _Timer(self.stats.split_times_ms) if self.stats
             else _nulltimer
         )
+        from deeplearning4j_tpu.datasets.api import MultiDataSet
+
         with timer:
             if isinstance(data, DataSet):
                 return self._batches_of(data)
+            if isinstance(data, MultiDataSet):
+                return self._batches_of_multi(data)
             return list(iter(data))
 
     def get_training_stats(self):
@@ -292,12 +322,13 @@ class _ListIterator(DataSetIterator):
 # ---------------------------------------------------------------------------
 
 
-class ClusterDl4jMultiLayer:
-    """Driver-side facade (reference ``SparkDl4jMultiLayer.java:77``):
-    couples a network with a TrainingMaster; fit over in-memory data
+class _ClusterModelFacade:
+    """Shared driver-side facade plumbing: fit over in-memory data
     (``fit(JavaRDD)`` analog), fit over exported batch files
-    (``fitPaths:265``), distributed evaluation
-    (``EvaluateFlatMapFunction`` + reduce)."""
+    (``fitPaths``), sharded evaluation (per-shard delegation to the
+    engine's own ``evaluate`` + ``Evaluation.merge`` — reference
+    ``EvaluateFlatMapFunction.java:41`` + ``EvaluationReduceFunction``),
+    scoring."""
 
     def __init__(self, net, training_master: TrainingMaster):
         self.net = net
@@ -308,43 +339,43 @@ class ClusterDl4jMultiLayer:
 
     def fit_paths(self, paths: Iterable[str]) -> None:
         """Train from exported minibatch files (reference export-based
-        path ``fitPaths``)."""
+        path ``fitPaths:265``)."""
         self.training_master.execute_training(
             self.net, PathDataSetIterator(list(paths))
         )
 
     def evaluate(self, data, num_shards: Optional[int] = None):
-        """Sharded evaluation merged to one Evaluation (reference
-        ``EvaluateFlatMapFunction.java:41`` per-partition eval +
-        ``EvaluationReduceFunction`` merge)."""
         from deeplearning4j_tpu.eval import Evaluation
 
         batches = (
             data if isinstance(data, list) else list(iter(data))
         )
         n = num_shards or getattr(self.training_master, "workers", 1)
-        shards: List[List[DataSet]] = [[] for _ in range(max(n, 1))]
+        shards: List[list] = [[] for _ in range(max(n, 1))]
         for i, b in enumerate(batches):
             shards[i % len(shards)].append(b)
         merged: Optional[Evaluation] = None
         for shard in shards:
             if not shard:
                 continue
-            e = Evaluation()
-            for ds in shard:
-                out = self.net.output(
-                    ds.features, features_mask=ds.features_mask
-                )
-                mask = (
-                    np.asarray(ds.labels_mask)
-                    if ds.labels_mask is not None else None
-                )
-                e.eval(np.asarray(ds.labels), np.asarray(out), mask=mask)
+            e = self.net.evaluate(iter(shard))
             merged = e if merged is None else merged.merge(e)
         return merged if merged is not None else Evaluation()
 
-    def get_score(self, ds: DataSet) -> float:
+    def get_score(self, ds) -> float:
         return float(self.net.score(ds))
+
+
+class ClusterDl4jMultiLayer(_ClusterModelFacade):
+    """MultiLayerNetwork + TrainingMaster (reference
+    ``SparkDl4jMultiLayer.java:77``)."""
+
+
+class ClusterComputationGraph(_ClusterModelFacade):
+    """ComputationGraph + TrainingMaster (reference
+    ``SparkComputationGraph.java:156-182``). Data is DataSets or
+    MultiDataSets — the replica step maps over the input/label list
+    pytree."""
 
 
 # ---------------------------------------------------------------------------

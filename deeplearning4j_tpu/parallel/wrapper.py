@@ -88,11 +88,15 @@ class ParallelWrapper:
     def _build_replica_step(self):
         m = self.model
         updater = m.updater_def
+        # MLN and CG share positional (params, state, x, y, mask, rng)
+        # in _score_pure; only the features-mask keyword differs
+        fmask_kw = "fmasks" if self._is_graph() else "fmask"
 
-        def one(params, upd_state, state, x, y, lrs, t, rng):
+        def one(params, upd_state, state, x, y, lm, fm, lrs, t, rng):
             def loss_fn(p):
                 s, new_state = m._score_pure(
-                    p, state, x, y, None, rng, train=True
+                    p, state, x, y, lm, rng, train=True,
+                    **{fmask_kw: fm},
                 )
                 return s, new_state
 
@@ -105,10 +109,13 @@ class ParallelWrapper:
             return new_params, new_upd, new_state, score
 
         vstep = jax.vmap(
-            one, in_axes=(0, 0, 0, 0, 0, None, None, 0),
+            one, in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, 0),
             out_axes=(0, 0, 0, 0),
         )
         return jax.jit(vstep, donate_argnums=(0, 1, 2))
+
+    def _is_graph(self) -> bool:
+        return not hasattr(self.model, "layer_names")
 
     def _build_average(self):
         def avg(replica_tree):
@@ -156,10 +163,56 @@ class ParallelWrapper:
             m.epoch_count += 1
         self._sync_model()
 
+    def _stack_batches(self, batches, get, dtype):
+        """Stack one field replica-wise. For a ComputationGraph model
+        every field is a LIST of per-slot arrays (bare DataSet arrays
+        are wrapped), and each slot stacks separately — the vmapped
+        step maps over the list pytree. ``None`` fields/slots stay
+        None."""
+        graph = self._is_graph()
+
+        def field(b):
+            v = get(b)
+            if graph and v is not None and not isinstance(
+                v, (list, tuple)
+            ):
+                return [v]
+            return v
+
+        first = field(batches[0])
+        if first is None:
+            return None
+        if isinstance(first, (list, tuple)):
+            return [
+                None if first[i] is None else jnp.stack([
+                    jnp.asarray(field(b)[i], dtype) for b in batches
+                ])
+                for i in range(len(first))
+            ]
+        return jnp.stack([jnp.asarray(field(b), dtype) for b in batches])
+
+    @staticmethod
+    def _mask_of(b, *names):
+        for n in names:
+            v = getattr(b, n, None)
+            if v is not None:
+                return v
+        return None
+
     def _round(self, batches, dtype) -> None:
         m = self.model
-        x = jnp.stack([jnp.asarray(b.features, dtype) for b in batches])
-        y = jnp.stack([jnp.asarray(b.labels, dtype) for b in batches])
+        x = self._stack_batches(batches, lambda b: b.features, dtype)
+        y = self._stack_batches(batches, lambda b: b.labels, dtype)
+        lm = self._stack_batches(
+            batches,
+            lambda b: self._mask_of(b, "labels_masks", "labels_mask"),
+            dtype,
+        )
+        fm = self._stack_batches(
+            batches,
+            lambda b: self._mask_of(b, "features_masks", "features_mask"),
+            dtype,
+        )
         lrs = m.updater_def.scheduled_lrs(m.iteration_count)
         t = jnp.asarray(m.iteration_count + 1, jnp.float32)
         rngs = jax.vmap(
@@ -172,7 +225,7 @@ class ParallelWrapper:
             scores,
         ) = self._jit_replica_step(
             self._replica_params, self._replica_upd, self._replica_state,
-            x, y,
+            x, y, lm, fm,
             {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
             t, rngs,
         )

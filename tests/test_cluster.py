@@ -162,3 +162,143 @@ class TestDistributedEval:
         plain.eval(y, np.asarray(net.output(x)))
         assert merged.accuracy() == pytest.approx(plain.accuracy())
         assert merged.f1() == pytest.approx(plain.f1())
+
+
+class TestClusterComputationGraph:
+    """Reference SparkComputationGraph analog: the DAG engine under the
+    cluster TrainingMaster."""
+
+    def _graph(self, seed=3, lr=0.3):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(seed).learning_rate(lr)
+            .updater("SGD")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8,
+                                       activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3), "d")
+            .set_outputs("out")
+            .build()
+        )
+        return ComputationGraph(conf).init()
+
+    def _data(self, n=64):
+        from deeplearning4j_tpu.datasets.api import MultiDataSet
+
+        r = np.random.RandomState(0)
+        centers = r.randn(3, 4) * 2
+        li = r.randint(0, 3, n)
+        x = (centers[li] + r.randn(n, 4) * 0.3).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[li]
+        batches = [
+            MultiDataSet(features=[x[i:i + 16]], labels=[y[i:i + 16]])
+            for i in range(0, n, 16)
+        ]
+        return x, y, batches
+
+    def test_matches_single_machine_avg_freq_1(self):
+        """4 workers, averaging every step, SGD == single machine on
+        the concatenated batch (the reference equivalence bar applied
+        to the CG engine)."""
+        from deeplearning4j_tpu.datasets.api import MultiDataSet
+        from deeplearning4j_tpu.parallel import (
+            ClusterComputationGraph,
+            ParameterAveragingTrainingMaster,
+        )
+
+        x, y, batches = self._data()
+        single = self._graph()
+        big = MultiDataSet(features=[x], labels=[y])
+        for _ in range(6):
+            single.fit_minibatch(big)
+
+        clustered = self._graph()
+        master = ParameterAveragingTrainingMaster(
+            workers=4, batch_size_per_worker=16, averaging_frequency=1,
+        )
+        cg = ClusterComputationGraph(clustered, master)
+        for _ in range(6):
+            cg.fit(batches)
+        np.testing.assert_allclose(
+            np.asarray(single.params_flat()),
+            np.asarray(clustered.params_flat()),
+            rtol=2e-4, atol=1e-6,
+        )
+
+    def test_sharded_eval_and_score(self):
+        from deeplearning4j_tpu.parallel import (
+            ClusterComputationGraph,
+            ParameterAveragingTrainingMaster,
+        )
+
+        x, y, batches = self._data()
+        g = self._graph()
+        cg = ClusterComputationGraph(
+            g, ParameterAveragingTrainingMaster(
+                workers=4, batch_size_per_worker=16,
+                averaging_frequency=1,
+            )
+        )
+        cg.fit(batches)
+        ev = cg.evaluate(batches)
+        plain = g.evaluate(iter(batches))
+        assert abs(ev.accuracy() - plain.accuracy()) < 1e-9
+        assert np.isfinite(cg.get_score(batches[0]))
+
+
+def test_cluster_masked_rnn_matches_single_machine():
+    """Masked variable-length RNN under the cluster master: replica
+    steps must thread labels/features masks (averaging equivalence with
+    the mask-aware single-machine step)."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import (
+        ClusterDl4jMultiLayer,
+        ParameterAveragingTrainingMaster,
+    )
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.Builder().seed(2).learning_rate(0.2)
+            .updater("SGD")
+            .list()
+            .layer(GravesLSTM(n_in=3, n_out=5, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    r = np.random.RandomState(4)
+    x = r.randn(8, 3, 6).astype(np.float32)
+    y = np.zeros((8, 2, 6), np.float32)
+    y[:, 0, :] = 1.0
+    mask = np.ones((8, 6), np.float32)
+    mask[:, 4:] = 0.0  # padded tail must not train
+
+    single = build()
+    for _ in range(4):
+        single.fit_minibatch(DataSet(
+            features=x, labels=y, features_mask=mask, labels_mask=mask,
+        ))
+
+    clustered = build()
+    master = ParameterAveragingTrainingMaster(
+        workers=2, batch_size_per_worker=4, averaging_frequency=1,
+    )
+    cl = ClusterDl4jMultiLayer(clustered, master)
+    big = DataSet(features=x, labels=y, features_mask=mask,
+                  labels_mask=mask)
+    for _ in range(4):
+        cl.fit(big)
+    np.testing.assert_allclose(
+        np.asarray(single.params_flat()),
+        np.asarray(clustered.params_flat()),
+        rtol=2e-4, atol=1e-6,
+    )
