@@ -746,21 +746,30 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
 
-    def output(self, *inputs) -> List[jax.Array]:
+    def output(self, *inputs, features_masks=None) -> List[jax.Array]:
         """Activated values of the output vertices (reference
-        ``ComputationGraph.output``)."""
+        ``ComputationGraph.output``). ``features_masks``: per-graph-
+        input [b, t] masks threaded to recurrent branches (reference
+        ``output(..., featureMaskArrays)``)."""
         if self.params is None:
             self.init()
         if self._jit_output is None:
-            def out_fn(params, state, inputs):
+            def out_fn(params, state, inputs, fmasks):
                 values, _, _ = self._forward_values(
-                    params, state, inputs, train=False, rng=None
+                    params, state, inputs, train=False, rng=None,
+                    fmasks=fmasks,
                 )
                 return [values[n] for n in self.conf.outputs]
             self._jit_output = jax.jit(out_fn)
         dtype = self._dtype()
         arr = [jnp.asarray(x, dtype) for x in inputs]
-        return self._jit_output(self.params, self.state, arr)
+        fm = None
+        if features_masks is not None:
+            fm = [
+                None if m is None else jnp.asarray(m, dtype)
+                for m in _as_list(features_masks)
+            ]
+        return self._jit_output(self.params, self.state, arr, fm)
 
     def feed_forward(self, *inputs, train: bool = False) -> Dict[str, Any]:
         """Activations of EVERY vertex by name (reference
@@ -855,7 +864,11 @@ class ComputationGraph:
 
         e = Evaluation()
         for ds in iterator:
-            out = self.output(*_as_list(ds.features))[0]
+            fm = (getattr(ds, "features_masks", None)
+                  or getattr(ds, "features_mask", None))
+            out = self.output(
+                *_as_list(ds.features), features_masks=fm
+            )[0]
             labels = _as_list(ds.labels)[0]
             m = _as_list(getattr(ds, "labels_masks", None)
                          or getattr(ds, "labels_mask", None))

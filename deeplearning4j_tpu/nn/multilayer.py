@@ -1245,10 +1245,14 @@ class MultiLayerNetwork:
                 ds.features,
                 features_mask=getattr(ds, "features_mask", None),
             )
+            labels = np.asarray(ds.labels)
             m = getattr(ds, "labels_mask", None)
-            if m is None:
+            if m is None and labels.ndim == 3:
+                # per-timestep eval falls back to the features mask;
+                # 2-d (per-sequence) labels must NOT — a [b, t] mask
+                # cannot index b rows
                 m = getattr(ds, "features_mask", None)
-            e.eval(np.asarray(ds.labels), np.asarray(out),
+            e.eval(labels, np.asarray(out),
                    mask=np.asarray(m) if m is not None else None)
         if hasattr(iterator, "reset"):
             iterator.reset()

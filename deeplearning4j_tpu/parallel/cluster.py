@@ -255,15 +255,27 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             else _nulltimer
         )
         # replicas step as one stacked vmap, so every batch in a round
-        # must share a shape: group batches by size (iterator input can
-        # carry several distinct off-sizes, not just one tail) and fit
-        # once per uniform-size group, full-size group first.
+        # must share a shape AND mask presence: group by (size, which
+        # masks exist) — iterator input can carry several distinct
+        # off-sizes plus masked/unmasked mixes — and fit once per
+        # uniform group, full-size groups first.
+        def mask_sig(b):
+            return (
+                getattr(b, "features_masks", None) is not None
+                or getattr(b, "features_mask", None) is not None,
+                getattr(b, "labels_masks", None) is not None
+                or getattr(b, "labels_mask", None) is not None,
+            )
+
         by_size: dict = {}
         for b in batches:
-            by_size.setdefault(b.num_examples(), []).append(b)
+            by_size.setdefault(
+                (b.num_examples(), mask_sig(b)), []
+            ).append(b)
         ordered = sorted(
             by_size.items(),
-            key=lambda kv: (kv[0] != self.batch_size_per_worker, kv[0]),
+            key=lambda kv: (kv[0][0] != self.batch_size_per_worker,
+                            kv[0]),
         )
         with timer:
             for _, group in ordered:

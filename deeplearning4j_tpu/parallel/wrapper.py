@@ -181,6 +181,13 @@ class ParallelWrapper:
 
         first = field(batches[0])
         if first is None:
+            if any(field(b) is not None for b in batches[1:]):
+                raise ValueError(
+                    "replicas in one averaging round mix masked and "
+                    "unmasked batches; group them (an absent mask "
+                    "means all timesteps count — pass explicit ones "
+                    "to mix)"
+                )
             return None
         if isinstance(first, (list, tuple)):
             return [

@@ -1,0 +1,150 @@
+"""XLA layer implementations vs pure-numpy references (the analog of
+the reference's backend-vs-backend consistency tests —
+`deeplearning4j-cuda/src/test/.../convolution/TestConvolution.java`
+compares the cuDNN helper path against the builtin im2col path; here
+the XLA path is checked against direct-loop numpy implementations)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    GravesLSTM,
+    LocalResponseNormalization,
+    SubsamplingLayer,
+)
+
+
+def _np_conv2d(x, w, b, stride, pad):
+    """Direct-loop NCHW cross-correlation."""
+    bs, cin, h, wid = x.shape
+    cout, _, kh, kw = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (wid + 2 * pw - kw) // sw + 1
+    out = np.zeros((bs, cout, oh, ow), np.float64)
+    for n in range(bs):
+        for co in range(cout):
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[n, :, i * sh:i * sh + kh,
+                               j * sw:j * sw + kw]
+                    out[n, co, i, j] = np.sum(patch * w[co]) + b[co]
+    return out
+
+
+@pytest.mark.parametrize("stride,pad", [((1, 1), (0, 0)), ((2, 2), (1, 1))])
+def test_convolution_matches_numpy(rng, stride, pad):
+    layer = ConvolutionLayer(n_in=3, n_out=4, kernel_size=(3, 3),
+                             stride=stride, padding=pad,
+                             activation="identity")
+    import jax
+
+    params = layer.init_params(jax.random.PRNGKey(0))
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    got, _ = layer.apply(params, x, {})
+    want = _np_conv2d(
+        x.astype(np.float64), np.asarray(params["W"], np.float64),
+        np.asarray(params["b"], np.float64), stride, pad,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("ptype", ["MAX", "AVG", "SUM"])
+def test_pooling_matches_numpy(rng, ptype):
+    layer = SubsamplingLayer(pooling_type=ptype, kernel_size=(2, 2),
+                             stride=(2, 2))
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    got, _ = layer.apply({}, x, {})
+    want = np.zeros((2, 3, 3, 3))
+    for i in range(3):
+        for j in range(3):
+            win = x[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+            if ptype == "MAX":
+                want[:, :, i, j] = win.max(axis=(2, 3))
+            elif ptype == "AVG":
+                want[:, :, i, j] = win.mean(axis=(2, 3))
+            else:
+                want[:, :, i, j] = win.sum(axis=(2, 3))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_batchnorm_matches_numpy(rng):
+    layer = BatchNormalization(n_out=3, eps=1e-5)
+    import jax
+
+    params = layer.init_params(jax.random.PRNGKey(1))
+    state = layer.init_state()
+    x = rng.randn(4, 3, 5, 5).astype(np.float32)
+    got, new_state = layer.apply(params, x, state, train=True)
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    xhat = (x - mean) / np.sqrt(var + 1e-5)
+    want = (
+        np.asarray(params["gamma"]).reshape(1, -1, 1, 1) * xhat
+        + np.asarray(params["beta"]).reshape(1, -1, 1, 1)
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-4)
+    # running stats follow the decay rule
+    np.testing.assert_allclose(
+        np.asarray(new_state["mean"]),
+        0.9 * np.asarray(state["mean"]) + 0.1 * mean.ravel(),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_lrn_matches_numpy(rng):
+    layer = LocalResponseNormalization(k=2.0, n=5, alpha=1e-4, beta=0.75)
+    x = rng.randn(2, 7, 4, 4).astype(np.float32)
+    got, _ = layer.apply({}, x, {})
+    want = np.zeros_like(x, dtype=np.float64)
+    half = 5 // 2
+    for c in range(7):
+        lo, hi = max(0, c - half), min(7, c + half + 1)
+        denom = (2.0 + 1e-4 * np.sum(
+            x[:, lo:hi].astype(np.float64) ** 2, axis=1
+        )) ** 0.75
+        want[:, c] = x[:, c] / denom
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lstm_matches_numpy_step_loop(rng):
+    """GravesLSTM vs an explicit per-timestep numpy loop (the
+    reference's LSTMHelpers.activateHelper math, gate order i,f,o,g)."""
+    import jax
+
+    layer = GravesLSTM(n_in=3, n_out=4, activation="tanh")
+    params = layer.init_params(jax.random.PRNGKey(2))
+    x = rng.randn(2, 3, 5).astype(np.float32)
+    got, _ = layer.apply(params, x, {})
+
+    W = np.asarray(params["W"], np.float64)    # [n_in, 4*n_out]
+    RW = np.asarray(params["RW"], np.float64)  # [n_out, 4*n_out]
+    b = np.asarray(params["b"], np.float64)
+    n_out = 4
+    h = np.zeros((2, n_out))
+    c = np.zeros((2, n_out))
+    outs = []
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for t in range(5):
+        xt = x[:, :, t].astype(np.float64)
+        z = xt @ W + h @ RW + b
+        zi, zf, zo, zg = np.split(z, 4, axis=1)
+        i_g, f_g, o_g = sig(zi), sig(zf), sig(zo)
+        g_g = np.tanh(zg)
+        c = f_g * c + i_g * g_g
+        h = o_g * np.tanh(c)
+        outs.append(h)
+    want = np.stack(outs, axis=2)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3,
+                               atol=1e-4)
