@@ -869,11 +869,18 @@ class ComputationGraph:
             out = self.output(
                 *_as_list(ds.features), features_masks=fm
             )[0]
-            labels = _as_list(ds.labels)[0]
+            labels = np.asarray(_as_list(ds.labels)[0])
             m = _as_list(getattr(ds, "labels_masks", None)
                          or getattr(ds, "labels_mask", None))
-            e.eval(np.asarray(labels), np.asarray(out),
-                   mask=np.asarray(m[0]) if m and m[0] is not None else None)
+            mask = m[0] if m else None
+            if mask is None and labels.ndim == 3:
+                # per-timestep labels without a labels mask: fall back
+                # to the features mask (same rule as MLN.evaluate);
+                # 2-d per-sequence labels must not take a [b, t] mask
+                fml = _as_list(fm)
+                mask = fml[0] if fml else None
+            e.eval(labels, np.asarray(out),
+                   mask=np.asarray(mask) if mask is not None else None)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return e

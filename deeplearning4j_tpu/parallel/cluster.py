@@ -260,11 +260,15 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         # off-sizes plus masked/unmasked mixes — and fit once per
         # uniform group, full-size groups first.
         def mask_sig(b):
+            def sig_of(group, single):
+                g = getattr(b, group, None)
+                if g is not None:
+                    return tuple(m is not None for m in g)  # per slot
+                return (getattr(b, single, None) is not None,)
+
             return (
-                getattr(b, "features_masks", None) is not None
-                or getattr(b, "features_mask", None) is not None,
-                getattr(b, "labels_masks", None) is not None
-                or getattr(b, "labels_mask", None) is not None,
+                sig_of("features_masks", "features_mask"),
+                sig_of("labels_masks", "labels_mask"),
             )
 
         by_size: dict = {}

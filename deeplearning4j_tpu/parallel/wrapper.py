@@ -179,24 +179,33 @@ class ParallelWrapper:
                 return [v]
             return v
 
-        first = field(batches[0])
-        if first is None:
-            if any(field(b) is not None for b in batches[1:]):
-                raise ValueError(
-                    "replicas in one averaging round mix masked and "
-                    "unmasked batches; group them (an absent mask "
-                    "means all timesteps count — pass explicit ones "
-                    "to mix)"
-                )
+        def mixed_error():
+            raise ValueError(
+                "replicas in one averaging round mix masked and "
+                "unmasked batches (or mask different slots); group "
+                "them — an absent mask means all timesteps count, so "
+                "pass explicit ones to mix"
+            )
+
+        values = [field(b) for b in batches]
+        if values[0] is None or any(v is None for v in values):
+            if any(v is not None for v in values):
+                mixed_error()
             return None
-        if isinstance(first, (list, tuple)):
-            return [
-                None if first[i] is None else jnp.stack([
-                    jnp.asarray(field(b)[i], dtype) for b in batches
-                ])
-                for i in range(len(first))
-            ]
-        return jnp.stack([jnp.asarray(field(b), dtype) for b in batches])
+        if isinstance(values[0], (list, tuple)):
+            out = []
+            for i in range(len(values[0])):
+                slot = [v[i] for v in values]
+                if any(a is None for a in slot):
+                    if any(a is not None for a in slot):
+                        mixed_error()
+                    out.append(None)
+                else:
+                    out.append(jnp.stack([
+                        jnp.asarray(a, dtype) for a in slot
+                    ]))
+            return out
+        return jnp.stack([jnp.asarray(v, dtype) for v in values])
 
     @staticmethod
     def _mask_of(b, *names):

@@ -1,5 +1,6 @@
 from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     alexnet,
+    googlenet,
     graves_lstm_char_rnn,
     lenet,
     resnet50,

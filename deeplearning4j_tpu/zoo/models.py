@@ -12,7 +12,10 @@ the MXU, optional pure-bf16 compute via ``data_type``.
 """
 
 from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
-from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ElementWiseVertex,
+    MergeVertex,
+)
 from deeplearning4j_tpu.nn.layers import (
     ActivationLayer,
     BatchNormalization,
@@ -227,6 +230,101 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
         pooling_type="AVG", kernel_size=final_hw, stride=final_hw,
     ), prev)
     b.add_layer("out", OutputLayer(n_out=n_classes, loss="MCXENT"), "gap")
+    b.set_outputs("out")
+    b.set_input_types(InputType.convolutional(height, width, channels))
+    return b.build()
+
+
+def _inception_module(b, name, in_name, c1, c3r, c3, c5r, c5, pp):
+    """GoogLeNet inception module: 1x1 / 1x1->3x3 / 1x1->5x5 /
+    maxpool->1x1 branches concatenated over channels (MergeVertex)."""
+    b.add_layer(f"{name}_b1", ConvolutionLayer(
+        n_out=c1, kernel_size=(1, 1), activation="relu"), in_name)
+    b.add_layer(f"{name}_b3r", ConvolutionLayer(
+        n_out=c3r, kernel_size=(1, 1), activation="relu"), in_name)
+    b.add_layer(f"{name}_b3", ConvolutionLayer(
+        n_out=c3, kernel_size=(3, 3), padding=(1, 1),
+        activation="relu"), f"{name}_b3r")
+    b.add_layer(f"{name}_b5r", ConvolutionLayer(
+        n_out=c5r, kernel_size=(1, 1), activation="relu"), in_name)
+    b.add_layer(f"{name}_b5", ConvolutionLayer(
+        n_out=c5, kernel_size=(5, 5), padding=(2, 2),
+        activation="relu"), f"{name}_b5r")
+    b.add_layer(f"{name}_pool", SubsamplingLayer(
+        pooling_type="MAX", kernel_size=(3, 3), stride=(1, 1),
+        padding=(1, 1)), in_name)
+    b.add_layer(f"{name}_pp", ConvolutionLayer(
+        n_out=pp, kernel_size=(1, 1), activation="relu"),
+        f"{name}_pool")
+    b.add_vertex(f"{name}_cat", MergeVertex(), f"{name}_b1",
+                 f"{name}_b3", f"{name}_b5", f"{name}_pp")
+    return f"{name}_cat"
+
+
+def googlenet(height=224, width=224, channels=3, n_classes=1000, *,
+              updater="NESTEROVS", learning_rate=0.01, seed=42,
+              dtype="float32", compute_dtype=None):
+    """GoogLeNet / Inception v1 (Szegedy et al. 2014; the reference
+    era's MergeVertex-concat showcase — aux classifier heads omitted,
+    as in modern replications). ~6M params."""
+    if height % 32 or width % 32:
+        raise ValueError(
+            "googlenet input extent must be divisible by 32; got "
+            f"{height}x{width}"
+        )
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(learning_rate).updater(updater)
+        .data_type(dtype).compute_data_type(compute_dtype)
+        .graph_builder()
+        .add_inputs("in")
+    )
+    b.add_layer("stem1", ConvolutionLayer(
+        n_out=64, kernel_size=(7, 7), stride=(2, 2), padding=(3, 3),
+        activation="relu"), "in")
+    b.add_layer("pool1", SubsamplingLayer(
+        pooling_type="MAX", kernel_size=(3, 3), stride=(2, 2),
+        padding=(1, 1)), "stem1")
+    b.add_layer("stem2r", ConvolutionLayer(
+        n_out=64, kernel_size=(1, 1), activation="relu"), "pool1")
+    b.add_layer("stem2", ConvolutionLayer(
+        n_out=192, kernel_size=(3, 3), padding=(1, 1),
+        activation="relu"), "stem2r")
+    b.add_layer("pool2", SubsamplingLayer(
+        pooling_type="MAX", kernel_size=(3, 3), stride=(2, 2),
+        padding=(1, 1)), "stem2")
+    spec = [
+        ("3a", 64, 96, 128, 16, 32, 32),
+        ("3b", 128, 128, 192, 32, 96, 64),
+        ("pool", 0, 0, 0, 0, 0, 0),
+        ("4a", 192, 96, 208, 16, 48, 64),
+        ("4b", 160, 112, 224, 24, 64, 64),
+        ("4c", 128, 128, 256, 24, 64, 64),
+        ("4d", 112, 144, 288, 32, 64, 64),
+        ("4e", 256, 160, 320, 32, 128, 128),
+        ("pool", 0, 0, 0, 0, 0, 0),
+        ("5a", 256, 160, 320, 32, 128, 128),
+        ("5b", 384, 192, 384, 48, 128, 128),
+    ]
+    prev = "pool2"
+    n_pools = 0
+    for name, c1, c3r, c3, c5r, c5, pp in spec:
+        if name == "pool":
+            n_pools += 1
+            pname = f"pool{2 + n_pools}"
+            b.add_layer(pname, SubsamplingLayer(
+                pooling_type="MAX", kernel_size=(3, 3), stride=(2, 2),
+                padding=(1, 1)), prev)
+            prev = pname
+        else:
+            prev = _inception_module(
+                b, f"inc{name}", prev, c1, c3r, c3, c5r, c5, pp
+            )
+    gap = (height // 32, width // 32)
+    b.add_layer("gap", SubsamplingLayer(
+        pooling_type="AVG", kernel_size=gap, stride=gap), prev)
+    b.add_layer("out", OutputLayer(n_out=n_classes, loss="MCXENT",
+                                   dropout=0.4), "gap")
     b.set_outputs("out")
     b.set_input_types(InputType.convolutional(height, width, channels))
     return b.build()

@@ -9,6 +9,7 @@ from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.zoo import (
     alexnet,
+    googlenet,
     graves_lstm_char_rnn,
     lenet,
     resnet50,
@@ -86,3 +87,19 @@ def test_char_rnn_trains(rng):
     ].transpose(0, 2, 1)
     s = net.fit_minibatch(DataSet(features=x, labels=y))
     assert np.isfinite(float(s))
+
+
+def test_googlenet_param_count_and_trains(rng):
+    """GoogLeNet/Inception-v1 is ~6M params (no aux heads); a train
+    step runs through the 9 concat modules."""
+    g = ComputationGraph(
+        googlenet(height=64, width=64, n_classes=10)
+    ).init()
+    n = _n_params(g.params)
+    assert 5e6 < n < 8e6, n
+    x = rng.rand(2, 3, 64, 64).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 2)]
+    s = g.fit_minibatch(MultiDataSet(features=[x], labels=[y]))
+    assert np.isfinite(float(s))
+    out = np.asarray(g.output(x)[0])
+    assert out.shape == (2, 10)
