@@ -45,6 +45,7 @@ from deeplearning4j_tpu.nn.layers.variational import (  # noqa: F401
 from deeplearning4j_tpu.nn.layers.attention import (  # noqa: F401
     LayerNormalization,
     MultiHeadSelfAttention,
+    PositionalEncoding,
     TransformerBlock,
 )
 from deeplearning4j_tpu.nn.layers.moe import (  # noqa: F401

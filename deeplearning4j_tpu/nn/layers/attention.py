@@ -309,3 +309,31 @@ class LayerNormalization(LayerSpec):
             g = g[:, None]
             bta = bta[:, None]
         return self.activate_fn()(xn * g + bta), state
+
+
+@register_layer
+@dataclass(frozen=True)
+class PositionalEncoding(LayerSpec):
+    """Sinusoidal positional encoding added to [b, n, t] activations
+    (Vaswani et al. 2017) — parameter-free, any sequence length, so it
+    composes with the jit static-shape contract. Attention is
+    permutation-equivariant without it; place after the input
+    projection in decoder-only stacks."""
+
+    max_wavelength: float = 10000.0
+
+    def input_kind(self) -> str:
+        return "recurrent"
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        n, t = x.shape[1], x.shape[2]
+        pos = jnp.arange(t, dtype=x.dtype)
+        i = jnp.arange(n)
+        freq = jnp.asarray(self.max_wavelength, x.dtype) ** (
+            -((i // 2) * 2 / n).astype(x.dtype)
+        )
+        angle = freq[:, None] * pos[None, :]              # [n, t]
+        pe = jnp.where(
+            (i % 2 == 0)[:, None], jnp.sin(angle), jnp.cos(angle)
+        )
+        return x + pe[None].astype(x.dtype), state

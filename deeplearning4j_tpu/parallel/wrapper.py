@@ -188,7 +188,7 @@ class ParallelWrapper:
             )
 
         values = [field(b) for b in batches]
-        if values[0] is None or any(v is None for v in values):
+        if any(v is None for v in values):
             if any(v is not None for v in values):
                 mixed_error()
             return None

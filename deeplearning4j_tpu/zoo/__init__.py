@@ -4,5 +4,6 @@ from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     graves_lstm_char_rnn,
     lenet,
     resnet50,
+    transformer_lm,
     vgg16,
 )

@@ -330,6 +330,40 @@ def googlenet(height=224, width=224, channels=3, n_classes=1000, *,
     return b.build()
 
 
+def transformer_lm(vocab=77, d_model=256, n_layers=4, n_heads=8, *,
+                   ffn_hidden=None, n_experts=0, updater="ADAM",
+                   learning_rate=1e-3, seed=42, dtype="float32",
+                   compute_dtype=None):
+    """Decoder-only transformer language model (net-new family beyond
+    the reference's RNN era): causal MultiHeadSelfAttention via the
+    Pallas flash-attention kernel on TPU, sinusoidal positional
+    encoding, dense or Switch-MoE FFN (``n_experts > 0``).
+    Inputs/labels are [b, vocab, t] one-hots like the char-RNN
+    configs."""
+    from deeplearning4j_tpu.nn.layers import (
+        PositionalEncoding,
+        TransformerBlock,
+    )
+
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(learning_rate).updater(updater)
+        .data_type(dtype).compute_data_type(compute_dtype)
+        .list()
+        .layer(DenseLayer(n_out=d_model, activation="identity"))
+        .layer(PositionalEncoding())
+    )
+    for _ in range(n_layers):
+        b.layer(TransformerBlock(
+            n_heads=n_heads, causal=True,
+            ffn_hidden=ffn_hidden or 4 * d_model,
+            n_experts=n_experts,
+        ))
+    b.layer(RnnOutputLayer(n_out=vocab, loss="MCXENT"))
+    b.set_input_type(InputType.recurrent(vocab))
+    return b.build()
+
+
 def graves_lstm_char_rnn(vocab=77, hidden=200, n_layers=2, *,
                          updater="RMSPROP", learning_rate=0.1, seed=42,
                          tbptt_length=None, dtype="float32",

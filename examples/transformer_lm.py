@@ -15,6 +15,7 @@ from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.layers import (
     DenseLayer,
     RnnOutputLayer,
+    PositionalEncoding,
     TransformerBlock,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -53,6 +54,7 @@ def main():
         .seed(7).learning_rate(1e-3).updater("ADAM")
         .list()
         .layer(DenseLayer(n_out=64, activation="identity"))
+        .layer(PositionalEncoding())
     )
     for _ in range(2):
         builder.layer(TransformerBlock(

@@ -103,3 +103,24 @@ def test_googlenet_param_count_and_trains(rng):
     assert np.isfinite(float(s))
     out = np.asarray(g.output(x)[0])
     assert out.shape == (2, 10)
+
+
+def test_transformer_lm_trains(rng):
+    """Net-new family: decoder-only transformer LM with causal
+    attention (dense and Switch-MoE FFN variants) trains a step."""
+    from deeplearning4j_tpu.zoo import transformer_lm
+
+    for n_experts in (0, 2):
+        net = MultiLayerNetwork(transformer_lm(
+            vocab=11, d_model=16, n_layers=1, n_heads=2,
+            n_experts=n_experts,
+        )).init()
+        ids = rng.randint(0, 11, (4, 8))
+        x = np.eye(11, dtype=np.uint8)[ids].transpose(0, 2, 1)
+        y = np.eye(11, dtype=np.uint8)[
+            np.roll(ids, -1, 1)
+        ].transpose(0, 2, 1)
+        s = net.fit_minibatch(DataSet(features=x, labels=y))
+        assert np.isfinite(float(s))
+        out = np.asarray(net.output(x.astype(np.float32)))
+        assert out.shape == (4, 11, 8)
