@@ -57,13 +57,20 @@ class ComputationGraph:
         self._jit_multi_step = None
         self._solver = None  # lazily built for LBFGS/CG/line-search
         self.scan_chunk = 16  # minibatches fused per dispatch
-        # multi-epoch fits keep the dataset HBM-resident up to this size
-        self.device_cache_bytes = 4 << 30
+        # multi-epoch fits keep the dataset HBM-resident up to this
+        # size, derived from the device's reported memory limit
+        from deeplearning4j_tpu.util.device import device_cache_budget_bytes
+
+        self.device_cache_bytes = device_cache_budget_bytes()
         self._jit_output = None
         self._jit_rnn_step = None
         self._rnn_state: Dict[str, Any] = {}  # streaming rnnTimeStep
         self._jit_pretrain_steps: Dict[str, Any] = {}
         self._jit_pretrain_inputs: Dict[str, Any] = {}
+        # device-resident scan constants (see multilayer._scan_consts)
+        self._scan_const_cache: Dict[Any, Any] = {}
+        self._it0_dev = None
+        self._it0_shadow = -1
         self._pretrain_done = False
         self._base_key = jax.random.PRNGKey(conf.seed)
 
@@ -299,7 +306,8 @@ class ComputationGraph:
                 body, (params, upd_state, state),
                 (xs, ys, lmasks, fmasks, lr_stack, ts, rngs),
             )
-            return params, upd_state, state, scores
+            # next chunk's it0 stays device-resident (see _scan_consts)
+            return params, upd_state, state, scores, it0 + k
 
         return jax.jit(multi_step, donate_argnums=(0, 1, 2))
 
@@ -360,8 +368,11 @@ class ComputationGraph:
 
     def _stack_chunk(self, batches: list):
         """Stack k same-shaped minibatches into device-resident arrays
-        (integer inputs keep native width; cast on device)."""
-        from deeplearning4j_tpu.nn.multilayer import _to_device
+        (integer inputs keep native width; cast on device).
+        Already-device arrays stack ON DEVICE — pulling them back to
+        host first would round-trip the whole chunk over the
+        host<->device link (per-chunk seconds on a tunneled TPU)."""
+        from deeplearning4j_tpu.nn.multilayer import _stack_on_device
 
         dtype = self._dtype()
         rows = [self._ds_arrays(b) for b in batches]
@@ -371,9 +382,8 @@ class ComputationGraph:
             if first is None:
                 return None
             return [
-                None if first[j] is None else _to_device(
-                    np.stack([np.asarray(r[idx][j]) for r in rows]), dtype
-                )
+                None if first[j] is None
+                else _stack_on_device([r[idx][j] for r in rows], dtype)
                 for j in range(len(first))
             ]
 
@@ -389,24 +399,24 @@ class ComputationGraph:
         self._run_scan_chunk(self._stack_chunk(batches))
 
     def _run_scan_chunk(self, stacked) -> None:
+        from deeplearning4j_tpu.nn.multilayer import (
+            _note_it0,
+            _scan_consts,
+        )
+
         xs, ys, fmasks, lmasks, k = stacked
         it0 = self.iteration_count
-        lr_rows = [
-            self.updater_def.scheduled_lrs(it0 + i) for i in range(k)
-        ]
-        lr_stack = {
-            ln: jnp.asarray([row[ln] for row in lr_rows], jnp.float32)
-            for ln in self.updater_def.settings
-        }
+        lr_stack, it0_dev = _scan_consts(self, k, it0)
         if self._jit_multi_step is None:
             self._jit_multi_step = self._build_multi_step()
         (
             self.params, self.updater_state, self.state, scores,
+            it0_next,
         ) = self._jit_multi_step(
             self.params, self.updater_state, self.state,
-            xs, ys, lmasks, fmasks, lr_stack,
-            jnp.asarray(it0, jnp.int32), self._base_key,
+            xs, ys, lmasks, fmasks, lr_stack, it0_dev, self._base_key,
         )
+        _note_it0(self, it0_next, it0 + k)
         self.iteration_count += k
         self._last_score = scores[-1]
         if self.listeners:

@@ -74,6 +74,25 @@ def _cast_floats(tree, dtype):
     )
 
 
+def _stack_on_device(arrs, dtype):
+    """Stack k same-shaped minibatch arrays for a fused dispatch,
+    preserving the cast-on-device contract in ONE place for both
+    engines: already-device arrays stack on device (no host round
+    trip), narrow integer inputs (uint8 pixels/one-hots) keep their
+    native width — the step casts them on device."""
+    if all(isinstance(a, jax.Array) for a in arrs):
+        stacked = jnp.stack(arrs)
+    else:
+        return _to_device(
+            np.stack([np.asarray(a) for a in arrs]), dtype
+        )
+    return (
+        stacked
+        if stacked.dtype.kind in ("u", "i") and stacked.dtype.itemsize <= 2
+        else stacked.astype(dtype)
+    )
+
+
 def _nbytes(a) -> int:
     nb = getattr(a, "nbytes", None)
     return int(nb) if nb is not None else int(np.asarray(a).nbytes)
@@ -136,6 +155,46 @@ def _build_scan_plan(seq, sig_fn, stack_fn, scan_chunk: int):
     return plan
 
 
+def _scan_consts(model, k: int, it0: int):
+    """Device-resident (lr_stack, it0) for a fused k-step dispatch.
+
+    Both are tiny, but through a high-latency host link (e.g. the
+    tunneled-TPU dev setup) transferring the per-layer lr dict —
+    ~n_layers small arrays — EVERY chunk dominated ResNet-50-class
+    dispatch cost. Constant schedules (the common case) repeat the
+    same values every chunk, so the device copy is cached by value;
+    the it0 scalar is reused from the multi-step program's own
+    device-computed ``it0 + k`` output (``_note_it0``) so steady-state
+    chunks transfer nothing host-side at all."""
+    rows = [model.updater_def.scheduled_lrs(it0 + i) for i in range(k)]
+    names = list(model.updater_def.settings)
+    key = (k, tuple(
+        tuple(float(r[n]) for n in names) for r in rows
+    ))
+    cache = model._scan_const_cache
+    lr = cache.get(key)
+    if lr is None:
+        if len(cache) >= 64:  # unbounded only for pathological schedules
+            cache.clear()
+        lr = {
+            n: jnp.asarray([r[n] for r in rows], jnp.float32)
+            for n in names
+        }
+        cache[key] = lr
+    if model._it0_shadow == it0 and model._it0_dev is not None:
+        it0_dev = model._it0_dev
+    else:
+        it0_dev = jnp.asarray(it0, jnp.int32)
+    return lr, it0_dev
+
+
+def _note_it0(model, it0_dev, host_value: int) -> None:
+    """Record the device-side iteration counter a multi-step program
+    returned, for reuse by the next chunk's ``_scan_consts``."""
+    model._it0_dev = it0_dev
+    model._it0_shadow = host_value
+
+
 def _reg_penalty(layer, layer_params):
     """L1/L2 penalty for one layer (reference calcL1/calcL2)."""
     reg = 0.0
@@ -182,13 +241,20 @@ class MultiLayerNetwork:
         self._solver = None  # lazily built for LBFGS/CG/line-search
         self.scan_chunk = 16  # minibatches fused per dispatch
         # multi-epoch fits keep the dataset HBM-resident up to this
-        # size (v5e has 16 GiB HBM; leave room for params/activations)
-        self.device_cache_bytes = 4 << 30
+        # size, derived from the device's reported memory limit
+        # (4 GiB fallback when the runtime exposes no memory_stats())
+        from deeplearning4j_tpu.util.device import device_cache_budget_bytes
+
+        self.device_cache_bytes = device_cache_budget_bytes()
         self._jit_output = None
         self._jit_rnn_step = None
         self._jit_pretrain_steps: Dict[int, Callable] = {}
         self._jit_pretrain_input = None
         self._pretrain_done = False
+        # device-resident scan constants (see _scan_consts)
+        self._scan_const_cache: Dict[Any, Any] = {}
+        self._it0_dev = None
+        self._it0_shadow = -1
         self._base_key = jax.random.PRNGKey(conf.seed)
 
     @property
@@ -416,7 +482,9 @@ class MultiLayerNetwork:
                 body, (params, upd_state, state),
                 (xs, ys, masks, fmasks, lr_stack, ts, rngs),
             )
-            return params, upd_state, state, scores
+            # next chunk's it0, computed on device: the caller keeps it
+            # resident so consecutive chunks transfer no host scalars
+            return params, upd_state, state, scores, it0 + k
 
         return jax.jit(multi_step, donate_argnums=(0, 1, 2))
 
@@ -481,7 +549,7 @@ class MultiLayerNetwork:
                 body, (params, upd_state, state),
                 (xs, ys, masks, fmasks, lr_stack, ts, rngs, resets),
             )
-            return params, upd_state, state, scores
+            return params, upd_state, state, scores, it0 + k
 
         return jax.jit(multi_step, donate_argnums=(0, 1, 2))
 
@@ -545,23 +613,19 @@ class MultiLayerNetwork:
             if layer.is_recurrent():
                 state[name] = layer.init_stream_state(b, cdt)
         it0 = self.iteration_count
-        lr_rows = [
-            self.updater_def.scheduled_lrs(it0 + i) for i in range(k)
-        ]
-        lr_stack = {
-            ln: jnp.asarray([row[ln] for row in lr_rows], jnp.float32)
-            for ln in self.updater_def.settings
-        }
+        lr_stack, it0_dev = _scan_consts(self, k, it0)
         if self._jit_tbptt_multi_step is None:
             self._jit_tbptt_multi_step = self._build_tbptt_multi_step()
         (
             self.params, self.updater_state, new_state, scores,
+            it0_next,
         ) = self._jit_tbptt_multi_step(
             self.params, self.updater_state, state,
             xs, ys, masks, fmasks,
-            lr_stack, jnp.asarray(it0, jnp.int32), self._base_key,
+            lr_stack, it0_dev, self._base_key,
             resets,
         )
+        _note_it0(self, it0_next, it0 + k)
         self.state = new_state
         self.iteration_count += k
         self._last_score = scores[-1]
@@ -638,17 +702,7 @@ class MultiLayerNetwork:
             first = get(batches[0])
             if first is None:
                 return None
-            if all(isinstance(get(b), jax.Array) for b in batches):
-                stacked = jnp.stack([get(b) for b in batches])
-                return (
-                    stacked
-                    if stacked.dtype.kind in ("u", "i")
-                    and stacked.dtype.itemsize <= 2
-                    else stacked.astype(dtype)
-                )
-            return _to_device(
-                np.stack([np.asarray(get(b)) for b in batches]), dtype
-            )
+            return _stack_on_device([get(b) for b in batches], dtype)
 
         return (
             stack(lambda b: b.features),
@@ -670,22 +724,17 @@ class MultiLayerNetwork:
         """One fused k-step dispatch from pre-stacked device arrays."""
         xs, ys, masks, fmasks, k = stacked
         it0 = self.iteration_count
-        lr_rows = [
-            self.updater_def.scheduled_lrs(it0 + i) for i in range(k)
-        ]
-        lr_stack = {
-            ln: jnp.asarray([row[ln] for row in lr_rows], jnp.float32)
-            for ln in self.updater_def.settings
-        }
+        lr_stack, it0_dev = _scan_consts(self, k, it0)
         if self._jit_multi_step is None:
             self._jit_multi_step = self._build_multi_step()
         (
             self.params, self.updater_state, self.state, scores,
+            it0_next,
         ) = self._jit_multi_step(
             self.params, self.updater_state, self.state,
-            xs, ys, masks, fmasks, lr_stack,
-            jnp.asarray(it0, jnp.int32), self._base_key,
+            xs, ys, masks, fmasks, lr_stack, it0_dev, self._base_key,
         )
+        _note_it0(self, it0_next, it0 + k)
         self.iteration_count += k
         self._last_score = scores[-1]
         if self.listeners:

@@ -1,0 +1,36 @@
+"""Device-memory introspection shared by the two engines' HBM-resident
+dataset caches (reference analog: workspace sizing around the nd4j
+backends — here the budget bounds how much training data the fused
+multi-epoch fit keeps device-resident)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_FALLBACK_BYTES = 4 << 30  # no memory_stats(): assume a 16 GiB part
+_CACHE_FRACTION = 0.25     # leave the rest for params/acts/workspaces
+_cached: Optional[int] = None
+
+
+def device_cache_budget_bytes(device=None, refresh: bool = False) -> int:
+    """Bytes of training data the HBM cache may pin: a quarter of the
+    device's reported memory limit, with a 4 GiB fallback when the
+    runtime exposes no ``memory_stats()`` (e.g. a tunneled v5e, or the
+    CPU backend). Cached per process — device memory size is static."""
+    global _cached
+    if _cached is not None and not refresh and device is None:
+        return _cached
+    budget = _FALLBACK_BYTES
+    try:
+        import jax
+
+        d = device if device is not None else jax.devices()[0]
+        stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+        limit = (stats or {}).get("bytes_limit")
+        if limit:
+            budget = max(256 << 20, int(limit * _CACHE_FRACTION))
+    except Exception:
+        pass
+    if device is None:
+        _cached = budget
+    return budget
