@@ -72,6 +72,13 @@ import time
 
 import numpy as np
 
+# Persistent XLA compile cache: ResNet-50-class programs take minutes
+# to compile (especially the GSPMD-partitioned CPU-mesh child), and
+# the bench recompiles nothing across runs once this is warm.
+_COMPILE_CACHE = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", "/tmp/deeplearning4j_tpu_jax_cache"
+)
+
 BASELINES = {
     "lenet_mnist": 12000.0,        # ex/s    (derivation 1)
     "vgg16_cifar10": 1500.0,       # ex/s    (derivation 2)
@@ -139,7 +146,15 @@ def bench_lenet(batch=256, chunk=30, epochs=8) -> dict:
 
     net = MultiLayerNetwork(_lenet_conf()).init()
     net.scan_chunk = chunk
-    batches = _to_hbm(_mnist_batches(batch, chunk))
+    t0 = time.perf_counter()
+    batches, source, n_decoded = _mnist_batches(batch, chunk)
+    decode_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    batches = _to_hbm(batches)
+    transfer_s = time.perf_counter() - t0
+    # small real datasets: cycle the device-resident batches to fill
+    # the window (no duplicate transfers)
+    batches = [batches[i % len(batches)] for i in range(chunk)]
     flops_ex = train_step_cost(net, batches[0])["flops_per_example"]
     net.fit(batches, epochs=2)  # warmup: compile + one steady epoch
     _ = float(net.score_value)
@@ -149,20 +164,36 @@ def bench_lenet(batch=256, chunk=30, epochs=8) -> dict:
         _ = float(net.score_value)
 
     rate = _best_rate(window, 3, epochs * chunk * batch)
-    return {"value": rate, "flops_per_example": flops_ex}
+    # unoverlapped input cost: host decode (native C++ IDX parse +
+    # batch assembly) + host->device transfer, per example, vs the
+    # train step; the AsyncDataSetIterator-analog prefetch overlaps
+    # this in production, so the fraction is the worst case
+    per_ex_input = (decode_s + transfer_s) / max(n_decoded, 1)
+    per_ex_train = 1.0 / rate
+    return {
+        "value": rate, "flops_per_example": flops_ex,
+        "data": source,
+        "input_us_per_example": round(per_ex_input * 1e6, 2),
+        "input_fraction_unoverlapped": round(
+            per_ex_input / (per_ex_input + per_ex_train), 4
+        ),
+    }
 
 
 def _mnist_batches(batch, chunk):
-    """MNIST minibatches for the LeNet bench: REAL images decoded from
-    IDX files through the MnistDataSetIterator + native C++ loader
-    when a shard exists (DL4J_TPU_MNIST_DIR or
-    ~/.deeplearning4j_tpu/mnist), else synthetic binarized bits with
-    the same shapes/dtypes."""
-    from deeplearning4j_tpu.datasets.api import DataSet
-
-    real = _mnist_real_batches(batch, chunk)
+    """(batches, source, n_decoded) for the LeNet bench. REAL images
+    are decoded from IDX files through MnistDataSetIterator and the
+    native C++ loader: actual MNIST when present (DL4J_TPU_MNIST_DIR
+    or ~/.deeplearning4j_tpu/mnist), else the bundled real
+    handwritten-digits dataset written-once as IDX
+    (``datasets/realdata.py`` — sklearn load_digits, declared as
+    such). Synthetic bits are the last resort, labeled in the
+    output. Small real datasets are cycled to fill ``chunk``."""
+    real = _real_idx_batches(batch, chunk)
     if real is not None:
         return real
+    from deeplearning4j_tpu.datasets.api import DataSet
+
     rng = np.random.RandomState(0)
     return [
         DataSet(
@@ -172,24 +203,36 @@ def _mnist_batches(batch, chunk):
             ],
         )
         for _ in range(chunk)
-    ]
+    ], "synthetic", batch * chunk
 
 
-def _mnist_real_batches(batch, chunk):
+def _real_idx_batches(batch, chunk):
+    from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.datasets.realdata import ensure_digits_idx
+
+    def decode(data_dir, source):
+        it = MnistDataSetIterator(
+            batch, num_examples=batch * chunk, binarize=True,
+            data_dir=data_dir, allow_synthetic=False,
+        )
+        full = [ds for ds in it if ds.num_examples() == batch]
+        if not full:
+            raise ValueError("dataset smaller than one batch")
+        return full, source, len(full) * batch
+
     try:
-        import warnings
-
-        from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
-
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore", RuntimeWarning)
-            it = MnistDataSetIterator(
-                batch, num_examples=batch * chunk, binarize=True,
-            )
-            if getattr(it, "synthetic", False):
-                return None  # opt-in synthetic is NOT the real path
-            out = list(it)
-        return out if len(out) == chunk else None
+        return decode(None, "mnist-idx (native C++ decode)")
+    except Exception:
+        pass  # no (usable) real MNIST -> bundled-digits fallback
+    try:
+        digits_dir = ensure_digits_idx()
+        if digits_dir is None:
+            return None
+        return decode(
+            digits_dir,
+            "real-handwritten-digits-idx (sklearn load_digits, "
+            "native C++ decode; not MNIST)",
+        )
     except Exception:
         return None
 
@@ -496,6 +539,7 @@ def bench_dp_scaling() -> dict:
     def run(n):
         env = dict(os.environ)
         env.update({
+            "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE,
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": (
                 env.get("XLA_FLAGS", "")
@@ -550,18 +594,19 @@ def main() -> None:
                 "detail": value,
             }
             return
-        rate = value["value"]
+        rate = value.pop("value")
         entry = {
             "value": round(rate, 1), "unit": unit,
             "vs_baseline": round(rate / BASELINES[key], 3),
         }
-        f_ex = value.get("flops_per_example")
+        f_ex = value.pop("flops_per_example", None)
         if f_ex:
             achieved = rate * f_ex
             entry["flops_per_example"] = round(f_ex)
             entry["achieved_tflops"] = round(achieved / 1e12, 2)
             if peak:
                 entry["mfu"] = round(achieved / peak, 4)
+        entry.update(value)  # data source, input-pipeline metrics, ...
         configs[key] = entry
 
     run_config("lenet_mnist", bench_lenet, "examples/sec/chip")

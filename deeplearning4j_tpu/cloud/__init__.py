@@ -1,0 +1,27 @@
+"""Cloud provisioning + object storage (reference:
+``deeplearning4j-scaleout/deeplearning4j-aws`` — ``Ec2BoxCreator``,
+``ClusterSetup``/``HostProvisioner``, ``S3Downloader``/``S3Uploader``,
+``BaseS3DataSetIterator``), redesigned for TPU fleets: box creation
+becomes TPU-pod provisioning plans, SSH fan-out becomes per-worker
+command execution, and the S3 reader/uploader becomes an ObjectStore
+SPI whose local-filesystem backend works in any environment (the
+cloud-SDK backends are optional and gated on their clients)."""
+
+from deeplearning4j_tpu.cloud.provision import (  # noqa: F401
+    ClusterSetup,
+    HostProvisioner,
+    TpuPodProvisioner,
+)
+from deeplearning4j_tpu.cloud.storage import (  # noqa: F401
+    GcsObjectStore,
+    LocalObjectStore,
+    ObjectStore,
+    S3ObjectStore,
+    StorageDownloader,
+    StorageUploader,
+    object_store_for,
+)
+from deeplearning4j_tpu.cloud.data import (  # noqa: F401
+    CloudDataSetIterator,
+    save_dataset_shards,
+)

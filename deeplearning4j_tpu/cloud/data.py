@@ -1,0 +1,103 @@
+"""Object-store dataset iteration (reference:
+``aws/s3/reader/BaseS3DataSetIterator.java`` — iterate serialized
+DataSet objects straight out of a bucket — and the export-based
+training path ``spark/data/BatchAndExportDataSetsFunction.java``,
+which writes minibatch files a cluster later trains from).
+
+Shards are npz files (features/labels + optional masks) — the same
+arrays ``datasets.api.DataSet`` holds; ``save_dataset_shards``
+produces them, ``CloudDataSetIterator`` streams them back from any
+``ObjectStore`` backend. Together with ``parallel.cluster``'s
+``fit_paths`` analog this closes the loop the reference runs over S3:
+export minibatches once, train many times from storage."""
+
+from __future__ import annotations
+
+import io
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.cloud.storage import ObjectStore
+from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+
+
+def _ds_to_bytes(ds: DataSet) -> bytes:
+    arrays = {"features": np.asarray(ds.features),
+              "labels": np.asarray(ds.labels)}
+    if ds.features_mask is not None:
+        arrays["features_mask"] = np.asarray(ds.features_mask)
+    if ds.labels_mask is not None:
+        arrays["labels_mask"] = np.asarray(ds.labels_mask)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _ds_from_bytes(data: bytes) -> DataSet:
+    z = np.load(io.BytesIO(data))
+    return DataSet(
+        features=z["features"], labels=z["labels"],
+        features_mask=z["features_mask"] if "features_mask" in z.files
+        else None,
+        labels_mask=z["labels_mask"] if "labels_mask" in z.files
+        else None,
+    )
+
+
+def save_dataset_shards(batches, store: ObjectStore,
+                        prefix: str = "dataset/") -> List[str]:
+    """Export minibatches as numbered npz shards (the
+    BatchAndExportDataSetsFunction analog). Returns the keys."""
+    keys = []
+    for i, ds in enumerate(batches):
+        key = f"{prefix}shard-{i:06d}.npz"
+        store.write(key, _ds_to_bytes(ds))
+        keys.append(key)
+    return keys
+
+
+class CloudDataSetIterator(DataSetIterator):
+    """Stream DataSet shards from an object store
+    (``BaseS3DataSetIterator`` analog). Keys are listed once at
+    construction; ``reset()`` restarts the stream. Feed it to any
+    ``fit(iterator)`` — the engines' async prefetch wrapper
+    (``datasets.iterators.AsyncDataSetIterator``) overlaps the
+    store reads with device steps exactly as the reference wraps its
+    S3 iterator."""
+
+    def __init__(self, store: ObjectStore, prefix: str = "dataset/",
+                 keys: Optional[List[str]] = None):
+        self.store = store
+        self._keys = list(keys) if keys is not None else store.keys(
+            prefix
+        )
+        if not self._keys:
+            raise ValueError(
+                f"no dataset shards under prefix {prefix!r}"
+            )
+        self._pos = 0
+        self._first: Optional[DataSet] = None
+
+    def next(self) -> DataSet:
+        ds = _ds_from_bytes(self.store.read(self._keys[self._pos]))
+        self._pos += 1
+        if self._first is None:
+            self._first = ds
+        return ds
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._keys)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        if self._first is None:
+            self._first = _ds_from_bytes(
+                self.store.read(self._keys[0])
+            )
+        return self._first.num_examples()
+
+    def total_examples(self) -> int:
+        return -1  # unknown without reading every shard

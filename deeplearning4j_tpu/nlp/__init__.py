@@ -25,6 +25,7 @@ from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
     tokenizer_factory,
 )
 from deeplearning4j_tpu.nlp import cjk  # noqa: F401 — registers ja/ko
+from deeplearning4j_tpu.nlp import japanese  # noqa: F401 — dict segmenter
 from deeplearning4j_tpu.nlp.treeparser import (  # noqa: F401
     Tree,
     TreeParser,

@@ -116,4 +116,7 @@ class KoreanTokenizerFactory(JapaneseTokenizerFactory):
 
 
 register_tokenizer_factory("japanese", JapaneseTokenizerFactory)
+# explicit name for the script-run fallback; nlp.japanese re-registers
+# "japanese" with the dictionary/Viterbi segmenter on package import
+register_tokenizer_factory("japanese_script", JapaneseTokenizerFactory)
 register_tokenizer_factory("korean", KoreanTokenizerFactory)

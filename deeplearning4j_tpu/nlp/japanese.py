@@ -1,0 +1,157 @@
+"""Dictionary-based Japanese segmentation (reference:
+``deeplearning4j-nlp-japanese`` vendors the Kuromoji morphological
+analyzer — ``com/atilika/kuromoji/TokenizerBase.java:1``, a
+dictionary lattice + Viterbi minimum-cost path over connection costs).
+
+This is the same algorithmic scheme at mini scale, dependency-free:
+a checked-in lexicon (common particles, auxiliaries, verb forms, and
+frequent content words) is matched into a lattice over every text
+position, unknown spans are covered by script-class runs (the
+Kuromoji unknown-word handler does the same grouping), and a Viterbi
+pass picks the minimum-cost segmentation. Costs are unigram
+(length-discounted dictionary costs vs a per-character unknown
+penalty) rather than Kuromoji's learned connection matrix — the
+honest divergence, documented here and in the README.
+
+Registered as ``tokenizer_factory("japanese")``; the zero-dependency
+script-run segmenter stays available as ``"japanese_script"``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from deeplearning4j_tpu.nlp.cjk import _script_class, segment_by_script
+from deeplearning4j_tpu.nlp.tokenization import (
+    Tokenizer,
+    register_tokenizer_factory,
+)
+
+# Mini-lexicon: surface -> cost (lower = preferred). Particles and
+# auxiliaries are cheap (they are near-certain when they match);
+# content words cost more than function words but much less than
+# unknown spans. A real deployment swaps this dict for a full
+# IPADIC-style lexicon through the same factory.
+LEXICON: Dict[str, int] = {
+    # particles
+    "は": 100, "が": 100, "を": 100, "に": 100, "で": 110, "と": 110,
+    # も costs more than half of もも so the lattice prefers the noun
+    # over a particle chain (the unigram stand-in for Kuromoji's
+    # connection costs, which penalize particle-particle transitions)
+    "も": 150, "の": 100, "へ": 120, "や": 130, "から": 120,
+    "まで": 120, "より": 130, "ね": 140, "よ": 140, "か": 130,
+    # copula / auxiliaries / common verb endings
+    "です": 150, "でした": 160, "ます": 150, "ました": 160,
+    "ません": 160, "だ": 160, "である": 170, "する": 170,
+    "します": 160, "しました": 170,
+    "した": 170, "して": 170, "います": 170, "いる": 170,
+    "ある": 170, "なる": 180, "れる": 180, "られる": 190,
+    "ない": 170, "たい": 180, "ください": 180,
+    # pronouns / demonstratives
+    "私": 200, "僕": 210, "彼": 210, "彼女": 220, "これ": 200,
+    "それ": 200, "あれ": 210, "ここ": 210, "そこ": 210, "どこ": 210,
+    # common nouns
+    "こと": 200, "もの": 260, "とき": 210, "ところ": 220, "人": 220,
+    "日": 230, "年": 230, "月": 230, "時間": 240, "今日": 230,
+    "明日": 240, "昨日": 240, "学生": 250, "先生": 250, "学校": 250,
+    "大学": 250, "東京": 250, "日本": 240, "日本語": 250, "言語": 260,
+    "単語": 260, "文章": 260, "意味": 260, "世界": 260, "会社": 260,
+    "仕事": 260, "電車": 270, "車": 260, "家": 250, "水": 260,
+    "本": 250, "犬": 260, "猫": 260, "うち": 230, "すもも": 300,
+    "もも": 280, "桃": 270, "李": 290,
+    # common verbs/adjectives (stems + frequent conjugations)
+    "行き": 260, "行く": 260, "行った": 270, "来る": 260, "来た": 270,
+    "見る": 260, "見た": 270, "食べ": 270, "食べる": 270,
+    "読む": 270, "読み": 270, "書く": 270, "書き": 270, "話す": 270,
+    "思い": 270, "思う": 270, "使う": 270, "使い": 270,
+    "良い": 270, "いい": 260, "大きい": 280, "小さい": 280,
+    "新しい": 280, "高い": 280,
+}
+
+_MAX_LEN = max(len(w) for w in LEXICON)
+_UNK_BASE = 700       # flat penalty for opening an unknown span
+_UNK_PER_CHAR = 350   # per-character unknown cost: two dictionary
+#                       words always beat one unknown covering both
+
+
+def _unknown_run_len(text: str, i: int) -> int:
+    """Length of the same-script run starting at i (Kuromoji's
+    unknown-word grouping)."""
+    c = _script_class(text[i])
+    j = i + 1
+    while j < len(text) and _script_class(text[j]) == c:
+        j += 1
+    return j - i
+
+
+def segment(text: str) -> List[str]:
+    """Minimum-cost segmentation of ``text`` (Viterbi over the match
+    lattice). Whitespace splits the lattice; punctuation tokens are
+    dropped (matching the script-run segmenter's convention)."""
+    out: List[str] = []
+    for chunk in text.split():
+        out.extend(_segment_chunk(chunk))
+    return [
+        t for t in out
+        if t and not all(_script_class(c) == "punct" for c in t)
+    ]
+
+
+def _segment_chunk(text: str) -> List[str]:
+    n = len(text)
+    if n == 0:
+        return []
+    INF = float("inf")
+    best = [INF] * (n + 1)
+    back = [0] * (n + 1)
+    best[0] = 0.0
+    for i in range(n):
+        if best[i] is INF:
+            continue
+        # dictionary edges
+        for ln in range(1, min(_MAX_LEN, n - i) + 1):
+            w = text[i:i + ln]
+            cost = LEXICON.get(w)
+            if cost is None:
+                continue
+            c = best[i] + cost
+            if c < best[i + ln]:
+                best[i + ln] = c
+                back[i + ln] = i
+        # unknown edges: the full same-script run AND its single first
+        # character (so a dictionary word just past position i+1 is
+        # reachable without consuming the whole run)
+        run = _unknown_run_len(text, i)
+        for ln in {run, 1}:
+            c = best[i] + _UNK_BASE + _UNK_PER_CHAR * ln
+            if c < best[i + ln]:
+                best[i + ln] = c
+                back[i + ln] = i
+    if best[n] is INF:  # unreachable only if text is empty; guard
+        return segment_by_script(text)
+    cuts = []
+    j = n
+    while j > 0:
+        cuts.append(j)
+        j = back[j]
+    cuts.append(0)
+    cuts.reverse()
+    return [text[a:b] for a, b in zip(cuts, cuts[1:])]
+
+
+class JapaneseDictTokenizerFactory:
+    """Kuromoji-analog TokenizerFactory: lattice + Viterbi over the
+    checked-in mini-lexicon, unknown spans grouped by script class.
+    ``preprocessor`` follows the reference's TokenPreProcess seam."""
+
+    def __init__(self, preprocessor=None):
+        self.preprocessor = preprocessor
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(segment(text), self.preprocessor)
+
+
+# dictionary segmentation becomes the default "japanese" tokenizer;
+# the zero-dependency script-run fallback stays registered under an
+# explicit name
+register_tokenizer_factory("japanese", JapaneseDictTokenizerFactory)

@@ -7,13 +7,18 @@ Formats:
   (Google text format; reference ``writeWordVectors``/``loadTxt``).
 - binary: header "V D\\n", then per word: name + 0x20 + D float32 LE
   (Google ``word2vec`` C binary; reference ``loadGoogleModel``).
+- full model: zip of config.json + vocab.json + tables.npz preserving
+  ALL training state — syn0 AND syn1/syn1neg + Huffman coding + word
+  counts — so ``fit()`` resumes from disk (reference
+  ``writeFullModel``/``loadFullModel``; the txt/binary interop formats
+  keep only syn0 and cannot resume).
 """
 
 from __future__ import annotations
 
-import struct
-from pathlib import Path
-from typing import Tuple
+import json
+import zipfile
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -90,6 +95,99 @@ def load_binary(path) -> Tuple[VocabCache, np.ndarray]:
                 # older files omit the newline; step back
                 f.seek(-1, 1)
     return cache, m
+
+
+_FULL_MODEL_KEYS = (
+    "layer_size", "window", "learning_rate", "min_learning_rate",
+    "negative", "sample", "epochs", "iterations", "batch_size",
+    "seed", "algorithm",
+)
+
+
+def write_full_model(model, path) -> None:
+    """Checkpoint a SequenceVectors/Word2Vec with its FULL training
+    state (reference ``WordVectorSerializer.writeFullModel``): both
+    weight tables, the Huffman coding, and per-word counts — enough to
+    resume ``fit()`` with the alpha schedule and negative-sampling
+    distribution intact."""
+    import io
+
+    cache = model.cache
+    lk = model.lookup
+    tables = {"syn0": np.asarray(lk.syn0)}
+    if lk.syn1 is not None:
+        tables["syn1"] = np.asarray(lk.syn1)
+    if lk.syn1neg is not None:
+        tables["syn1neg"] = np.asarray(lk.syn1neg)
+    if model.use_hs:
+        tables["huffman_codes"] = np.asarray(model._codes)
+        tables["huffman_points"] = np.asarray(model._points)
+        tables["huffman_code_lens"] = np.asarray(model._code_lens)
+    conf = {
+        "format": "deeplearning4j_tpu.full_word2vec.1",
+        "class": type(model).__name__,
+        "use_hierarchic_softmax": model.use_hs,
+        **{k: getattr(model, k) for k in _FULL_MODEL_KEYS},
+    }
+    vocab = {
+        "total_word_count": cache.total_word_count,
+        "words": [[w.word, int(w.count)] for w in cache.words],
+    }
+    buf = io.BytesIO()
+    np.savez(buf, **tables)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("config.json", json.dumps(conf))
+        z.writestr("vocab.json", json.dumps(vocab))
+        z.writestr("tables.npz", buf.getvalue())
+
+
+def load_full_model(path, sequences: Optional[list] = None):
+    """Restore a full word2vec checkpoint. Returns a ``Word2Vec``
+    (or base ``SequenceVectors``) whose next ``fit()`` continues from
+    the saved tables; pass ``sequences`` (id arrays) to resume
+    training on a corpus (reference ``loadFullModel``)."""
+    import io
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.word2vec import SequenceVectors, Word2Vec
+
+    with zipfile.ZipFile(path, "r") as z:
+        conf = json.loads(z.read("config.json"))
+        if not str(conf.get("format", "")).startswith(
+            "deeplearning4j_tpu.full_word2vec."
+        ):
+            raise ValueError(
+                f"{path} is not a full word2vec checkpoint"
+            )
+        vocab = json.loads(z.read("vocab.json"))
+        tables = np.load(io.BytesIO(z.read("tables.npz")))
+        tables = {k: tables[k] for k in tables.files}
+    cache = VocabCache()
+    for word, count in vocab["words"]:
+        cache.add(VocabWord(word, count))
+    cache.total_word_count = vocab["total_word_count"]
+    kw = {k: conf[k] for k in _FULL_MODEL_KEYS}
+    kw["use_hierarchic_softmax"] = conf["use_hierarchic_softmax"]
+    if conf["class"] == "Word2Vec":
+        model = Word2Vec(cache, sequences or [], **kw)
+    else:
+        model = SequenceVectors(cache, **kw)
+        if sequences is not None:
+            model._seqs = sequences
+            model._sequences = lambda: iter(model._seqs)
+    lk = model.lookup
+    lk.syn0 = jnp.asarray(tables["syn0"])
+    if "syn1" in tables:
+        lk.syn1 = jnp.asarray(tables["syn1"])
+    if "syn1neg" in tables:
+        lk.syn1neg = jnp.asarray(tables["syn1neg"])
+    if model.use_hs and "huffman_codes" in tables:
+        model._codes = tables["huffman_codes"]
+        model._points = tables["huffman_points"]
+        model._code_lens = tables["huffman_code_lens"]
+    lk.invalidate_norms()
+    return model
 
 
 def write_word_vectors(model, path) -> None:

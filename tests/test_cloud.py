@@ -1,0 +1,189 @@
+"""Cloud module tests (deeplearning4j-aws analog): provisioning plans,
+the ObjectStore SPI over the local backend, and storage-backed
+dataset iteration feeding a real fit()."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cloud import (
+    CloudDataSetIterator,
+    ClusterSetup,
+    HostProvisioner,
+    LocalObjectStore,
+    S3ObjectStore,
+    StorageDownloader,
+    StorageUploader,
+    TpuPodProvisioner,
+    object_store_for,
+    save_dataset_shards,
+)
+from deeplearning4j_tpu.datasets.api import DataSet
+
+
+def test_provisioner_plans():
+    p = TpuPodProvisioner(name="trainer", accelerator_type="v5litepod-16",
+                          zone="us-east5-b", project="proj")
+    create = p.create_plan()
+    assert create[:5] == ["gcloud", "compute", "tpus", "tpu-vm",
+                         "create"]
+    assert "v5litepod-16" in create and "--project" in create
+    assert p.num_hosts() == 4
+    envs = p.worker_env("10.0.0.2")
+    assert len(envs) == 4
+    assert envs[2] == {
+        "COORDINATOR_ADDRESS": "10.0.0.2:8476",
+        "NUM_PROCESSES": "4",
+        "PROCESS_ID": "2",
+    }
+    with pytest.raises(ValueError, match="unknown accelerator"):
+        TpuPodProvisioner(name="x", accelerator_type="v99").num_hosts()
+
+
+def test_cluster_setup_plan_and_dry_run_exec():
+    p = TpuPodProvisioner(name="pod", accelerator_type="v5litepod-16")
+    cs = ClusterSetup(
+        p, setup_commands=["pip install -e ."],
+        train_command="python train.py",
+    )
+    lines = cs.plan(coordinator_host="10.0.0.9")
+    # create + 1 setup fan-out + 4 per-worker launches
+    assert len(lines) == 1 + 1 + 4
+    assert "create" in lines[0]
+    assert "PROCESS_ID=3" in lines[-1]
+    ran = []
+    cs.exec("10.0.0.9", runner=ran.append)
+    assert len(ran) == 6
+
+
+def test_host_provisioner_records_and_runs():
+    h = HostProvisioner("worker-0")  # dry-run: records only
+    h.run("echo hello")
+    h.run_all(["ls -l", ["touch", "x"]])
+    assert h.commands_run[0] == ["echo", "hello"]
+    assert h.commands_run[2] == ["touch", "x"]
+    live = HostProvisioner("localhost",
+                           runner=HostProvisioner.local_runner)
+    r = live.run("echo provisioned")
+    assert r.stdout.strip() == "provisioned"
+
+
+def test_local_object_store_round_trip(tmp_path):
+    store = LocalObjectStore(tmp_path / "bucket")
+    store.write("a/x.bin", b"xx")
+    store.write("a/y.bin", b"yy")
+    store.write("b/z.bin", b"zz")
+    assert store.keys() == ["a/x.bin", "a/y.bin", "b/z.bin"]
+    assert store.keys("a/") == ["a/x.bin", "a/y.bin"]
+    assert store.read("a/y.bin") == b"yy"
+    seen = []
+    store.paginate(seen.append, prefix="a/", page_size=1)
+    assert seen == ["a/x.bin", "a/y.bin"]
+    streams = list(store.iterate("b/"))
+    assert streams[0].read() == b"zz"
+    with pytest.raises(ValueError, match="escapes"):
+        store.write("../evil", b"no")
+    # downloader/uploader shims keep the reference call shape
+    up = StorageUploader(store)
+    f = tmp_path / "local.txt"
+    f.write_bytes(b"payload")
+    up.upload(f, "c/local.txt")
+    down = StorageDownloader(store)
+    assert down.keys_for_bucket("c/") == ["c/local.txt"]
+    out = tmp_path / "back.txt"
+    down.download("c/local.txt", out)
+    assert out.read_bytes() == b"payload"
+
+
+def test_object_store_for_dispatch(tmp_path):
+    st = object_store_for(str(tmp_path / "store"))
+    st.write("k", b"v")
+    assert object_store_for(
+        f"file://{tmp_path / 'store'}"
+    ).read("k") == b"v"
+
+
+def test_s3_store_gated_or_adapts():
+    try:
+        import boto3  # noqa: F401
+
+        has_boto = True
+    except ImportError:
+        has_boto = False
+    if not has_boto:
+        with pytest.raises(ImportError, match="boto3"):
+            S3ObjectStore("bucket")
+
+    class FakeClient:
+        def __init__(self):
+            self.objects = {}
+
+        def list_objects_v2(self, Bucket, Prefix, **kw):
+            keys = sorted(
+                k for k in self.objects if k.startswith(Prefix)
+            )
+            return {
+                "Contents": [{"Key": k} for k in keys],
+                "IsTruncated": False,
+            }
+
+        def put_object(self, Bucket, Key, Body):
+            self.objects[Key] = Body
+
+        def get_object(self, Bucket, Key):
+            import io
+
+            return {"Body": io.BytesIO(self.objects[Key])}
+
+    st = S3ObjectStore("bucket", client=FakeClient())
+    st.write("p/k", b"v")
+    assert st.keys("p/") == ["p/k"]
+    assert st.read("p/k") == b"v"
+
+
+def test_cloud_dataset_iterator_feeds_fit(tmp_path):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(96, 8).astype(np.float32)
+    w = rng.rand(8, 3)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, 1)]
+    batches = [
+        DataSet(features=x[i * 32:(i + 1) * 32],
+                labels=y[i * 32:(i + 1) * 32])
+        for i in range(3)
+    ]
+    store = LocalObjectStore(tmp_path / "bucket")
+    keys = save_dataset_shards(batches, store)
+    assert len(keys) == 3
+
+    it = CloudDataSetIterator(store)
+    assert it.batch() == 32
+    round_trip = list(it)
+    np.testing.assert_array_equal(
+        round_trip[1].features, batches[1].features
+    )
+
+    conf = (NeuralNetConfiguration.Builder().seed(1).learning_rate(0.05)
+            .updater("ADAM").list()
+            .layer(DenseLayer(n_in=8, n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss="MCXENT"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=1)
+    s1 = float(net.score_value)
+    net.fit(it, epochs=20)
+    assert float(net.score_value) < s1
+
+    with pytest.raises(ValueError, match="no dataset shards"):
+        CloudDataSetIterator(store, prefix="missing/")
+
+
+def test_local_store_blocks_sibling_prefix_escape(tmp_path):
+    """'../bucket-evil' must not pass the root check just because the
+    sibling shares the root directory name as a string prefix."""
+    store = LocalObjectStore(tmp_path / "bucket")
+    with pytest.raises(ValueError, match="escapes"):
+        store.write("../bucket-evil/pwn", b"x")
+    assert not (tmp_path / "bucket-evil").exists()

@@ -495,3 +495,28 @@ def test_record_reader_multi_dataset_iterator():
     batches = list(it)  # __iter__ resets: one full pass
     assert sum(b.features[0].shape[0] for b in batches) == 10
     assert [b.features[0].shape[0] for b in batches] == [4, 4, 2]
+
+
+def test_real_digits_idx_roundtrip(tmp_path):
+    """ensure_digits_idx writes real handwritten rasters as IDX that
+    the (native-decoding) MnistDataSetIterator parses end-to-end."""
+    pytest.importorskip("sklearn")
+    from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.datasets.realdata import ensure_digits_idx
+
+    d = ensure_digits_idx(str(tmp_path / "digits"))
+    assert d is not None
+    # generate-once: second call is a no-op returning the cache
+    assert ensure_digits_idx(d) == d
+    it = MnistDataSetIterator(64, data_dir=d, allow_synthetic=False)
+    ds = next(iter(it))
+    assert ds.features.shape == (64, 784)
+    assert ds.labels.shape == (64, 10)
+    assert not it.synthetic
+    # real pen strokes: nontrivial ink distribution per image
+    ink = (ds.features > 0).mean()
+    assert 0.05 < ink < 0.9
+    te = MnistDataSetIterator(64, train=False, data_dir=d,
+                              allow_synthetic=False)
+    assert te.total_examples() == 297
+    assert it.total_examples() == 1500

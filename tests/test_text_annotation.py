@@ -95,3 +95,33 @@ def test_tree_vectorizer_attaches_vectors():
     # "dogs" stems to "dog" -> known vector
     by_word = {leaf.value: leaf.vector for leaf in leaves}
     np.testing.assert_array_equal(by_word["dogs"], np.ones(4))
+
+
+def test_japanese_dict_segmentation_beats_script_runs():
+    """The Viterbi/dictionary segmenter (Kuromoji analog,
+    nlp/japanese.py) splits inside same-script runs where the
+    script-run fallback cannot."""
+    tf = tokenizer_factory("japanese")
+    # one kanji run "東京大学" -> two lexicon words
+    assert tf.create("東京大学に行きます").get_tokens() == [
+        "東京", "大学", "に", "行き", "ます"
+    ]
+    # the classic lattice sentence: すもももももももものうち.
+    # A unigram lattice (no connection matrix) picks the fewer-token
+    # path すもも/もも/もも/もも/の/うち over Kuromoji's canonical
+    # すもも/も/もも/も/もも/の/うち — the divergence documented in
+    # nlp/japanese.py; every cut still falls on a dictionary word.
+    assert tf.create("すもももももももものうち").get_tokens() == [
+        "すもも", "もも", "もも", "もも", "の", "うち"
+    ]
+    # script-run fallback keeps runs whole (registered explicitly)
+    script = tokenizer_factory("japanese_script")
+    assert script.create("東京大学に行きます").get_tokens()[0] == "東京大学"
+
+
+def test_japanese_dict_unknown_words_group_by_script():
+    tf = tokenizer_factory("japanese")
+    # unknown katakana run stays one token; particles still split
+    toks = tf.create("コンピュータは速い").get_tokens()
+    assert toks[0] == "コンピュータ"
+    assert "は" in toks

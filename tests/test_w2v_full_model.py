@@ -1,0 +1,123 @@
+"""Full-model word2vec checkpoint: round-trip + resume (reference
+``WordVectorSerializer.writeFullModel``/``loadFullModel`` — the
+interop txt/binary formats keep only syn0 and cannot resume)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.serializer import (
+    load_full_model,
+    write_full_model,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def _corpus(rng, vocab=50, n=300, ln=12):
+    words = [f"w{i}" for i in range(vocab)]
+    zipf = 1.0 / np.arange(1, vocab + 1)
+    p = zipf / zipf.sum()
+    return [
+        [words[i] for i in rng.choice(vocab, size=ln, p=p)]
+        for _ in range(n)
+    ]
+
+
+def _model(sentences, **kw):
+    cache = VocabConstructor(min_word_frequency=1).build_vocab_from_tokens(
+        sentences
+    )
+    ids = [np.asarray(cache.id_stream(s), np.int64) for s in sentences]
+    return Word2Vec(cache, ids, layer_size=16, window=3, seed=7,
+                    epochs=1, batch_size=256, **kw), ids
+
+
+def _ns_loss(syn0, syn1neg, centers, contexts, negs):
+    def ls(x):
+        return -np.log1p(np.exp(-x))
+
+    v = syn0[centers]
+    pos = ls(np.sum(v * syn1neg[contexts], axis=-1))
+    neg = ls(-np.einsum("bd,bkd->bk", v, syn1neg[negs])).sum(axis=-1)
+    return float(-np.mean(pos + neg))
+
+
+@pytest.mark.parametrize("hs", [False, True])
+def test_full_model_round_trip(tmp_path, hs):
+    rng = np.random.RandomState(0)
+    sv, _ids = _model(_corpus(rng), use_hierarchic_softmax=hs)
+    sv.fit()
+    p = tmp_path / "w2v_full.zip"
+    write_full_model(sv, p)
+    back = load_full_model(p)
+    assert type(back).__name__ == "Word2Vec"
+    np.testing.assert_array_equal(
+        np.asarray(back.lookup.syn0), np.asarray(sv.lookup.syn0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(back.lookup.syn1neg), np.asarray(sv.lookup.syn1neg)
+    )
+    if hs:
+        np.testing.assert_array_equal(
+            np.asarray(back.lookup.syn1), np.asarray(sv.lookup.syn1)
+        )
+        np.testing.assert_array_equal(back._codes, sv._codes)
+        np.testing.assert_array_equal(back._points, sv._points)
+    assert len(back.cache) == len(sv.cache)
+    for a, b in zip(back.cache.words, sv.cache.words):
+        assert (a.word, a.count, a.index) == (b.word, b.count, b.index)
+    assert back.cache.total_word_count == sv.cache.total_word_count
+    for k in ("layer_size", "window", "negative", "seed", "algorithm"):
+        assert getattr(back, k) == getattr(sv, k)
+
+
+def test_full_model_resume_continues_training(tmp_path):
+    rng = np.random.RandomState(1)
+    sentences = _corpus(rng)
+    sv, ids = _model(sentences)
+    sv.fit()
+    p = tmp_path / "w2v_full.zip"
+    write_full_model(sv, p)
+
+    # probe NS loss: trained tables must beat a fresh model's, and the
+    # loaded model must match the saved one exactly (loss continuity)
+    probe_c, probe_o = sv._gen_pairs(999)
+    probe_c, probe_o = probe_c[:512], probe_o[:512]
+    negs = rng.randint(0, len(sv.cache), (len(probe_c), 5))
+    fresh, _ = _model(sentences)
+    loaded = load_full_model(p, sequences=ids)
+    l_fresh = _ns_loss(
+        np.asarray(fresh.lookup.syn0), np.asarray(fresh.lookup.syn1neg),
+        probe_c, probe_o, negs,
+    )
+    l_saved = _ns_loss(
+        np.asarray(sv.lookup.syn0), np.asarray(sv.lookup.syn1neg),
+        probe_c, probe_o, negs,
+    )
+    l_loaded = _ns_loss(
+        np.asarray(loaded.lookup.syn0),
+        np.asarray(loaded.lookup.syn1neg), probe_c, probe_o, negs,
+    )
+    assert l_loaded == pytest.approx(l_saved, abs=1e-7)
+    assert l_saved < l_fresh
+
+    # resuming fit() from the checkpoint keeps improving the probe
+    loaded.fit()
+    l_resumed = _ns_loss(
+        np.asarray(loaded.lookup.syn0),
+        np.asarray(loaded.lookup.syn1neg), probe_c, probe_o, negs,
+    )
+    assert np.isfinite(l_resumed)
+    assert l_resumed < l_saved
+
+
+def test_full_model_rejects_other_zips(tmp_path):
+    import zipfile
+
+    p = tmp_path / "not_w2v.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("config.json", '{"format": "something-else"}')
+        z.writestr("vocab.json", '{"total_word_count": 0, "words": []}')
+        z.writestr("tables.npz", b"")
+    with pytest.raises(ValueError, match="full word2vec"):
+        load_full_model(p)
