@@ -4,45 +4,19 @@ DataSet objects straight out of a bucket — and the export-based
 training path ``spark/data/BatchAndExportDataSetsFunction.java``,
 which writes minibatch files a cluster later trains from).
 
-Shards are npz files (features/labels + optional masks) — the same
-arrays ``datasets.api.DataSet`` holds; ``save_dataset_shards``
-produces them, ``CloudDataSetIterator`` streams them back from any
-``ObjectStore`` backend. Together with ``parallel.cluster``'s
-``fit_paths`` analog this closes the loop the reference runs over S3:
-export minibatches once, train many times from storage."""
+Shards use THE shard codec — ``DataSet.save_npz``/``load_npz``
+(``datasets/api.py``), shared with ``parallel.cluster``'s
+export-based path — so shards written by either path read back
+identically from the other. ``save_dataset_shards`` produces them,
+``CloudDataSetIterator`` streams them back from any ``ObjectStore``
+backend: export minibatches once, train many times from storage."""
 
 from __future__ import annotations
 
-import io
 from typing import List, Optional
-
-import numpy as np
 
 from deeplearning4j_tpu.cloud.storage import ObjectStore
 from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
-
-
-def _ds_to_bytes(ds: DataSet) -> bytes:
-    arrays = {"features": np.asarray(ds.features),
-              "labels": np.asarray(ds.labels)}
-    if ds.features_mask is not None:
-        arrays["features_mask"] = np.asarray(ds.features_mask)
-    if ds.labels_mask is not None:
-        arrays["labels_mask"] = np.asarray(ds.labels_mask)
-    buf = io.BytesIO()
-    np.savez(buf, **arrays)
-    return buf.getvalue()
-
-
-def _ds_from_bytes(data: bytes) -> DataSet:
-    z = np.load(io.BytesIO(data))
-    return DataSet(
-        features=z["features"], labels=z["labels"],
-        features_mask=z["features_mask"] if "features_mask" in z.files
-        else None,
-        labels_mask=z["labels_mask"] if "labels_mask" in z.files
-        else None,
-    )
 
 
 def save_dataset_shards(batches, store: ObjectStore,
@@ -52,7 +26,7 @@ def save_dataset_shards(batches, store: ObjectStore,
     keys = []
     for i, ds in enumerate(batches):
         key = f"{prefix}shard-{i:06d}.npz"
-        store.write(key, _ds_to_bytes(ds))
+        store.write(key, ds.to_npz_bytes())
         keys.append(key)
     return keys
 
@@ -80,7 +54,9 @@ class CloudDataSetIterator(DataSetIterator):
         self._first: Optional[DataSet] = None
 
     def next(self) -> DataSet:
-        ds = _ds_from_bytes(self.store.read(self._keys[self._pos]))
+        ds = DataSet.from_npz_bytes(
+            self.store.read(self._keys[self._pos])
+        )
         self._pos += 1
         if self._first is None:
             self._first = ds
@@ -94,7 +70,7 @@ class CloudDataSetIterator(DataSetIterator):
 
     def batch(self) -> int:
         if self._first is None:
-            self._first = _ds_from_bytes(
+            self._first = DataSet.from_npz_bytes(
                 self.store.read(self._keys[0])
             )
         return self._first.num_examples()

@@ -166,7 +166,11 @@ class ClusterSetup:
         out = []
         for env in envs:
             exports = " ".join(
-                f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+                # deliberately NOT shlex.quote'd: values are either
+                # host:port/ints (shell-safe by construction) or a
+                # ${VAR} placeholder the user substitutes when running
+                # the emitted plan — quoting would freeze the literal
+                f"{k}={v}" for k, v in sorted(env.items())
             )
             out.append(f"{exports} {self.train_command}")
         return out

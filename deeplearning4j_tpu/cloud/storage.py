@@ -50,19 +50,12 @@ class ObjectStore:
         for key in self.keys(prefix):
             yield self.open(key)
 
-    def paginate(self, listener, prefix: str = "",
-                 page_size: int = 1000) -> None:
-        """Page keys through ``listener(key)`` (reference
-        ``paginate:118`` + BucketKeyListener)."""
-        page: List[str] = []
+    def paginate(self, listener, prefix: str = "") -> None:
+        """Every key through ``listener(key)`` (reference
+        ``paginate:118`` + BucketKeyListener — whose S3 pages are an
+        API detail; the contract is per-key delivery in order)."""
         for key in self.keys(prefix):
-            page.append(key)
-            if len(page) >= page_size:
-                for k in page:
-                    listener(k)
-                page = []
-        for k in page:
-            listener(k)
+            listener(key)
 
 
 class LocalObjectStore(ObjectStore):
@@ -171,11 +164,22 @@ class GcsObjectStore(ObjectStore):
 
 def object_store_for(url: str) -> ObjectStore:
     """URL-dispatching constructor: ``s3://bucket``, ``gs://bucket``,
-    or a local path / ``file://`` directory."""
-    if url.startswith("s3://"):
-        return S3ObjectStore(url[5:].split("/", 1)[0])
-    if url.startswith("gs://"):
-        return GcsObjectStore(url[5:].split("/", 1)[0])
+    or a local path / ``file://`` directory. Bucket URLs must name
+    ONLY the bucket — a key prefix would be silently ignored by the
+    store, so it is rejected; pass prefixes to the key-taking APIs
+    (``keys(prefix)``, ``CloudDataSetIterator(prefix=...)``)."""
+    for scheme, cls in (("s3://", S3ObjectStore),
+                        ("gs://", GcsObjectStore)):
+        if url.startswith(scheme):
+            rest = url[len(scheme):]
+            bucket, _, suffix = rest.partition("/")
+            if suffix:
+                raise ValueError(
+                    f"{url!r} names a key prefix; use "
+                    f"{scheme}{bucket} and pass {suffix!r} as the "
+                    "prefix argument"
+                )
+            return cls(bucket)
     if url.startswith("file://"):
         url = url[7:]
     return LocalObjectStore(url)

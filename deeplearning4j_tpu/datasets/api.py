@@ -27,6 +27,47 @@ class DataSet:
     def num_examples(self) -> int:
         return int(self.features.shape[0])
 
+    # -- the ONE npz shard codec (export-based training + object-store
+    # shards share this format; reference BatchAndExportDataSetsFunction)
+
+    def save_npz(self, file) -> None:
+        """Write this minibatch as an npz shard (``file``: path or
+        file-like)."""
+        arrays = {"features": np.asarray(self.features),
+                  "labels": np.asarray(self.labels)}
+        if self.features_mask is not None:
+            arrays["features_mask"] = np.asarray(self.features_mask)
+        if self.labels_mask is not None:
+            arrays["labels_mask"] = np.asarray(self.labels_mask)
+        np.savez(file, **arrays)
+
+    def to_npz_bytes(self) -> bytes:
+        import io
+
+        buf = io.BytesIO()
+        self.save_npz(buf)
+        return buf.getvalue()
+
+    @classmethod
+    def load_npz(cls, file) -> "DataSet":
+        """Read a shard written by ``save_npz`` (path or file-like)."""
+        with np.load(file) as z:
+            return cls(
+                features=z["features"], labels=z["labels"],
+                features_mask=(
+                    z["features_mask"] if "features_mask" in z else None
+                ),
+                labels_mask=(
+                    z["labels_mask"] if "labels_mask" in z else None
+                ),
+            )
+
+    @classmethod
+    def from_npz_bytes(cls, data: bytes) -> "DataSet":
+        import io
+
+        return cls.load_npz(io.BytesIO(data))
+
     def split_test_and_train(self, n_train: int):
         return (
             DataSet(

@@ -408,15 +408,7 @@ def batch_and_export_datasets(iterator, export_dir: str,
     paths = []
     for i, ds in enumerate(iter(iterator)):
         path = os.path.join(export_dir, f"{prefix}_{i:06d}.npz")
-        arrays = {
-            "features": np.asarray(ds.features),
-            "labels": np.asarray(ds.labels),
-        }
-        if ds.features_mask is not None:
-            arrays["features_mask"] = np.asarray(ds.features_mask)
-        if ds.labels_mask is not None:
-            arrays["labels_mask"] = np.asarray(ds.labels_mask)
-        np.savez(path, **arrays)
+        ds.save_npz(path)
         paths.append(path)
     return paths
 
@@ -437,16 +429,7 @@ class PathDataSetIterator(DataSetIterator):
     def __next__(self) -> DataSet:
         if not self.has_next():
             raise StopIteration
-        with np.load(self.paths[self._pos]) as z:
-            ds = DataSet(
-                features=z["features"], labels=z["labels"],
-                features_mask=(
-                    z["features_mask"] if "features_mask" in z else None
-                ),
-                labels_mask=(
-                    z["labels_mask"] if "labels_mask" in z else None
-                ),
-            )
+        ds = DataSet.load_npz(self.paths[self._pos])
         self._pos += 1
         return ds
 

@@ -40,6 +40,49 @@ def rng():
     return np.random.RandomState(12345)
 
 
+def assert_params_match(net_a, net_b) -> None:
+    """Param-tree equality across two engines/paths: bitwise on the
+    CPU profile (identical programs -> identical bits), small-tolerance
+    on TPU, where two mathematically identical programs may fuse or
+    tile differently (and matmuls default to bf16-input precision), so
+    bit-equality is not the contract — numerical agreement is."""
+    import jax
+
+    tpu = jax.default_backend() == "tpu"
+    for ln in net_a.params:
+        for pn in net_a.params[ln]:
+            a = np.asarray(net_a.params[ln][pn])
+            b = np.asarray(net_b.params[ln][pn])
+            if tpu:
+                np.testing.assert_allclose(
+                    a, b, rtol=5e-3, atol=1e-5,
+                    err_msg=f"{ln}/{pn}",
+                )
+            else:
+                np.testing.assert_array_equal(a, b, err_msg=f"{ln}/{pn}")
+
+
+def pallas_interpret() -> bool:
+    """Pallas tests run interpret-mode on CPU and the REAL kernels on
+    the TPU profile (the point of the -P test-nd4j-cuda analog run)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def kernel_tols():
+    """(rtol, atol) for kernel-vs-reference comparisons: tight on CPU
+    (f32 throughout), bf16-scale on TPU, where the MXU truncates f32
+    matmul inputs to bf16 at default precision (eps ~7.8e-3) — for
+    both the kernel AND the XLA reference, in independently-rounded
+    ways."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return 2e-2, 8e-3
+    return 2e-4, 2e-5
+
+
 def require_devices(n: int) -> None:
     """Skip a multi-device test when the active backend has fewer
     devices (the TPU profile runs on one real chip; the CPU profile

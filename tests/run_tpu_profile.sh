@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# TPU-profile test run — the `-P test-nd4j-cuda-8.0` analog
+# (SURVEY.md §4): the same suite subset that exercises the Pallas
+# kernels / conv / rnn / transformer paths, on the REAL TPU backend
+# (Pallas compiled non-interpret; see tests/conftest.py
+# pallas_interpret()). Usage:  bash tests/run_tpu_profile.sh [outfile]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-artifacts/tpu_profile_run.log}"
+mkdir -p "$(dirname "$OUT")"
+{
+  echo "== TPU profile run: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  python - <<'PY'
+import jax
+d = jax.devices()[0]
+print(f"backend={jax.default_backend()} device={d.device_kind}")
+assert jax.default_backend() == "tpu", "TPU backend required"
+PY
+  DL4J_TPU_TEST_PLATFORM=tpu python -m pytest \
+    tests/test_pallas_ops.py tests/test_cnn.py tests/test_rnn.py \
+    tests/test_mlp.py tests/test_transformer.py \
+    tests/test_flops_and_device.py -q --no-header
+} 2>&1 | tee "$OUT"

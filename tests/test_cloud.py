@@ -76,7 +76,7 @@ def test_local_object_store_round_trip(tmp_path):
     assert store.keys("a/") == ["a/x.bin", "a/y.bin"]
     assert store.read("a/y.bin") == b"yy"
     seen = []
-    store.paginate(seen.append, prefix="a/", page_size=1)
+    store.paginate(seen.append, prefix="a/")
     assert seen == ["a/x.bin", "a/y.bin"]
     streams = list(store.iterate("b/"))
     assert streams[0].read() == b"zz"

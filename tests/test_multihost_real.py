@@ -22,6 +22,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
 import jax
 jax.config.update("jax_platforms", "cpu")
+# the env's sitecustomize may have initialized jax on the TPU plugin
+# already (see tests/conftest.py) — reset the backend registry so the
+# settings above take effect; libtpu is single-process, so two
+# children must NOT both grab the chip
+import jax.extend.backend as _jeb
+_jeb.clear_backends()
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except Exception:
+    pass
+_jeb.clear_backends()
 
 from deeplearning4j_tpu.parallel.mesh import (
     build_mesh, init_distributed, process_local_batch,

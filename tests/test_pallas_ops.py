@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import kernel_tols, pallas_interpret
 from deeplearning4j_tpu.ops.flash_attention import flash_attention
 from deeplearning4j_tpu.ops.lstm_cell import _reference_cell, lstm_cell
 from deeplearning4j_tpu.parallel.sequence import attention
@@ -22,10 +23,11 @@ class TestFlashAttention:
             for _ in range(3)
         )
         out = flash_attention(q, k, v, causal=causal, block_q=32,
-                              block_k=32, interpret=True)
+                              block_k=32, interpret=pallas_interpret())
         ref = attention(q, k, v, causal=causal)
+        rtol, atol = kernel_tols()
         np.testing.assert_allclose(
-            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+            np.asarray(out), np.asarray(ref), rtol=rtol, atol=atol
         )
 
     def test_single_block(self):
@@ -34,10 +36,11 @@ class TestFlashAttention:
             jnp.asarray(rng.randn(1, 1, 16, 8), jnp.float32)
             for _ in range(3)
         )
-        out = flash_attention(q, k, v, causal=True, interpret=True)
+        out = flash_attention(q, k, v, causal=True, interpret=pallas_interpret())
         ref = attention(q, k, v, causal=True)
+        rtol, atol = kernel_tols()
         np.testing.assert_allclose(
-            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+            np.asarray(out), np.asarray(ref), rtol=rtol, atol=atol
         )
 
     def test_indivisible_length_raises(self):
@@ -61,7 +64,7 @@ class TestLstmCellKernel:
                   for _ in range(3))
             if peephole else None
         )
-        h_new, c_new = lstm_cell(xproj, h, c, rw, peeps, interpret=True)
+        h_new, c_new = lstm_cell(xproj, h, c, rw, peeps, interpret=pallas_interpret())
         ref_peeps = (
             tuple(p.reshape(1, n) for p in peeps) if peeps else None
         )

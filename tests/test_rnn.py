@@ -3,6 +3,8 @@
 ``TestVariableLengthTS``)."""
 
 import numpy as np
+
+import conftest
 import pytest
 
 from deeplearning4j_tpu.datasets.api import DataSet
@@ -167,12 +169,7 @@ def test_tbptt_fused_matches_chunk_loop(rng):
         loop.fit(ds)
 
     assert fused.iteration_count == loop.iteration_count == 12
-    for ln in fused.params:
-        for pn in fused.params[ln]:
-            np.testing.assert_array_equal(
-                np.asarray(fused.params[ln][pn]),
-                np.asarray(loop.params[ln][pn]),
-            )
+    conftest.assert_params_match(fused, loop)
 
 
 def test_tbptt_fused_with_masks(rng):
@@ -192,12 +189,7 @@ def test_tbptt_fused_with_masks(rng):
     for _ in range(3):
         fused.fit(ds)
         loop.fit(ds)
-    for ln in fused.params:
-        for pn in fused.params[ln]:
-            np.testing.assert_array_equal(
-                np.asarray(fused.params[ln][pn]),
-                np.asarray(loop.params[ln][pn]),
-            )
+    conftest.assert_params_match(fused, loop)
 
 
 def test_tbptt_device_cached_epochs_match_streaming(rng):
