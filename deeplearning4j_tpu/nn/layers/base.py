@@ -177,6 +177,13 @@ class LayerSpec:
         RNNs) and therefore cannot be used with rnn_time_step."""
         return True
 
+    def uses_batch_statistics(self) -> bool:
+        """True for layers whose TRAINING math couples examples across
+        the batch (BatchNormalization): under data parallelism these
+        decide sync-vs-local batch stats (see
+        ``parallel.trainer.DistributedTrainer``)."""
+        return False
+
     # -- helpers -----------------------------------------------------------
 
     def activate_fn(self):

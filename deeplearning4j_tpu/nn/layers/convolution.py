@@ -95,14 +95,32 @@ class ConvolutionLayer(LayerSpec):
         return {"W": w, "b": b}
 
     def pre_output(self, params, x):
+        from deeplearning4j_tpu.ops.dispatch import effective_platform
+
         sh, sw = _pair(self.stride)
         ph, pw = _pair(self.padding)
-        y = lax.conv_general_dilated(
-            x, params["W"],
-            window_strides=(sh, sw),
-            padding=((ph, ph), (pw, pw)),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        )
+        if effective_platform() == "tpu":
+            # TPU: XLA relayouts freely; NCHW and NHWC compile to the
+            # same MXU convolutions (measured equal)
+            y = lax.conv_general_dilated(
+                x, params["W"],
+                window_strides=(sh, sw),
+                padding=((ph, ph), (pw, pw)),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            )
+        else:
+            # CPU: XLA's fast (Eigen) conv kernels exist ONLY for
+            # NHWC — the NCHW lowering is a naive loop, measured 38x
+            # slower at ResNet shapes. The API stays NCHW (reference
+            # parity); the transposes fuse into the surrounding ops.
+            y = lax.conv_general_dilated(
+                jnp.transpose(x, (0, 2, 3, 1)),
+                jnp.transpose(params["W"], (2, 3, 1, 0)),  # OIHW->HWIO
+                window_strides=(sh, sw),
+                padding=((ph, ph), (pw, pw)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            y = jnp.transpose(y, (0, 3, 1, 2))
         return y + params["b"].reshape(1, -1, 1, 1)
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
@@ -189,6 +207,9 @@ class BatchNormalization(LayerSpec):
 
     def regularizable_params(self) -> tuple:
         return ()  # reference: gamma/beta not regularized
+
+    def uses_batch_statistics(self) -> bool:
+        return True
 
     def init_params(self, key, dtype=jnp.float32) -> dict:
         if self.lock_gamma_beta:

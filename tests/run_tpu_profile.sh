@@ -4,18 +4,22 @@
 # kernels / conv / rnn / transformer paths, on the REAL TPU backend
 # (Pallas compiled non-interpret; see tests/conftest.py
 # pallas_interpret()). Usage:  bash tests/run_tpu_profile.sh [outfile]
-set -uo pipefail
+set -euo pipefail
 cd "$(dirname "$0")/.."
 OUT="${1:-artifacts/tpu_profile_run.log}"
 mkdir -p "$(dirname "$OUT")"
-{
-  echo "== TPU profile run: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
-  python - <<'PY'
+# hard gate OUTSIDE the logged group: on a non-TPU host the suite
+# would silently run Pallas in interpret mode and write an artifact
+# that looks like a TPU run
+python - <<'PY'
 import jax
 d = jax.devices()[0]
 print(f"backend={jax.default_backend()} device={d.device_kind}")
 assert jax.default_backend() == "tpu", "TPU backend required"
 PY
+{
+  echo "== TPU profile run: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  python -c "import jax; d=jax.devices()[0]; print(f'backend={jax.default_backend()} device={d.device_kind}')"
   DL4J_TPU_TEST_PLATFORM=tpu python -m pytest \
     tests/test_pallas_ops.py tests/test_cnn.py tests/test_rnn.py \
     tests/test_mlp.py tests/test_transformer.py \
