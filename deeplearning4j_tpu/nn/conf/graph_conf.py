@@ -116,15 +116,20 @@ class LayerVertex(GraphVertexSpec):
         return self.layer_conf.init_state(dtype)
 
     def apply(self, params, inputs, state, *, train=False, rng=None,
-              mask=None):
+              mask=None, ctx: Optional[ShapeContext] = None):
         if len(inputs) != 1:
             raise ValueError("LayerVertex expects exactly one input")
         x = inputs[0]
         if self.preprocessor is not None:
-            t = x.shape[2] if x.ndim == 3 else -1
-            x = self.preprocessor.preprocess(
-                x, ShapeContext(batch=x.shape[0], time=t)
-            )
+            # ``ctx`` is the engine-global shape context (original
+            # minibatch batch/time) — a vertex's own input may already
+            # be flattened to [b*t, f], from which neither batch nor
+            # time is recoverable (MultiLayerNetwork threads its ctx
+            # from the original input the same way)
+            if ctx is None:
+                t = x.shape[2] if x.ndim == 3 else -1
+                ctx = ShapeContext(batch=x.shape[0], time=t)
+            x = self.preprocessor.preprocess(x, ctx)
         return self.layer_conf.apply(
             params, x, state, train=train, rng=rng, mask=mask
         )

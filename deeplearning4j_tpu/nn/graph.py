@@ -65,6 +65,7 @@ class ComputationGraph:
         self._jit_output = None
         self._jit_rnn_step = None
         self._rnn_state: Dict[str, Any] = {}  # streaming rnnTimeStep
+        self._stream_steps = 0  # timesteps consumed vs finite caches
         self._jit_pretrain_steps: Dict[str, Any] = {}
         self._jit_pretrain_inputs: Dict[str, Any] = {}
         # device-resident scan constants (see multilayer._scan_consts)
@@ -145,6 +146,16 @@ class ComputationGraph:
                     None if m is None else _cast_floats(m, cdt)
                     for m in fmasks
                 ]
+        # engine-global shape context for preprocessors: batch/time of
+        # the ORIGINAL minibatch (vertex-local inputs may be flattened)
+        from deeplearning4j_tpu.nn.conf.preprocessors import ShapeContext
+
+        g_time = max(
+            (int(x.shape[2]) for x in inputs if x.ndim == 3), default=-1
+        )
+        gctx = ShapeContext(
+            batch=int(inputs[0].shape[0]) if inputs else 0, time=g_time
+        )
         values: Dict[str, Any] = dict(zip(conf.inputs, inputs))
         masks: Dict[str, Any] = {}
         if fmasks is not None:
@@ -181,6 +192,10 @@ class ComputationGraph:
                 out, st = v.apply(vparams, vin, vstate, train=train,
                                   rng=lrng, mask=m)
                 vmask[name] = None  # time axis collapsed
+            elif isinstance(v, LayerVertex):
+                out, st = v.apply(vparams, vin, vstate, train=train,
+                                  rng=lrng, mask=mask, ctx=gctx)
+                vmask[name] = mask
             else:
                 out, st = v.apply(vparams, vin, vstate, train=train,
                                   rng=lrng, mask=mask)
@@ -191,13 +206,7 @@ class ComputationGraph:
                 if name in conf.outputs and layer.has_loss():
                     x = vin[0]
                     if v.preprocessor is not None:
-                        from deeplearning4j_tpu.nn.conf.preprocessors import (
-                            ShapeContext,
-                        )
-                        t = x.shape[2] if x.ndim == 3 else -1
-                        x = v.preprocessor.preprocess(
-                            x, ShapeContext(batch=x.shape[0], time=t)
-                        )
+                        x = v.preprocessor.preprocess(x, gctx)
                     x = layer.maybe_dropout(x, train=train, rng=lrng)
                     preouts[name] = layer.pre_output(params[name], x)
             values[name] = out
@@ -821,6 +830,33 @@ class ComputationGraph:
         was_2d = [x.ndim == 2 for x in arr]
         squeeze = bool(arr) and all(was_2d)
         arr = [x[:, :, None] if w else x for x, w in zip(arr, was_2d)]
+        t_new = max(
+            (int(x.shape[2]) for x in arr if x.ndim == 3), default=1
+        )
+        # finite streaming buffers (KV caches) must not silently wrap
+        caps = [
+            self.conf.vertices[n].layer_conf.stream_capacity()
+            for n in self.layer_vertex_names
+            if self.conf.vertices[n].layer_conf.streams_state()
+            and self.conf.vertices[n].layer_conf.stream_capacity()
+        ]
+        if caps and self._stream_steps + t_new > min(caps):
+            raise ValueError(
+                f"rnn_time_step overflow: {self._stream_steps} + "
+                f"{t_new} timesteps exceeds the smallest streaming "
+                f"cache ({min(caps)}); raise kv_cache or call "
+                "rnn_clear_previous_state()"
+            )
+        # prime streaming state (zero caches / carries) on first use
+        batch = int(arr[0].shape[0]) if arr else 1
+        for n in self.layer_vertex_names:
+            lc = self.conf.vertices[n].layer_conf
+            if (
+                lc.streams_state()
+                and n not in self._rnn_state
+                and getattr(lc, "init_stream_state", None) is not None
+            ):
+                self._rnn_state[n] = lc.init_stream_state(batch, dtype)
         merged = dict(self.state)
         for name, carry in self._rnn_state.items():
             merged[name] = {**merged.get(name, {}), **carry}
@@ -833,17 +869,21 @@ class ComputationGraph:
             self._jit_rnn_step = jax.jit(rnn_step)
         outs, new_state = self._jit_rnn_step(self.params, merged, arr)
         for n in self.layer_vertex_names:
-            if self.conf.vertices[n].layer_conf.is_recurrent():
+            lc = self.conf.vertices[n].layer_conf
+            if lc.streams_state():
                 self._rnn_state[n] = {
-                    k: new_state[n][k] for k in ("h", "c")
+                    k: new_state[n][k]
+                    for k in lc.stream_state_keys()
                     if k in new_state[n]
                 }
+        self._stream_steps += t_new
         return [o[:, :, 0] if squeeze and o.ndim == 3 else o
                 for o in outs]
 
     def rnn_clear_previous_state(self) -> None:
         """Reference ``rnnClearPreviousState``."""
         self._rnn_state = {}
+        self._stream_steps = 0
 
     def score(self, ds) -> float:
         dtype = self._dtype()

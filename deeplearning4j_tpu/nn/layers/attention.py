@@ -44,9 +44,37 @@ class MultiHeadSelfAttention(LayerSpec):
     # layer computes ring attention instead of local attention
     seq_axis: str = ""
     seq_axis_size: int = 0
+    # max total timesteps for incremental decoding (the rnnTimeStep
+    # analog): the KV cache is a fixed [b, h, kv_cache, hd] buffer so
+    # streaming stays jit-static
+    kv_cache: int = 1024
 
     def input_kind(self) -> str:
         return "recurrent"
+
+    # -- streaming (rnn_time_step) contract -----------------------------
+
+    def streams_state(self) -> bool:
+        return True
+
+    def can_stream(self) -> bool:
+        # a non-causal layer needs future timesteps — cannot stream
+        return self.causal
+
+    def stream_state_keys(self) -> tuple:
+        return ("k_cache", "v_cache", "pos")
+
+    def stream_capacity(self):
+        return self.kv_cache
+
+    def init_stream_state(self, batch: int, dtype) -> dict:
+        hd = self._head_dim()
+        shape = (batch, self.n_heads, self.kv_cache, hd)
+        return {
+            "k_cache": jnp.zeros(shape, dtype),
+            "v_cache": jnp.zeros(shape, dtype),
+            "pos": jnp.zeros((), jnp.int32),
+        }
 
     def with_input_type(self, it: InputType) -> "MultiHeadSelfAttention":
         changes = {}
@@ -103,6 +131,36 @@ class MultiHeadSelfAttention(LayerSpec):
         q, k, v = heads(params["Wq"]), heads(params["Wk"]), heads(
             params["Wv"]
         )
+        if "k_cache" in state:
+            # incremental decode: append this chunk's K/V to the cache
+            # and attend over the filled prefix (fixed cache shape ->
+            # jit-static; reference analog: rnnTimeStep's stateMap)
+            from jax import lax as _lax
+
+            pos = state["pos"]
+            kc = _lax.dynamic_update_slice(
+                state["k_cache"], k.astype(state["k_cache"].dtype),
+                (0, 0, pos, 0),
+            )
+            vc = _lax.dynamic_update_slice(
+                state["v_cache"], v.astype(state["v_cache"].dtype),
+                (0, 0, pos, 0),
+            )
+            scale = 1.0 / jnp.sqrt(jnp.asarray(hd, q.dtype))
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, kc) * scale
+            key_idx = jnp.arange(self.kv_cache)[None, None, None, :]
+            q_idx = (pos + jnp.arange(t))[None, None, :, None]
+            s = jnp.where(key_idx <= q_idx, s, -1e9)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+            new_state = {
+                **state, "k_cache": kc, "v_cache": vc,
+                "pos": pos + t,
+            }
+            o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, t, h * hd)
+            y = o @ params["Wo"] + params["bo"]
+            y = self.activate_fn()(y)
+            return jnp.transpose(y, (0, 2, 1)), new_state
         if self.seq_axis and self.seq_axis_size > 1:
             o = ring_attention(
                 q, k, v, axis_name=self.seq_axis,
@@ -143,6 +201,7 @@ class TransformerBlock(LayerSpec):
     activation: str = "identity"
     seq_axis: str = ""
     seq_axis_size: int = 0
+    kv_cache: int = 1024  # incremental-decode cache (see MHSA)
 
     def input_kind(self) -> str:
         return "recurrent"
@@ -174,9 +233,27 @@ class TransformerBlock(LayerSpec):
         return MultiHeadSelfAttention(
             n_in=self.n_in, n_out=self.n_in, n_heads=self.n_heads,
             causal=self.causal, seq_axis=self.seq_axis,
-            seq_axis_size=self.seq_axis_size,
+            seq_axis_size=self.seq_axis_size, kv_cache=self.kv_cache,
             weight_init=self.weight_init, dist=self.dist,
         )
+
+    # -- streaming (rnn_time_step) contract: delegate to the attention
+    # sublayer (LN/FFN are per-position and carry nothing)
+
+    def streams_state(self) -> bool:
+        return True
+
+    def can_stream(self) -> bool:
+        return self.causal
+
+    def stream_state_keys(self) -> tuple:
+        return ("k_cache", "v_cache", "pos")
+
+    def stream_capacity(self):
+        return self.kv_cache
+
+    def init_stream_state(self, batch: int, dtype) -> dict:
+        return self._attn().init_stream_state(batch, dtype)
 
     def _ln(self) -> "LayerNormalization":
         return LayerNormalization(n_out=self.n_in)
@@ -226,13 +303,14 @@ class TransformerBlock(LayerSpec):
     def apply(self, params, x, state, *, train=False, rng=None,
               mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
-        # attention sublayer (pre-norm)
+        # attention sublayer (pre-norm); streaming KV-cache state (if
+        # any) passes through to the attention and back out
         h1 = self._layernorm(x, params["ln1_gamma"], params["ln1_beta"])
         attn_params = {
             k: params[k] for k in ("Wq", "Wk", "Wv", "Wo", "bo")
         }
-        a, _ = self._attn().apply(
-            attn_params, h1, {}, train=False, rng=None, mask=mask
+        a, state = self._attn().apply(
+            attn_params, h1, state, train=False, rng=None, mask=mask
         )
         x = x + a
         # FFN / MoE sublayer (pre-norm)
@@ -325,9 +403,25 @@ class PositionalEncoding(LayerSpec):
     def input_kind(self) -> str:
         return "recurrent"
 
+    # -- streaming: carry the absolute position offset ------------------
+
+    def streams_state(self) -> bool:
+        return True
+
+    def stream_state_keys(self) -> tuple:
+        return ("pos",)
+
+    def init_stream_state(self, batch: int, dtype) -> dict:
+        return {"pos": jnp.zeros((), jnp.int32)}
+
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         n, t = x.shape[1], x.shape[2]
-        pos = jnp.arange(t, dtype=x.dtype)
+        if "pos" in state:
+            off = state["pos"]
+            pos = (off + jnp.arange(t)).astype(x.dtype)
+            state = {**state, "pos": off + t}
+        else:
+            pos = jnp.arange(t, dtype=x.dtype)
         i = jnp.arange(n)
         freq = jnp.asarray(self.max_wavelength, x.dtype) ** (
             -((i // 2) * 2 / n).astype(x.dtype)

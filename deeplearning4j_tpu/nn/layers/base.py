@@ -184,6 +184,22 @@ class LayerSpec:
         ``parallel.trainer.DistributedTrainer``)."""
         return False
 
+    def streams_state(self) -> bool:
+        """True for layers that carry state across ``rnn_time_step``
+        calls: recurrent layers (h/c) and attention layers (KV cache).
+        Distinct from ``is_recurrent`` — attention layers stream at
+        inference but train with whole-sequence scan fusion."""
+        return self.is_recurrent()
+
+    def stream_state_keys(self) -> tuple:
+        """State-dict keys ``rnn_time_step`` carries across calls."""
+        return ("h", "c")
+
+    def stream_capacity(self):
+        """Max total timesteps this layer can stream (None =
+        unbounded; recurrent carry is O(1)). KV caches are finite."""
+        return None
+
     # -- helpers -----------------------------------------------------------
 
     def activate_fn(self):

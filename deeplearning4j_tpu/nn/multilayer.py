@@ -235,6 +235,7 @@ class MultiLayerNetwork:
         self._last_score = float("nan")
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep state
+        self._stream_steps = 0  # timesteps consumed vs finite caches
         self._jit_step = None
         self._jit_multi_step = None
         self._jit_tbptt_multi_step = None
@@ -1241,9 +1242,12 @@ class MultiLayerNetwork:
     # -- streaming RNN inference (reference rnnTimeStep:2290) -----------
 
     def rnn_time_step(self, x):
-        """Feed one (or a few) timesteps, carrying recurrent state
+        """Feed one (or a few) timesteps, carrying streaming state
         across calls (reference ``rnnTimeStep``; state in
-        ``stateMap``). Input [b, size] or [b, size, t]."""
+        ``stateMap``). Input [b, size] or [b, size, t]. Recurrent
+        layers carry h/c; attention layers carry a fixed-size KV
+        cache (incremental decoding — the transformer analog of the
+        reference's char-RNN sampling loop)."""
         if self.params is None:
             self.init()
         for name, layer in zip(self.layer_names, self.conf.layers):
@@ -1258,6 +1262,31 @@ class MultiLayerNetwork:
         squeeze = x.ndim == 2
         if squeeze:
             x = x[:, :, None]
+        t_new = int(x.shape[2])
+        # finite streaming buffers (KV caches) must not silently wrap:
+        # track consumed timesteps host-side against the tightest cap
+        caps = [
+            layer.stream_capacity()
+            for layer in self.conf.layers
+            if layer.streams_state() and layer.stream_capacity()
+        ]
+        if caps and self._stream_steps + t_new > min(caps):
+            raise ValueError(
+                f"rnn_time_step overflow: {self._stream_steps} + "
+                f"{t_new} timesteps exceeds the smallest streaming "
+                f"cache ({min(caps)}); raise kv_cache or call "
+                "rnn_clear_previous_state()"
+            )
+        # prime streaming state on first use (zero caches / carries)
+        for name, layer in zip(self.layer_names, self.conf.layers):
+            if (
+                layer.streams_state()
+                and name not in self._rnn_state
+                and getattr(layer, "init_stream_state", None) is not None
+            ):
+                self._rnn_state[name] = layer.init_stream_state(
+                    int(x.shape[0]), dtype
+                )
         merged = dict(self.state)
         for name, carry in self._rnn_state.items():
             merged[name] = {**merged.get(name, {}), **carry}
@@ -1270,16 +1299,19 @@ class MultiLayerNetwork:
             self._jit_rnn_step = jax.jit(rnn_step)
         out, new_state = self._jit_rnn_step(self.params, merged, x)
         for name, layer in zip(self.layer_names, self.conf.layers):
-            if layer.is_recurrent():
+            if layer.streams_state():
                 self._rnn_state[name] = {
-                    k: new_state[name][k] for k in ("h", "c")
+                    k: new_state[name][k]
+                    for k in layer.stream_state_keys()
                     if k in new_state[name]
                 }
+        self._stream_steps += t_new
         return out[:, :, 0] if squeeze else out
 
     def rnn_clear_previous_state(self) -> None:
         """Reference ``rnnClearPreviousState``."""
         self._rnn_state = {}
+        self._stream_steps = 0
 
     def predict(self, x) -> np.ndarray:
         """Argmax class predictions (reference ``predict``)."""

@@ -87,6 +87,12 @@ class DistributedTrainer:
             raise ValueError(
                 f"batch_stats must be auto|sync|local, got {batch_stats!r}"
             )
+        if batch_stats == "local" and tensor_parallel:
+            raise ValueError(
+                "batch_stats='local' is incompatible with "
+                "tensor_parallel=True: sharded weights need the GSPMD "
+                "step, which computes global (sync) batch statistics"
+            )
         self.model = model
         self.mesh = mesh if mesh is not None else build_mesh()
         self.tensor_parallel = tensor_parallel

@@ -73,6 +73,22 @@ def main():
     acc = (out.argmax(1) == np.asarray(sample.labels).argmax(1)).mean()
     print(f"next-char accuracy: {acc:.3f}")
 
+    # incremental decoding through the KV cache (rnn_time_step — the
+    # same sampling loop examples/char_rnn.py runs on the LSTM)
+    net.rnn_clear_previous_state()
+    seed_text = "to be or not to "
+    for c in seed_text[:-1]:
+        net.rnn_time_step(np.eye(v, dtype=np.float32)[[idx[c]]])
+    cur = idx[seed_text[-1]]
+    generated = []
+    for _ in range(60):
+        probs = np.asarray(
+            net.rnn_time_step(np.eye(v, dtype=np.float32)[[cur]])
+        )[0]
+        cur = int(probs.argmax())
+        generated.append(chars[cur])
+    print("greedy continuation:", seed_text + "".join(generated))
+
 
 if __name__ == "__main__":
     main()
