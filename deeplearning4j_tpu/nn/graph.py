@@ -833,30 +833,19 @@ class ComputationGraph:
         t_new = max(
             (int(x.shape[2]) for x in arr if x.ndim == 3), default=1
         )
-        # finite streaming buffers (KV caches) must not silently wrap
-        caps = [
-            self.conf.vertices[n].layer_conf.stream_capacity()
+        from deeplearning4j_tpu.nn.multilayer import (
+            _extract_stream_state,
+            _stream_guard_and_prime,
+        )
+
+        named = [
+            (n, self.conf.vertices[n].layer_conf)
             for n in self.layer_vertex_names
-            if self.conf.vertices[n].layer_conf.streams_state()
-            and self.conf.vertices[n].layer_conf.stream_capacity()
         ]
-        if caps and self._stream_steps + t_new > min(caps):
-            raise ValueError(
-                f"rnn_time_step overflow: {self._stream_steps} + "
-                f"{t_new} timesteps exceeds the smallest streaming "
-                f"cache ({min(caps)}); raise kv_cache or call "
-                "rnn_clear_previous_state()"
-            )
-        # prime streaming state (zero caches / carries) on first use
-        batch = int(arr[0].shape[0]) if arr else 1
-        for n in self.layer_vertex_names:
-            lc = self.conf.vertices[n].layer_conf
-            if (
-                lc.streams_state()
-                and n not in self._rnn_state
-                and getattr(lc, "init_stream_state", None) is not None
-            ):
-                self._rnn_state[n] = lc.init_stream_state(batch, dtype)
+        _stream_guard_and_prime(
+            named, self._rnn_state, self._stream_steps, t_new,
+            int(arr[0].shape[0]) if arr else 1, dtype,
+        )
         merged = dict(self.state)
         for name, carry in self._rnn_state.items():
             merged[name] = {**merged.get(name, {}), **carry}
@@ -868,14 +857,7 @@ class ComputationGraph:
                 return [values[n] for n in self.conf.outputs], new_state
             self._jit_rnn_step = jax.jit(rnn_step)
         outs, new_state = self._jit_rnn_step(self.params, merged, arr)
-        for n in self.layer_vertex_names:
-            lc = self.conf.vertices[n].layer_conf
-            if lc.streams_state():
-                self._rnn_state[n] = {
-                    k: new_state[n][k]
-                    for k in lc.stream_state_keys()
-                    if k in new_state[n]
-                }
+        _extract_stream_state(named, new_state, self._rnn_state)
         self._stream_steps += t_new
         return [o[:, :, 0] if squeeze and o.ndim == 3 else o
                 for o in outs]

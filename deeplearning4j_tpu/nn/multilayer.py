@@ -195,6 +195,44 @@ def _note_it0(model, it0_dev, host_value: int) -> None:
     model._it0_shadow = host_value
 
 
+def _stream_guard_and_prime(named_layers, rnn_state, stream_steps,
+                            t_new, batch, dtype) -> None:
+    """Shared ``rnn_time_step`` bookkeeping for both engines: raise
+    before a finite streaming cache (KV) would silently wrap, and
+    prime missing streaming state (zero caches / carries).
+    ``named_layers``: (name, layer_conf) pairs."""
+    caps = [
+        lc.stream_capacity() for _, lc in named_layers
+        if lc.streams_state() and lc.stream_capacity()
+    ]
+    if caps and stream_steps + t_new > min(caps):
+        raise ValueError(
+            f"rnn_time_step overflow: {stream_steps} + {t_new} "
+            f"timesteps exceeds the smallest streaming cache "
+            f"({min(caps)}); raise kv_cache or call "
+            "rnn_clear_previous_state()"
+        )
+    for name, lc in named_layers:
+        if (
+            lc.streams_state()
+            and name not in rnn_state
+            and getattr(lc, "init_stream_state", None) is not None
+        ):
+            rnn_state[name] = lc.init_stream_state(batch, dtype)
+
+
+def _extract_stream_state(named_layers, new_state, rnn_state) -> None:
+    """Pull each streaming layer's carry keys out of the step's state
+    into the host-held ``rnn_state`` (the reference's stateMap)."""
+    for name, lc in named_layers:
+        if lc.streams_state():
+            rnn_state[name] = {
+                k: new_state[name][k]
+                for k in lc.stream_state_keys()
+                if k in new_state[name]
+            }
+
+
 def _reg_penalty(layer, layer_params):
     """L1/L2 penalty for one layer (reference calcL1/calcL2)."""
     reg = 0.0
@@ -1263,30 +1301,11 @@ class MultiLayerNetwork:
         if squeeze:
             x = x[:, :, None]
         t_new = int(x.shape[2])
-        # finite streaming buffers (KV caches) must not silently wrap:
-        # track consumed timesteps host-side against the tightest cap
-        caps = [
-            layer.stream_capacity()
-            for layer in self.conf.layers
-            if layer.streams_state() and layer.stream_capacity()
-        ]
-        if caps and self._stream_steps + t_new > min(caps):
-            raise ValueError(
-                f"rnn_time_step overflow: {self._stream_steps} + "
-                f"{t_new} timesteps exceeds the smallest streaming "
-                f"cache ({min(caps)}); raise kv_cache or call "
-                "rnn_clear_previous_state()"
-            )
-        # prime streaming state on first use (zero caches / carries)
-        for name, layer in zip(self.layer_names, self.conf.layers):
-            if (
-                layer.streams_state()
-                and name not in self._rnn_state
-                and getattr(layer, "init_stream_state", None) is not None
-            ):
-                self._rnn_state[name] = layer.init_stream_state(
-                    int(x.shape[0]), dtype
-                )
+        named = list(zip(self.layer_names, self.conf.layers))
+        _stream_guard_and_prime(
+            named, self._rnn_state, self._stream_steps, t_new,
+            int(x.shape[0]), dtype,
+        )
         merged = dict(self.state)
         for name, carry in self._rnn_state.items():
             merged[name] = {**merged.get(name, {}), **carry}
@@ -1298,13 +1317,7 @@ class MultiLayerNetwork:
                 return out, new_state
             self._jit_rnn_step = jax.jit(rnn_step)
         out, new_state = self._jit_rnn_step(self.params, merged, x)
-        for name, layer in zip(self.layer_names, self.conf.layers):
-            if layer.streams_state():
-                self._rnn_state[name] = {
-                    k: new_state[name][k]
-                    for k in layer.stream_state_keys()
-                    if k in new_state[name]
-                }
+        _extract_stream_state(named, new_state, self._rnn_state)
         self._stream_steps += t_new
         return out[:, :, 0] if squeeze else out
 

@@ -79,11 +79,33 @@ def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     o_ref[0, :, :] = (o / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
 
 
+# above this many K/V ELEMENTS (t*d) per head the whole-K/V-in-VMEM
+# kernel would overflow VMEM (two t*d arrays + q/out blocks vs ~16MB);
+# the blocked-grid kernel streams K/V instead. 512k elements = 2MB
+# bf16 / 4MB f32 per array — comfortable with headroom.
+_RESIDENT_TD_LIMIT = 8192 * 64
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = 128, block_k: int = 128,
                     interpret: bool = False):
     """q/k/v: [b, h, t, d] → [b, h, t, d]. t must divide by the block
-    sizes after clamping (blocks clamp to t when t is smaller)."""
+    sizes after clamping (blocks clamp to t when t is smaller).
+
+    Two schedules behind one entry point:
+    - t*d <= ~512k elements: K/V live in VMEM per (bh, q-block)
+      program and a fori_loop walks them (skipping fully-masked
+      blocks when causal).
+    - larger: the grid gains a k-block axis and K/V stream through
+      VMEM block-by-block with the online-softmax accumulator in
+      scratch — HBM-resident K/V, so FORWARD sequence length is
+      bounded by HBM, not VMEM (long-context single-chip inference).
+
+    Training at such lengths should shard the sequence instead (ring
+    attention, ``parallel.sequence``): the differentiable wrapper's
+    backward recomputes through the XLA reference attention, which
+    materializes the [t, t] score matrix.
+    """
     b, h, t, d = q.shape
     block_q = min(block_q, t)
     block_k = min(block_k, t)
@@ -96,26 +118,114 @@ def flash_attention(q, k, v, causal: bool = False,
     qr = q.reshape(b * h, t, d)
     kr = k.reshape(b * h, t, d)
     vr = v.reshape(b * h, t, d)
+    if t * d <= _RESIDENT_TD_LIMIT:
+        kernel = functools.partial(
+            _attention_kernel, block_k=block_k, causal=causal,
+            scale=scale,
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid=(b * h, t // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, d), lambda i, j: (i, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            interpret=interpret,
+        )(qr, kr, vr)
+        return out.reshape(b, h, t, d)
     kernel = functools.partial(
-        _attention_kernel, block_k=block_k, causal=causal, scale=scale,
+        _attention_kernel_streamed, block_q=block_q, block_k=block_k,
+        n_k=t // block_k, causal=causal, scale=scale,
     )
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, t // block_q),
+        # k-blocks innermost: the scratch accumulator carries across
+        # them and flushes on the last one
+        grid=(b * h, t // block_q, t // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda i, j, kk: (i, j, 0),
+            memory_space=pltpu.VMEM,
+        ),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(b, h, t, d)
+
+
+def _attention_kernel_streamed(q_ref, k_ref, v_ref, o_ref, acc, l, m,
+                               *, block_q: int, block_k: int, n_k: int,
+                               causal: bool, scale: float):
+    """One program = one (bh, q-block, k-block) grid cell; the online
+    softmax state lives in VMEM scratch across the k axis."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        l[...] = jnp.zeros_like(l)
+        m[...] = jnp.full_like(m, 2.0 * _NEG)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _step():
+        q = q_ref[0, :, :].astype(jnp.float32) * scale
+        k_blk = k_ref[0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, :, :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0
+            )
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        m_prev = m[...]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l[...] = l[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[...] = acc[...] * corr + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        m[...] = m_new
+
+    if causal:
+        # skip k-blocks strictly after this q-block (fully masked)
+        pl.when(k_start <= q_start + block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(ki == n_k - 1)
+    def _flush():
+        o_ref[0, :, :] = (
+            acc[...] / jnp.maximum(l[...], 1e-20)
+        ).astype(o_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
