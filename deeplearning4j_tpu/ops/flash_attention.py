@@ -98,13 +98,13 @@ def flash_attention(q, k, v, causal: bool = False,
       blocks when causal).
     - larger: the grid gains a k-block axis and K/V stream through
       VMEM block-by-block with the online-softmax accumulator in
-      scratch — HBM-resident K/V, so FORWARD sequence length is
-      bounded by HBM, not VMEM (long-context single-chip inference).
-
-    Training at such lengths should shard the sequence instead (ring
-    attention, ``parallel.sequence``): the differentiable wrapper's
-    backward recomputes through the XLA reference attention, which
-    materializes the [t, t] score matrix.
+      scratch — HBM-resident K/V, so sequence length is bounded by
+      HBM, not VMEM. The matching backward
+      (``_blockwise_attention_bwd``) scans K/V blocks the same way,
+      so long-context TRAINING never materializes [t, t] either
+      (verified: t=16k causal train steps on one v5e). Beyond one
+      chip's HBM/FLOPs, shard the sequence with ring attention
+      (``parallel.sequence``).
     """
     b, h, t, d = q.shape
     block_q = min(block_q, t)
@@ -230,21 +230,26 @@ def _attention_kernel_streamed(q_ref, k_ref, v_ref, o_ref, acc, l, m,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_diff(q, k, v, causal):
-    """Differentiable wrapper: Pallas forward, XLA-recompute backward
-    (``pallas_call`` has no automatic transpose; the backward re-runs
-    the reference attention under ``jax.vjp`` — same trade flash
-    attention makes anyway: recompute over materialize)."""
+    """Differentiable wrapper: Pallas forward; backward is the XLA
+    reference recompute at short sequences (cheapest to compile) and
+    the blockwise flash backward beyond the VMEM-residency bound —
+    O(t*block) memory instead of the [t, t] score matrix, so
+    long-context TRAINING is HBM-bound like the forward."""
     return flash_attention(q, k, v, causal=causal)
 
 
 def _flash_fwd(q, k, v, causal):
-    return flash_attention(q, k, v, causal=causal), (q, k, v)
+    out = flash_attention(q, k, v, causal=causal)
+    return out, (q, k, v, out)
 
 
 def _flash_bwd(causal, res, g):
+    q, k, v, out = res
+    t, d = q.shape[2], q.shape[3]
+    if t * d > _RESIDENT_TD_LIMIT:
+        return _blockwise_attention_bwd(q, k, v, out, g, causal)
     from deeplearning4j_tpu.parallel.sequence import attention
 
-    q, k, v = res
     _, vjp = jax.vjp(
         lambda q_, k_, v_: attention(q_, k_, v_, causal=causal), q, k, v
     )
@@ -252,6 +257,99 @@ def _flash_bwd(causal, res, g):
 
 
 _flash_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _blockwise_attention_bwd(q, k, v, out, do, causal,
+                             block_k: int = 512):
+    """Flash-attention backward as a ``lax.scan`` over K/V blocks
+    (Dao et al. 2022, in XLA rather than Pallas): per block it
+    rebuilds P_b = exp(QK_b^T*scale - L) from a first logsumexp pass,
+    then dV_b = P_b^T dO, dS_b = P_b*(dO V_b^T - D), dQ += dS_b K_b,
+    dK_b = dS_b^T Q. Peak live memory is O(t*block_k) — the [t, t]
+    matrix never materializes.
+
+    Known (accepted) inefficiencies vs a fully tuned flash backward:
+    the logsumexp is recomputed with one extra QK^T sweep (the
+    forward kernel does not return its l/m scratch), and the causal
+    path still computes fully-masked key blocks (a scan has static
+    per-iteration shapes) — both trade FLOPs, never memory."""
+    b, h, t, d = q.shape
+    block_k = min(block_k, t)
+    while t % block_k:
+        # shrink to a power-of-2 divisor: block_k = t would rebuild
+        # the [t, t] intermediates this path exists to avoid
+        block_k //= 2
+    n_blk = t // block_k
+    f32 = jnp.float32
+    scale = 1.0 / (d ** 0.5)
+    qf = q.astype(f32) * scale
+    dof = do.astype(f32)
+    q_pos = jnp.arange(t)[:, None]
+
+    def mask_block(s, j):
+        if not causal:
+            return s
+        k_pos = j * block_k + jnp.arange(block_k)[None, :]
+        return jnp.where(q_pos >= k_pos, s, _NEG)
+
+    # pass 1: per-row logsumexp L over all key blocks (O(t) carry)
+    def lse_step(carry, j):
+        m_run, l_run = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(
+            k, j * block_k, block_k, axis=2
+        ).astype(f32)
+        s = mask_block(
+            jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk), j
+        )
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_run, m_blk)
+        l_run = l_run * jnp.exp(m_run - m_new) + jnp.sum(
+            jnp.exp(s - m_new), axis=-1, keepdims=True
+        )
+        return (m_new, l_run), None
+
+    m0 = jnp.full((b, h, t, 1), 2.0 * _NEG, f32)
+    l0 = jnp.zeros((b, h, t, 1), f32)
+    (m_fin, l_fin), _ = jax.lax.scan(
+        lse_step, (m0, l0), jnp.arange(n_blk)
+    )
+    lse = m_fin + jnp.log(jnp.maximum(l_fin, 1e-20))
+
+    # D_i = sum_j P_ij dP_ij = rowsum(dO * O)
+    dvec = jnp.sum(dof * out.astype(f32), axis=-1, keepdims=True)
+
+    # pass 2: per-block gradients; dQ accumulates, dK/dV stack
+    def bwd_step(dq_acc, j):
+        k_blk = jax.lax.dynamic_slice_in_dim(
+            k, j * block_k, block_k, axis=2
+        ).astype(f32)
+        v_blk = jax.lax.dynamic_slice_in_dim(
+            v, j * block_k, block_k, axis=2
+        ).astype(f32)
+        s = mask_block(
+            jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk), j
+        )
+        p = jnp.exp(s - lse)                       # [b,h,t,bk]
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_blk)
+        ds = p * (dp - dvec)
+        dq_acc = dq_acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, k_blk
+        )
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        return dq_acc, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((b, h, t, d), f32)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        bwd_step, dq0, jnp.arange(n_blk)
+    )
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, t, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, t, d)
+    return (
+        (dq * scale).astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+    )
 
 
 def _use_pallas() -> bool:

@@ -158,3 +158,67 @@ class TestStreamedFlashAttention:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=rtol, atol=atol
         )
+
+
+class TestBlockwiseBackward:
+    """Long-context training path: beyond the VMEM-residency bound the
+    custom-vjp backward runs blockwise (lax.scan over K/V blocks, no
+    [t, t] materialization) and must match the reference attention's
+    gradients."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal, monkeypatch):
+        import importlib
+
+        fa = importlib.import_module(
+            "deeplearning4j_tpu.ops.flash_attention"
+        )
+        monkeypatch.setattr(fa, "_RESIDENT_TD_LIMIT", 63)
+        rng = np.random.RandomState(7)
+        q, k, v = (
+            jnp.asarray(rng.randn(2, 2, 128, 16), jnp.float32)
+            for _ in range(3)
+        )
+        # grads THROUGH the custom_vjp dispatch (t*d=2048 > patched
+        # limit -> the blockwise branch); the Pallas forward is
+        # swapped for the reference so this runs on any backend
+        monkeypatch.setattr(
+            fa, "flash_attention",
+            lambda q_, k_, v_, causal=False, **kw: attention(
+                q_, k_, v_, causal=causal
+            ),
+        )
+
+        def loss_diff(q_, k_, v_):
+            return jnp.sum(fa._flash_diff(q_, k_, v_, causal) ** 2)
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(attention(q_, k_, v_, causal=causal) ** 2)
+
+        g_diff = jax.grad(loss_diff, argnums=(0, 1, 2))(q, k, v)
+        g_full = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        rtol0, atol0 = kernel_tols()
+        for a, b_ in zip(g_diff, g_full):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=rtol0, atol=atol0
+            )
+
+        # compare the blockwise backward itself against autodiff of
+        # the reference (forward outputs from the reference too, so
+        # only the backward differs)
+        o_ref, vjp_ref = jax.vjp(
+            lambda q_, k_, v_: attention(q_, k_, v_, causal=causal),
+            q, k, v,
+        )
+        g = jnp.ones_like(o_ref)
+        dq_ref, dk_ref, dv_ref = vjp_ref(g)
+        dq, dk, dv = fa._blockwise_attention_bwd(
+            q, k, v, o_ref, g, causal, block_k=32
+        )
+        rtol, atol = kernel_tols()
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref),
+                                   rtol=rtol, atol=atol)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_ref),
+                                   rtol=rtol, atol=atol)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_ref),
+                                   rtol=rtol, atol=atol)
