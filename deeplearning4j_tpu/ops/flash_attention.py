@@ -228,25 +228,40 @@ def _attention_kernel_streamed(q_ref, k_ref, v_ref, o_ref, acc, l, m,
         ).astype(o_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_diff(q, k, v, causal):
+# beyond this many timesteps the backward's hazard — the [t, t] score
+# matrix the XLA-recompute path materializes (t^2 * 4B per (b, h):
+# 16MB at t=2048, 1GB at t=16k) — outweighs the blockwise backward's
+# extra QK^T sweep. Distinct from the forward's VMEM bound: the
+# backward pressure is HBM and quadratic in t alone.
+_BWD_MATERIALIZE_T_LIMIT = 2048
+
+
+def _use_blockwise_bwd(t: int) -> bool:
+    return t > _BWD_MATERIALIZE_T_LIMIT
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_diff(q, k, v, causal, interpret=False):
     """Differentiable wrapper: Pallas forward; backward is the XLA
     reference recompute at short sequences (cheapest to compile) and
-    the blockwise flash backward beyond the VMEM-residency bound —
-    O(t*block) memory instead of the [t, t] score matrix, so
-    long-context TRAINING is HBM-bound like the forward."""
-    return flash_attention(q, k, v, causal=causal)
+    the blockwise flash backward beyond ``_BWD_MATERIALIZE_T_LIMIT``
+    — O(t*block) memory instead of the [t, t] score matrix, so
+    long-context TRAINING is HBM-bound like the forward.
+    ``interpret`` exists for off-TPU tests of this exact path."""
+    return flash_attention(q, k, v, causal=causal, interpret=interpret)
 
 
-def _flash_fwd(q, k, v, causal):
-    out = flash_attention(q, k, v, causal=causal)
-    return out, (q, k, v, out)
+def _flash_fwd(q, k, v, causal, interpret=False):
+    out = flash_attention(q, k, v, causal=causal, interpret=interpret)
+    # the recompute branch never reads `out`; saving it there would
+    # pin an extra O(b*h*t*d) activation per layer for nothing
+    keep = out if _use_blockwise_bwd(q.shape[2]) else None
+    return out, (q, k, v, keep)
 
 
-def _flash_bwd(causal, res, g):
+def _flash_bwd(causal, interpret, res, g):
     q, k, v, out = res
-    t, d = q.shape[2], q.shape[3]
-    if t * d > _RESIDENT_TD_LIMIT:
+    if _use_blockwise_bwd(q.shape[2]):
         return _blockwise_attention_bwd(q, k, v, out, g, causal)
     from deeplearning4j_tpu.parallel.sequence import attention
 

@@ -173,24 +173,22 @@ class TestBlockwiseBackward:
         fa = importlib.import_module(
             "deeplearning4j_tpu.ops.flash_attention"
         )
-        monkeypatch.setattr(fa, "_RESIDENT_TD_LIMIT", 63)
+        # t=128 > patched backward limit -> the blockwise branch,
+        # fed by the REAL kernel forward (interpret off-TPU) — the
+        # D-vector consumes the kernel's own output
+        monkeypatch.setattr(fa, "_BWD_MATERIALIZE_T_LIMIT", 63)
         rng = np.random.RandomState(7)
         q, k, v = (
             jnp.asarray(rng.randn(2, 2, 128, 16), jnp.float32)
             for _ in range(3)
         )
-        # grads THROUGH the custom_vjp dispatch (t*d=2048 > patched
-        # limit -> the blockwise branch); the Pallas forward is
-        # swapped for the reference so this runs on any backend
-        monkeypatch.setattr(
-            fa, "flash_attention",
-            lambda q_, k_, v_, causal=False, **kw: attention(
-                q_, k_, v_, causal=causal
-            ),
-        )
 
         def loss_diff(q_, k_, v_):
-            return jnp.sum(fa._flash_diff(q_, k_, v_, causal) ** 2)
+            return jnp.sum(
+                fa._flash_diff(
+                    q_, k_, v_, causal, pallas_interpret()
+                ) ** 2
+            )
 
         def loss_ref(q_, k_, v_):
             return jnp.sum(attention(q_, k_, v_, causal=causal) ** 2)
@@ -198,9 +196,13 @@ class TestBlockwiseBackward:
         g_diff = jax.grad(loss_diff, argnums=(0, 1, 2))(q, k, v)
         g_full = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         rtol0, atol0 = kernel_tols()
+        # gradients chain ~3 matmuls deep, so on TPU the MXU's bf16
+        # input truncation compounds ~5x past the single-matmul
+        # tolerance (observed: 0.06% of elements at ~4e-2 abs)
         for a, b_ in zip(g_diff, g_full):
             np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b_), rtol=rtol0, atol=atol0
+                np.asarray(a), np.asarray(b_), rtol=rtol0,
+                atol=5 * atol0,
             )
 
         # compare the blockwise backward itself against autodiff of
