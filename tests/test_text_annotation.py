@@ -106,17 +106,60 @@ def test_japanese_dict_segmentation_beats_script_runs():
     assert tf.create("東京大学に行きます").get_tokens() == [
         "東京", "大学", "に", "行き", "ます"
     ]
-    # the classic lattice sentence: すもももももももものうち.
-    # A unigram lattice (no connection matrix) picks the fewer-token
-    # path すもも/もも/もも/もも/の/うち over Kuromoji's canonical
-    # すもも/も/もも/も/もも/の/うち — the divergence documented in
-    # nlp/japanese.py; every cut still falls on a dictionary word.
-    assert tf.create("すもももももももものうち").get_tokens() == [
-        "すもも", "もも", "もも", "もも", "の", "うち"
-    ]
     # script-run fallback keeps runs whole (registered explicitly)
     script = tokenizer_factory("japanese_script")
     assert script.create("東京大学に行きます").get_tokens()[0] == "東京大学"
+
+
+def test_japanese_lattice_classic_ambiguity():
+    """すもももももももものうち — THE lattice test sentence. A unigram
+    lattice picks the fewer-token path すもも/もも/もも/もも/の/うち;
+    the bigram connection matrix (particle chains penalized,
+    noun->particle rewarded — the compact analog of Kuromoji's
+    ConnectionCosts, ``viterbi/ViterbiSearcher.java:101``) recovers
+    the canonical alternating reading."""
+    from deeplearning4j_tpu.nlp.japanese import tokenize
+
+    toks = tokenize("すもももももももものうち")
+    assert [t.surface for t in toks] == [
+        "すもも", "も", "もも", "も", "もも", "の", "うち"
+    ]
+    assert [t.part_of_speech for t in toks] == [
+        "noun", "particle", "noun", "particle", "noun", "particle",
+        "noun",
+    ]
+
+
+def test_japanese_lattice_kuruma_ambiguity():
+    """くるまでまつ — the other classic: くるま/で/まつ (noun+case
+    particle) must beat くる/まで/まつ (verb+limit particle); the
+    connection matrix prefers BOS->noun and noun->particle."""
+    from deeplearning4j_tpu.nlp.japanese import tokenize
+
+    toks = tokenize("くるまでまつ")
+    assert [t.surface for t in toks] == ["くるま", "で", "まつ"]
+    assert [t.part_of_speech for t in toks] == [
+        "noun", "particle", "verb"
+    ]
+
+
+def test_japanese_pos_tags_and_base_forms():
+    from deeplearning4j_tpu.nlp.japanese import tokenize
+
+    toks = tokenize("私は学生です")
+    assert [(t.surface, t.part_of_speech) for t in toks] == [
+        ("私", "pronoun"), ("は", "particle"), ("学生", "noun"),
+        ("です", "auxiliary"),
+    ]
+    # verb stem + polite auxiliary: stems carry their dictionary form
+    toks = tokenize("本を読みました")
+    assert [(t.surface, t.part_of_speech) for t in toks] == [
+        ("本", "noun"), ("を", "particle"), ("読み", "verb"),
+        ("ました", "auxiliary"),
+    ]
+    assert toks[2].base_form == "読む"
+    assert toks[3].base_form == "ます"
+    assert all(t.known for t in toks)
 
 
 def test_japanese_dict_unknown_words_group_by_script():
@@ -125,3 +168,12 @@ def test_japanese_dict_unknown_words_group_by_script():
     toks = tf.create("コンピュータは速い").get_tokens()
     assert toks[0] == "コンピュータ"
     assert "は" in toks
+    # unknown tokens carry script-derived POS: katakana run -> noun
+    from deeplearning4j_tpu.nlp.japanese import tokenize
+
+    t = tokenize("コンピュータは速い")[0]
+    assert t.surface == "コンピュータ"
+    assert t.part_of_speech == "noun" and not t.known
+    # digit runs class as numbers
+    nums = [t for t in tokenize("3月に行きます") if t.pos == "number"]
+    assert [t.surface for t in nums] == ["3"]
