@@ -139,7 +139,8 @@ class ExpertParallelMoE:
             )
         self.n_experts = n_experts
         self.capacity_factor = capacity_factor
-        self._jit_applies: dict = {}  # token count -> compiled fn
+        self._jit_applies: dict = {}      # token count -> compiled fn
+        self._jit_train_steps: dict = {}  # token count -> compiled fn
 
     def shard_params(self, params: dict) -> dict:
         rep = NamedSharding(self.mesh, P())
@@ -149,7 +150,12 @@ class ExpertParallelMoE:
             out[k] = jax.device_put(params[k], exp)
         return out
 
-    def _build(self, n_tokens: int):
+    def _build(self, n_tokens: int, with_aux: bool = False):
+        """Sharded apply. ``with_aux=True`` additionally returns the
+        per-device load-balance loss ([n_devices] vector — the Switch
+        formulation balances each device's own token shard) computed
+        from the SAME router logits the dispatch uses, so training
+        never re-runs the router matmul outside the shard_map."""
         axis = self.axis_name
         nd = self.n_devices
         e_total = self.n_experts
@@ -185,13 +191,16 @@ class ExpertParallelMoE:
             back = jax.lax.all_to_all(
                 out, axis, split_axis=0, concat_axis=0, tiled=False,
             ).reshape(e_total, capacity, -1)
-            return jnp.einsum("ecd,nec->nd", back, combine)
+            y = jnp.einsum("ecd,nec->nd", back, combine)
+            if with_aux:
+                return y, aux_load_balance_loss(logits)[None]
+            return y
 
         sm = _shard_map()(
             local, mesh=self.mesh,
             in_specs=(P(), P(axis), P(axis), P(axis), P(axis),
                       P(axis)),
-            out_specs=P(axis),
+            out_specs=(P(axis), P(axis)) if with_aux else P(axis),
             check_rep=False,
         )
 
@@ -207,17 +216,58 @@ class ExpertParallelMoE:
         """x [n_tokens, d], n_tokens divisible by the device count;
         tokens sharded over 'expert' (placed if not already). One
         compile per distinct token count, all kept."""
+        x, n = self._check_tokens(x)
+        fn = self._jit_applies.get(n)
+        if fn is None:
+            fn = jax.jit(self._build(n))
+            self._jit_applies[n] = fn
+        return fn(params, x)
+
+    def train_step(self, params: dict, x, targets, *, lr=0.05,
+                   aux_weight: float = 0.01):
+        """One synchronous SGD training step through the sharded MoE:
+        ``loss = mean((moe(x) - targets)^2) + aux_weight *
+        load_balance`` (the Switch auxiliary loss on the router
+        logits). Returns ``(new_params, loss)``.
+
+        This is the public EP training API — gradients flow through
+        both all_to_alls and the per-expert FFNs; callers (the driver
+        dryrun, tests) never touch compiled internals. ``lr`` and
+        ``aux_weight`` are traced scalars, so one compile per token
+        count serves every hyperparameter setting."""
+        x, n = self._check_tokens(x)
+        exp = NamedSharding(self.mesh, P(self.axis_name))
+        targets = jax.device_put(jnp.asarray(targets), exp)
+        fn = self._jit_train_steps.get(n)
+        if fn is None:
+            apply = self._build(n, with_aux=True)
+
+            def step(p, x_, tgt, lr_, aux_w):
+                def loss_fn(pp):
+                    out, aux = apply(pp, x_)
+                    main = jnp.mean((out - tgt) ** 2)
+                    return main + aux_w * jnp.mean(aux)
+
+                loss, grads = jax.value_and_grad(loss_fn)(p)
+                # dtype-preserving update: bf16 params stay bf16
+                new = jax.tree_util.tree_map(
+                    lambda a, g: a - (lr_ * g).astype(a.dtype), p, grads
+                )
+                return new, loss
+
+            fn = jax.jit(step)
+            self._jit_train_steps[n] = fn
+        return fn(params, x, targets, jnp.float32(lr),
+                  jnp.float32(aux_weight))
+
+    def _check_tokens(self, x):
         x = jnp.asarray(x)
         n = x.shape[0]
         if n % self.n_devices:
             raise ValueError(
                 f"{n} tokens not divisible by {self.n_devices} devices"
             )
-        fn = self._jit_applies.get(n)
-        if fn is None:
-            fn = jax.jit(self._build(n))
-            self._jit_applies[n] = fn
         x = jax.device_put(
             x, NamedSharding(self.mesh, P(self.axis_name))
         )
-        return fn(params, x)
+        return x, n
