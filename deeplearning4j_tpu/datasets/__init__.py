@@ -9,11 +9,13 @@ from deeplearning4j_tpu.datasets.api import (  # noqa: F401
 )
 from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     AsyncDataSetIterator,
+    DevicePrefetchIterator,
     INDArrayDataSetIterator,
     MovingWindowDataSetIterator,
     MultipleEpochsIterator,
     ReconstructionDataSetIterator,
     SamplingDataSetIterator,
+    make_packbits_codec,
 )
 from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.curves import CurvesDataSetIterator  # noqa: F401

@@ -131,6 +131,133 @@ class AsyncDataSetIterator(DataSetIterator):
         return self.base.total_examples()
 
 
+class _EncodingIterator:
+    """Producer-side adapter for DevicePrefetchIterator: encode each
+    host batch and START its asynchronous host->device copy on the
+    worker thread, so transfer overlaps both decode and training."""
+
+    def __init__(self, base, host_encode):
+        self.base = base
+        self.host_encode = host_encode
+
+    def __iter__(self):
+        import jax
+
+        for ds in self.base:
+            if self.host_encode is not None:
+                payload = self.host_encode(ds)
+            else:
+                payload = (
+                    np.asarray(ds.features), np.asarray(ds.labels),
+                    getattr(ds, "labels_mask", None),
+                    getattr(ds, "features_mask", None),
+                )
+            # device_put is async: returns immediately, the copy
+            # proceeds while the worker decodes the next batch and the
+            # consumer trains on previous ones
+            yield jax.tree_util.tree_map(jax.device_put, payload)
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+
+class DevicePrefetchIterator(AsyncDataSetIterator):
+    """Device-affinity prefetch: the AsyncDataSetIterator thread PLUS
+    placement — the worker encodes each host batch (optional
+    ``host_encode``, e.g. 1-bit packing of binarized images), starts
+    its asynchronous host->device copy, and the consumer receives
+    device-RESIDENT DataSets (through the optional jitted
+    ``device_decode``). The engines' chunk stacking then runs on
+    device, so a cold ``fit()`` streams: decode (host, C++ loader) ->
+    encoded transfer -> on-device decode -> train, all overlapped.
+
+    Reference analog: ``AsyncDataSetIterator.java:36`` pins its
+    prefetch thread to a device for affinity. The TPU-native version
+    optimizes what the reference could not: the scarce resource is the
+    host->device link, so what crosses it is the *encoded* payload
+    (e.g. 98 bytes/example for bit-packed binarized MNIST instead of
+    3,136 bytes of float32) and bit-unpack/normalize/one-hot run on
+    device, where they are free against the MXU.
+
+    - ``host_encode(ds) -> pytree of np arrays`` (worker thread)
+    - ``device_decode(tree) -> (features, labels, labels_mask,
+      features_mask)`` — jitted on first use, one compile per payload
+      shape.
+    """
+
+    def __init__(self, base, queue_size: int = 2, host_encode=None,
+                 device_decode=None):
+        super().__init__(
+            _EncodingIterator(base, host_encode), queue_size
+        )
+        self._device_decode = device_decode
+        self._jit_decode = None
+        self._user_base = base
+
+    def next(self) -> DataSet:
+        payload = super().next()
+        if self._device_decode is None:
+            f, l, lm, fm = payload
+            return DataSet(features=f, labels=l, labels_mask=lm,
+                           features_mask=fm)
+        if self._jit_decode is None:
+            import jax
+
+            self._jit_decode = jax.jit(self._device_decode)
+        f, l, lm, fm = self._jit_decode(payload)
+        return DataSet(features=f, labels=l, labels_mask=lm,
+                       features_mask=fm)
+
+    def batch(self) -> int:
+        return self._user_base.batch()
+
+    def total_examples(self) -> int:
+        return self._user_base.total_examples()
+
+
+def make_packbits_codec(n_features: int, n_classes: int,
+                        threshold: float = 0.5):
+    """(host_encode, device_decode) for binary-valued feature rows +
+    one-hot labels: features pack to 1 bit/pixel on host (32x fewer
+    bytes over the link than float32), labels ride as class indices;
+    unpack and one-hot run on device. Exact for any features that are
+    strictly {0,1}-valued after thresholding (e.g. binarized MNIST).
+    """
+
+    # class indices ride at the narrowest width that can hold them
+    if n_classes <= 256:
+        idx_dtype = np.uint8
+    elif n_classes <= 65536:
+        idx_dtype = np.uint16
+    else:
+        idx_dtype = np.int32
+
+    def host_encode(ds):
+        f = np.asarray(ds.features)
+        bits = (
+            (f > threshold) if f.dtype.kind == "f" else (f != 0)
+        ).astype(np.uint8)
+        packed = np.packbits(bits, axis=1)  # big-endian bit order
+        y = np.asarray(ds.labels)
+        if y.ndim == 2:  # one-hot -> index
+            y = np.argmax(y, axis=1)
+        return packed, y.astype(idx_dtype)
+
+    def device_decode(tree):
+        import jax
+        import jax.numpy as jnp
+
+        packed, y = tree
+        shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+        bits = (packed[:, :, None] >> shifts) & jnp.uint8(1)
+        x = bits.reshape(packed.shape[0], -1)[:, :n_features]
+        onehot = jax.nn.one_hot(y, n_classes, dtype=jnp.uint8)
+        return x, onehot, None, None
+
+    return host_encode, device_decode
+
+
 class MultipleEpochsIterator(DataSetIterator):
     """Present N epochs of a base iterator as one pass (reference
     ``MultipleEpochsIterator``)."""

@@ -244,6 +244,22 @@ class SequenceVectors:
         self.seed = seed
         self.algorithm = algorithm
         self.scan_chunk = 16  # skip-gram batches fused per dispatch
+        # Device-resident epoch replay: the prepared (ids, negatives,
+        # masks, alphas) chunk arrays for an epoch are cached in HBM
+        # keyed by (epoch seed, step offset, batch/scan geometry, and
+        # every hyperparameter baked into the arrays), so repeated
+        # fits (and epochs>1 re-runs with matching keys) skip ALL
+        # host-side pair generation + transfer — the NLP analog of the
+        # engines' multi-epoch device cache. Pure caching: the cached
+        # arrays are bit-identical to regeneration (same seeds); a
+        # subclass that mutates its corpus between fits under the same
+        # seed must call clear_epoch_cache(). Bounded by
+        # ``epoch_cache_budget_bytes`` (epochs past the budget stream
+        # as before); 0 disables like cache_epoch_data=False.
+        self.cache_epoch_data = True
+        self.epoch_cache_budget_bytes = 256 * 2 ** 20
+        self._epoch_cache: dict = {}
+        self._epoch_cache_bytes = 0
         self.lookup = InMemoryLookupTable(
             cache, layer_size, seed=seed, use_hs=use_hierarchic_softmax,
             negative=negative,
@@ -358,6 +374,31 @@ class SequenceVectors:
 
     # -- training -----------------------------------------------------------
 
+    def clear_epoch_cache(self) -> None:
+        """Drop the device-resident epoch replay cache (required after
+        mutating the corpus without changing the seed)."""
+        self._epoch_cache.clear()
+        self._epoch_cache_bytes = 0
+
+    def _epoch_cache_key(self, ep_seed: int, step: int):
+        """Everything that shapes the prepared chunk arrays: epoch
+        seed + step offset (negatives, alpha offsets), geometry, and
+        the hyperparameters baked into alphas/negatives/hs-paths."""
+        return (
+            ep_seed, step, self.batch_size, self.scan_chunk,
+            self.learning_rate, self.min_learning_rate, self.epochs,
+            self.negative, self.use_hs,
+        )
+
+    @staticmethod
+    def _chunks_nbytes(chunks) -> int:
+        total = 0
+        for tup in chunks:
+            for a in tup[:-1]:
+                if a is not None:
+                    total += int(np.prod(a.shape)) * a.dtype.itemsize
+        return total
+
     def fit(self) -> None:
         B = self.batch_size
         lr0, lr_min = self.learning_rate, self.min_learning_rate
@@ -365,22 +406,44 @@ class SequenceVectors:
         step = 0
         cbow = self.algorithm == "CBOW"
         for epoch in range(self.epochs):
-            if cbow:
-                t, c, m = self._gen_cbow(self.seed + 31 * epoch)
-                n_items = len(t)
-            else:
-                c, o = self._gen_pairs(self.seed + 31 * epoch)
-                n_items = len(c)
-            if total_items is None:
-                total_items = max(n_items * self.epochs, 1)
-            if (
+            scan_ok = (
                 not cbow and self.scan_chunk > 1
                 and self.iterations == 1
                 and self._scan_path_ok()
-            ):
-                step = self._fit_epoch_scan(
+            )
+            ep_seed = self.seed + 31 * epoch
+            caching = (
+                self.cache_epoch_data
+                and self.epoch_cache_budget_bytes > 0
+            )
+            if scan_ok:
+                key = self._epoch_cache_key(ep_seed, step)
+                entry = self._epoch_cache.get(key) if caching else None
+                if entry is not None:
+                    n_items, chunks = entry
+                    if total_items is None:
+                        total_items = max(n_items * self.epochs, 1)
+                    step = self._run_scan_chunks(chunks, step)
+                    continue
+            if cbow:
+                t, c, m = self._gen_cbow(ep_seed)
+                n_items = len(t)
+            else:
+                c, o = self._gen_pairs(ep_seed)
+                n_items = len(c)
+            if total_items is None:
+                total_items = max(n_items * self.epochs, 1)
+            if scan_ok:
+                chunks = self._prepare_scan_chunks(
                     c, o, step, total_items, lr0, lr_min
                 )
+                if caching:
+                    nbytes = self._chunks_nbytes(chunks)
+                    if (self._epoch_cache_bytes + nbytes
+                            <= self.epoch_cache_budget_bytes):
+                        self._epoch_cache[key] = (n_items, chunks)
+                        self._epoch_cache_bytes += nbytes
+                step = self._run_scan_chunks(chunks, step)
                 continue
             for s in range(0, n_items, B):
                 mask = np.ones(B, np.float32)
@@ -420,19 +483,23 @@ class SequenceVectors:
             or getattr(self, "scan_path_compatible", False)
         )
 
-    def _fit_epoch_scan(self, centers, contexts, step, total_items,
-                        lr0, lr_min) -> int:
-        """Skip-gram epoch in scan-fused dispatches: ``scan_chunk``
-        batches per XLA call, identical math/negative-sampling to the
-        per-batch path (same per-batch step seeds and alphas)."""
+    def _prepare_scan_chunks(self, centers, contexts, step, total_items,
+                             lr0, lr_min) -> list:
+        """Build the device-resident chunk arrays for one scan-fused
+        skip-gram epoch: ``scan_chunk`` batches per XLA call, identical
+        math/negative-sampling/alphas to the per-batch path (same
+        per-batch step seeds). Returns a list of per-dispatch tuples
+        consumed by :meth:`_run_scan_chunks` (and cached for epoch
+        replay — ``_sg_scan_steps`` donates only the tables, never
+        these batch arrays, so they are reusable)."""
         B = self.batch_size
         K = self.scan_chunk
-        lk = self.lookup
         n = len(centers)
         # word ids transfer at native width (uint16 for vocabs under
         # 64k — half the host->device bytes); the on-device gather
         # accepts either and values are identical
         idt = np.uint16 if len(self._counts) < 2 ** 16 else np.int32
+        chunks = []
         for s0 in range(0, n, B * K):
             cs = centers[s0:s0 + B * K]
             os_ = contexts[s0:s0 + B * K]
@@ -463,12 +530,23 @@ class SequenceVectors:
                 pmd = jnp.asarray(pmask).reshape(k, B, -1)
             else:
                 ckd = ptd = pmd = None
-            lk.syn0, lk.syn1, lk.syn1neg, _ = _sg_scan_steps(
-                lk.syn0, lk.syn1, lk.syn1neg,
+            chunks.append((
                 self._put_stacked(ck), self._put_stacked(ok),
                 ckd, ptd, pmd,
                 self._put_stacked(negs) if negs is not None else None,
-                self._put_stacked(mk), jnp.asarray(alphas),
+                self._put_stacked(mk), jnp.asarray(alphas), k,
+            ))
+            step += k
+        return chunks
+
+    def _run_scan_chunks(self, chunks, step) -> int:
+        """Run a prepared epoch: one fused-scan dispatch per chunk,
+        zero host work (the device-resident replay path)."""
+        lk = self.lookup
+        for (ck, ok, ckd, ptd, pmd, negs, mk, alphas, k) in chunks:
+            lk.syn0, lk.syn1, lk.syn1neg, _ = _sg_scan_steps(
+                lk.syn0, lk.syn1, lk.syn1neg, ck, ok, ckd, ptd, pmd,
+                negs, mk, alphas,
             )
             step += k
         return step

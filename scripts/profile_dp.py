@@ -1,0 +1,172 @@
+"""Attribute the dp_scaling overhead (BENCH_r03: weak 0.771) on the
+8-device virtual CPU mesh.
+
+Decomposition measured here (all shard_map, batch_stats="local"
+semantics, the step DistributedTrainer picks for the dp bench):
+
+  A. full train step          n=1 b/8   and   n=8 b
+  B. collectives alone: jitted pmean over the grads+state-shaped tree
+  C. updater alone: replicated update on n=1 vs n=8 (the serialized
+     host pays the 8x duplication that real chips run in parallel)
+
+Residual = A(8) - 8*A(1,b/8)/8 ... i.e. whatever partitioning adds
+beyond B and C's duplication. Prints one JSON line.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, time
+import numpy as np
+from __graft_entry__ import _ensure_devices
+_ensure_devices(8)
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.parallel import build_mesh
+from deeplearning4j_tpu.zoo import resnet50
+
+n = int(os.environ["DP_DEVICES"])
+b = int(os.environ["DP_BATCH"])
+steps = int(os.environ.get("DP_STEPS", "3"))
+what = os.environ["DP_WHAT"]  # step | pmean | update
+
+conf = resnet50(height=32, width=32, channels=3, n_classes=10,
+                cifar_stem=True, learning_rate=0.01)
+net = ComputationGraph(conf).init()
+mesh = build_mesh(data=n, model=1, devices=jax.devices()[:n])
+updater = net.updater_def
+rep_sh = NamedSharding(mesh, P())
+dp_sh = NamedSharding(mesh, P("data"))
+
+params = jax.device_put(net.params, rep_sh)
+upd = jax.tree_util.tree_map(lambda a: jax.device_put(a, rep_sh),
+                             net.updater_state)
+state = jax.tree_util.tree_map(lambda a: jax.device_put(a, rep_sh),
+                               net.state)
+rng = jax.random.PRNGKey(0)
+lrs = {k: jnp.asarray(v, jnp.float32)
+       for k, v in updater.scheduled_lrs(0).items()}
+t = jnp.asarray(1.0, jnp.float32)
+rs = np.random.RandomState(0)
+x = jax.device_put(rs.rand(b, 3, 32, 32).astype(np.float32), dp_sh)
+y = jax.device_put(
+    np.eye(10, dtype=np.float32)[rs.randint(0, 10, b)], dp_sh)
+
+rep = P(); dp = P("data")
+
+def time_fn(fn, args, donate=None):
+    out = fn(*args)          # compile + 1 run
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+if what == "step":
+    def step(params, upd, state, x, y, lrs, t, rng):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+        def loss_fn(p):
+            s, ns = net._score_pure(p, state, [x], [y], None, rng,
+                                    train=True, fmasks=None)
+            return s, ns
+        (score, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = jax.lax.pmean(grads, "data")
+        score = jax.lax.pmean(score, "data")
+        new_params, new_upd = updater.update(grads, upd, params, lrs, t)
+        new_state = jax.tree_util.tree_map(
+            lambda a: jax.lax.pmean(a, "data"), new_state)
+        return new_params, new_upd, new_state, score
+    f = jax.jit(shard_map(step, mesh=mesh,
+                          in_specs=(rep, rep, rep, dp, dp, rep, rep, rep),
+                          out_specs=(rep, rep, rep, rep),
+                          check_rep=False))
+    sec = time_fn(f, (params, upd, state, x, y, lrs, t, rng))
+elif what == "fwdbwd":
+    def step(params, state, x, y, rng):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+        def loss_fn(p):
+            s, ns = net._score_pure(p, state, [x], [y], None, rng,
+                                    train=True, fmasks=None)
+            return s, ns
+        (score, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return grads, new_state, score
+    f = jax.jit(shard_map(step, mesh=mesh,
+                          in_specs=(rep, rep, dp, dp, rep),
+                          out_specs=(rep, rep, rep),
+                          check_rep=False))
+    sec = time_fn(f, (params, state, x, y, rng))
+elif what == "pmean":
+    def red(g, s):
+        g = jax.lax.pmean(g, "data")
+        s = jax.tree_util.tree_map(
+            lambda a: jax.lax.pmean(a, "data"), s)
+        return g, s
+    f = jax.jit(shard_map(red, mesh=mesh, in_specs=(rep, rep),
+                          out_specs=(rep, rep), check_rep=False))
+    sec = time_fn(f, (params, state))
+elif what == "update":
+    def up(g, upd, params, lrs, t):
+        return updater.update(g, upd, params, lrs, t)
+    f = jax.jit(shard_map(up, mesh=mesh,
+                          in_specs=(rep, rep, rep, rep, rep),
+                          out_specs=(rep, rep), check_rep=False))
+    sec = time_fn(f, (params, upd, params, lrs, t))
+print(json.dumps({"what": what, "devices": n, "batch": b,
+                  "sec": sec}))
+"""
+
+
+def run(what, n, b, steps=3):
+    env = dict(os.environ)
+    env.update({
+        "JAX_COMPILATION_CACHE_DIR": "/tmp/deeplearning4j_tpu_jax_cache",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=8"
+                      ).strip(),
+        "DP_DEVICES": str(n), "DP_BATCH": str(b),
+        "DP_STEPS": str(steps), "DP_WHAT": what,
+        "PYTHONPATH": REPO,
+    })
+    t0 = time.time()
+    out = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                         capture_output=True, text=True, timeout=3600)
+    wall = time.time() - t0
+    if out.returncode != 0:
+        return {"what": what, "devices": n, "batch": b,
+                "error": out.stderr[-1500:], "wall": round(wall, 1)}
+    r = json.loads(out.stdout.strip().splitlines()[-1])
+    r["wall"] = round(wall, 1)
+    return r
+
+
+def main():
+    results = []
+    for what, n, b in [
+        ("step", 1, 8), ("step", 8, 64),
+        ("fwdbwd", 1, 8), ("fwdbwd", 8, 64),
+        ("pmean", 8, 64),
+        ("update", 1, 8), ("update", 8, 64),
+    ]:
+        r = run(what, n, b)
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    print(json.dumps({"all": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,194 @@
+"""Round 2 of dp_scaling attribution: the pieces (fwdbwd / pmean /
+update) sum to ~28s but the composed step costs 34.8s on the 8-device
+mesh. Sweep step *compositions* to find what the composed program pays
+for: donation, state-pmean placement, joint-vs-split pmean, GSPMD vs
+shard_map."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, time
+import numpy as np
+from __graft_entry__ import _ensure_devices
+_ensure_devices(8)
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.parallel import build_mesh
+from deeplearning4j_tpu.zoo import resnet50
+
+n = int(os.environ["DP_DEVICES"])
+b = int(os.environ["DP_BATCH"])
+steps = int(os.environ.get("DP_STEPS", "3"))
+variant = os.environ["DP_VARIANT"]
+
+conf = resnet50(height=32, width=32, channels=3, n_classes=10,
+                cifar_stem=True, learning_rate=0.01)
+net = ComputationGraph(conf).init()
+mesh = build_mesh(data=n, model=1, devices=jax.devices()[:n])
+updater = net.updater_def
+rep_sh = NamedSharding(mesh, P())
+dp_sh = NamedSharding(mesh, P("data"))
+
+def place(tree, sh):
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tree)
+
+rng = jax.random.PRNGKey(0)
+lrs = {k: jnp.asarray(v, jnp.float32)
+       for k, v in updater.scheduled_lrs(0).items()}
+t = jnp.asarray(1.0, jnp.float32)
+rs = np.random.RandomState(0)
+x_h = rs.rand(b, 3, 32, 32).astype(np.float32)
+y_h = np.eye(10, dtype=np.float32)[rs.randint(0, 10, b)]
+
+rep = P(); dp = P("data")
+
+def flat_pmean(tree, axis):
+    # ONE fused all-reduce: ravel every leaf into a single flat
+    # vector, pmean once, unflatten (DDP-style gradient bucketing --
+    # collapses ~260 per-leaf collectives into 1)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sizes = [l.size for l in leaves]
+    flat = jnp.concatenate(
+        [l.astype(jnp.float32).ravel() for l in leaves])
+    flat = jax.lax.pmean(flat, axis)
+    out, off = [], 0
+    for l, s in zip(leaves, sizes):
+        out.append(flat[off:off + s].reshape(l.shape).astype(l.dtype))
+        off += s
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+def make_step(state_mode, joint, flat):
+    def step(params, upd, state, x, y, lrs, t, rng):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+        def loss_fn(p):
+            s, ns = net._score_pure(p, state, [x], [y], None, rng,
+                                    train=True, fmasks=None)
+            return s, ns
+        (score, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        if flat:
+            red = (grads, score, new_state if state_mode == "pmean"
+                   else None)
+            grads, score, red_state = flat_pmean(red, "data")
+            if state_mode == "pmean":
+                new_state = red_state
+        elif joint:
+            to_red = (grads, score, new_state if state_mode == "pmean"
+                      else None)
+            grads, score, red_state = jax.lax.pmean(to_red, "data")
+            if state_mode == "pmean":
+                new_state = red_state
+        else:
+            grads = jax.lax.pmean(grads, "data")
+            score = jax.lax.pmean(score, "data")
+            if state_mode == "pmean":
+                new_state = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, "data"), new_state)
+        new_params, new_upd = updater.update(grads, upd, params, lrs, t)
+        return new_params, new_upd, new_state, score
+    return step
+
+def build(variant):
+    donate = "donate" in variant
+    state_mode = "local" if "nostate" in variant else "pmean"
+    joint = "joint" in variant
+    flat = "flat" in variant
+    if variant.startswith("gspmd"):
+        def step(params, upd, state, x, y, lrs, t, rng):
+            def loss_fn(p):
+                s, ns = net._score_pure(p, state, [x], [y], None, rng,
+                                        train=True, fmasks=None)
+                return s, ns
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_upd = updater.update(
+                grads, upd, params, lrs, t)
+            return new_params, new_upd, new_state, score
+        return jax.jit(
+            step,
+            in_shardings=(rep_sh, rep_sh, rep_sh, dp_sh, dp_sh,
+                          None, None, None),
+            out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh),
+            donate_argnums=(0, 1, 2) if donate else (),
+        )
+    f = shard_map(make_step(state_mode, joint, flat), mesh=mesh,
+                  in_specs=(rep, rep, rep, dp, dp, rep, rep, rep),
+                  out_specs=(rep, rep, rep, rep), check_rep=False)
+    return jax.jit(f, donate_argnums=(0, 1, 2) if donate else ())
+
+f = build(variant)
+# host-side master copies: donation deletes the placed device arrays,
+# so each iteration re-places from host (device_put of an array that
+# already has the target sharding would alias, then die on donation)
+params_h = jax.tree_util.tree_map(np.asarray, net.params)
+upd_h = jax.tree_util.tree_map(np.asarray, net.updater_state)
+state_h = jax.tree_util.tree_map(np.asarray, net.state)
+times = []
+for it in range(steps + 1):
+    params = place(params_h, rep_sh)
+    upd = place(upd_h, rep_sh)
+    state = place(state_h, rep_sh)
+    x = jax.device_put(x_h, dp_sh); y = jax.device_put(y_h, dp_sh)
+    jax.block_until_ready((params, upd, state, x, y))
+    t0 = time.perf_counter()
+    out = f(params, upd, state, x, y, lrs, t, rng)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    if it > 0:  # first = compile
+        times.append(dt)
+    del out
+print(json.dumps({"variant": variant, "devices": n, "batch": b,
+                  "sec": min(times)}))
+"""
+
+
+def run(variant, n, b, steps=3):
+    env = dict(os.environ)
+    env.update({
+        "JAX_COMPILATION_CACHE_DIR": "/tmp/deeplearning4j_tpu_jax_cache",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=8"
+                      ).strip(),
+        "DP_DEVICES": str(n), "DP_BATCH": str(b),
+        "DP_STEPS": str(steps), "DP_VARIANT": variant,
+        "PYTHONPATH": REPO,
+    })
+    t0 = time.time()
+    out = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                         capture_output=True, text=True, timeout=3600)
+    wall = time.time() - t0
+    if out.returncode != 0:
+        return {"variant": variant, "devices": n, "batch": b,
+                "error": out.stderr[-1500:], "wall": round(wall, 1)}
+    r = json.loads(out.stdout.strip().splitlines()[-1])
+    r["wall"] = round(wall, 1)
+    return r
+
+
+def main():
+    cases = [
+        ("plain", 8, 64),
+        ("donate", 8, 64),
+        ("flat", 8, 64),
+        ("flat_donate", 8, 64),
+        ("joint", 8, 64),
+        ("nostate", 8, 64),
+        ("gspmd_donate", 8, 64),
+        ("donate", 1, 8),
+        ("flat_donate", 1, 8),
+    ]
+    for variant, n, b in cases:
+        print(json.dumps(run(variant, n, b)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

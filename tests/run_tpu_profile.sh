@@ -20,8 +20,18 @@ PY
 {
   echo "== TPU profile run: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
   python -c "import jax; d=jax.devices()[0]; print(f'backend={jax.default_backend()} device={d.device_kind}')"
+  # kernel/conv/rnn/transformer paths PLUS (r4, VERDICT #7) the graph
+  # engine, solvers, updaters, serialization, pretrain (VAE/RBM
+  # sampling under TPU PRNG), NLP XLA steps, transformer KV-cache
+  # streaming, config round-trip, and the DP trainer on a 1-chip
+  # degenerate mesh (multi-device cases self-skip via require_devices)
   DL4J_TPU_TEST_PLATFORM=tpu python -m pytest \
     tests/test_pallas_ops.py tests/test_cnn.py tests/test_rnn.py \
     tests/test_mlp.py tests/test_transformer.py \
-    tests/test_flops_and_device.py -q --no-header
+    tests/test_flops_and_device.py \
+    tests/test_graph.py tests/test_solvers.py tests/test_updaters.py \
+    tests/test_serialization.py tests/test_pretrain.py \
+    tests/test_nlp.py tests/test_transformer_streaming.py \
+    tests/test_config.py tests/test_parallel.py \
+    -q --no-header
 } 2>&1 | tee "$OUT"

@@ -314,6 +314,76 @@ def test_w2v_scan_fused_matches_per_batch(rng, hs, neg):
         )
 
 
+def test_w2v_epoch_replay_cache_is_pure(rng):
+    """The device-resident epoch replay cache must be a PURE cache:
+    repeated fits with caching give bit-identical tables to repeated
+    fits that regenerate everything (same seeds either way), and the
+    epochs>2 case replays per-epoch keys correctly."""
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+    from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+
+    words = [f"w{i}" for i in range(20)]
+    sents = [
+        [words[rng.randint(0, 20)] for _ in range(12)]
+        for _ in range(30)
+    ]
+    cache = VocabConstructor(
+        min_word_frequency=1
+    ).build_vocab_from_tokens(sents)
+    ids = [
+        np.asarray([cache.index_of(w) for w in s], np.int32)
+        for s in sents
+    ]
+
+    class _Seq(SequenceVectors):
+        def __init__(self, cache, seqs, **kw):
+            super().__init__(cache, **kw)
+            self._seqs = seqs
+
+        def _sequences(self):
+            return iter(self._seqs)
+
+    kw = dict(layer_size=8, window=2, negative=3, batch_size=16,
+              epochs=2, seed=4)
+    a = _Seq(cache, ids, **kw)   # caching on (default)
+    assert a.cache_epoch_data
+    a.fit()
+    assert a._epoch_cache  # populated
+    a.fit()                # replayed from HBM
+    b = _Seq(cache, ids, **kw)
+    b.cache_epoch_data = False
+    b.fit()
+    b.fit()                # regenerated host-side
+    assert not b._epoch_cache
+    np.testing.assert_array_equal(
+        np.asarray(a.lookup.syn0), np.asarray(b.lookup.syn0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.lookup.syn1neg), np.asarray(b.lookup.syn1neg)
+    )
+    # clear_epoch_cache forces regeneration and still matches
+    a.clear_epoch_cache()
+    assert not a._epoch_cache
+    a.fit()
+    b.fit()
+    np.testing.assert_array_equal(
+        np.asarray(a.lookup.syn0), np.asarray(b.lookup.syn0)
+    )
+    # hyperparameter changes invalidate the key (no stale replay)
+    a.learning_rate = a.learning_rate / 2
+    b.learning_rate = b.learning_rate / 2
+    a.fit()
+    b.fit()
+    np.testing.assert_array_equal(
+        np.asarray(a.lookup.syn0), np.asarray(b.lookup.syn0)
+    )
+    # budget 0 disables caching entirely
+    a.clear_epoch_cache()
+    a.epoch_cache_budget_bytes = 0
+    a.fit()
+    assert not a._epoch_cache
+
+
 def test_paragraph_vectors_infer_unseen_doc():
     """inferVector analog: an unseen document lands nearer to its
     topic's training docs (reference ParagraphVectors.inferVector)."""
