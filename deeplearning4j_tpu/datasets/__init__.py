@@ -1,6 +1,7 @@
 """Datasets & iterators (reference: ``deeplearning4j-core`` datasets)."""
 
 from deeplearning4j_tpu.datasets.api import (  # noqa: F401
+    ChunkedDataSet,
     DataSet,
     DataSetIterator,
     ExistingDataSetIterator,

@@ -107,6 +107,30 @@ class DataSet:
 
 
 @dataclass
+class ChunkedDataSet:
+    """k same-shaped minibatches pre-stacked on a leading axis
+    ([k, b, ...]) — the payload an input pipeline hands the engines'
+    fused multi-step dispatch DIRECTLY, skipping the per-batch
+    split-and-restack round trip (each split/stack is a device
+    dispatch; through a high-latency link those dominated streamed
+    training). Produced by ``DevicePrefetchIterator(emit_chunks=True)``
+    and consumed natively by the engines' scan path."""
+
+    features: np.ndarray      # [k, b, ...]
+    labels: np.ndarray        # [k, b, ...]
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    @property
+    def k(self) -> int:
+        return int(np.shape(self.features)[0])
+
+    def num_examples(self) -> int:
+        s = np.shape(self.features)
+        return int(s[0]) * int(s[1])
+
+
+@dataclass
 class MultiDataSet:
     """Multi-input/multi-output container (reference nd4j MultiDataSet,
     consumed by ComputationGraph)."""

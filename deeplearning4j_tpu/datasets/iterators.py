@@ -133,29 +133,67 @@ class AsyncDataSetIterator(DataSetIterator):
 
 class _EncodingIterator:
     """Producer-side adapter for DevicePrefetchIterator: encode each
-    host batch and START its asynchronous host->device copy on the
-    worker thread, so transfer overlaps both decode and training."""
+    host batch and START its host->device copy on the worker thread,
+    so transfer overlaps both decode and training. ``batch_group``
+    batches share ONE ``device_put`` (leaves stacked on a new leading
+    axis): per-transfer latency — not just bandwidth — is the scarce
+    resource on some interconnects, so grouping amortizes it the way
+    the engines' scan chunks amortize dispatches."""
 
-    def __init__(self, base, host_encode):
+    def __init__(self, base, host_encode, batch_group: int = 1):
         self.base = base
         self.host_encode = host_encode
+        self.batch_group = max(1, int(batch_group))
+
+    def _encode(self, ds):
+        if self.host_encode is not None:
+            return self.host_encode(ds)
+        return (
+            np.asarray(ds.features), np.asarray(ds.labels),
+            getattr(ds, "labels_mask", None),
+            getattr(ds, "features_mask", None),
+        )
+
+    @staticmethod
+    def _shapes(tree):
+        import jax
+
+        return tuple(
+            (np.shape(l), np.asarray(l).dtype.str)
+            for l in jax.tree_util.tree_leaves(tree)
+        )
 
     def __iter__(self):
         import jax
 
+        if self.batch_group == 1:
+            # ungrouped fast path: one put per batch, no stack copy
+            for ds in self.base:
+                yield ("single", 1, jax.tree_util.tree_map(
+                    jax.device_put, self._encode(ds)
+                ))
+            return
+
+        def put_group(group):
+            # one device_put for the whole group; async — the copy
+            # proceeds while the worker encodes the next group
+            stacked = jax.tree_util.tree_map(
+                lambda *ls: np.stack(ls), *group
+            )
+            return ("group", len(group),
+                    jax.tree_util.tree_map(jax.device_put, stacked))
+
+        group, sig = [], None
         for ds in self.base:
-            if self.host_encode is not None:
-                payload = self.host_encode(ds)
-            else:
-                payload = (
-                    np.asarray(ds.features), np.asarray(ds.labels),
-                    getattr(ds, "labels_mask", None),
-                    getattr(ds, "features_mask", None),
-                )
-            # device_put is async: returns immediately, the copy
-            # proceeds while the worker decodes the next batch and the
-            # consumer trains on previous ones
-            yield jax.tree_util.tree_map(jax.device_put, payload)
+            payload = self._encode(ds)
+            s = self._shapes(payload)
+            if group and (s != sig or len(group) >= self.batch_group):
+                yield put_group(group)
+                group = []
+            sig = s
+            group.append(payload)
+        if group:
+            yield put_group(group)
 
     def reset(self):
         if hasattr(self.base, "reset"):
@@ -182,32 +220,89 @@ class DevicePrefetchIterator(AsyncDataSetIterator):
 
     - ``host_encode(ds) -> pytree of np arrays`` (worker thread)
     - ``device_decode(tree) -> (features, labels, labels_mask,
-      features_mask)`` — jitted on first use, one compile per payload
-      shape.
+      features_mask)`` — vmapped over the transfer group and jitted on
+      first use, one compile per payload shape.
+    - ``batch_group``: batches per ``device_put`` (grouped transfer —
+      amortizes per-transfer latency; decoded as one dispatch, then
+      split on device).
+    - ``emit_chunks``: yield each transfer group as ONE
+      :class:`ChunkedDataSet` ([k, b, ...]) instead of splitting it —
+      the engines' fused scan consumes it directly, so a streamed
+      group costs ~2 dispatches instead of ~2k+2 (split + restack).
     """
 
     def __init__(self, base, queue_size: int = 2, host_encode=None,
-                 device_decode=None):
+                 device_decode=None, batch_group: int = 1,
+                 emit_chunks: bool = False):
         super().__init__(
-            _EncodingIterator(base, host_encode), queue_size
+            _EncodingIterator(base, host_encode, batch_group),
+            queue_size,
         )
         self._device_decode = device_decode
         self._jit_decode = None
         self._user_base = base
+        self._pending: list = []
+        self._emit_chunks = emit_chunks
+
+    def has_next(self) -> bool:
+        return bool(self._pending) or super().has_next()
+
+    def _decode_fn(self, grouped: bool):
+        """Jitted decode, cached ON the codec function so it (and its
+        compiled programs) survive iterator recreation — a fresh
+        fit() per epoch/window must not retrace."""
+        import jax
+
+        attr = "_dl4j_jit_group" if grouped else "_dl4j_jit_single"
+        fn = getattr(self._device_decode, attr, None)
+        if fn is None:
+            fn = jax.jit(
+                jax.vmap(self._device_decode) if grouped
+                else self._device_decode
+            )
+            try:
+                setattr(self._device_decode, attr, fn)
+            except AttributeError:
+                pass  # bound methods etc.: per-instance jit
+        return fn
 
     def next(self) -> DataSet:
-        payload = super().next()
-        if self._device_decode is None:
-            f, l, lm, fm = payload
+        if self._pending:
+            return self._pending.pop(0)
+        tag, k, stacked = super().next()
+        if tag == "single":
+            if self._device_decode is not None:
+                f, l, lm, fm = self._decode_fn(False)(stacked)
+            else:
+                f, l, lm, fm = stacked
             return DataSet(features=f, labels=l, labels_mask=lm,
                            features_mask=fm)
-        if self._jit_decode is None:
-            import jax
+        if self._device_decode is not None:
+            if self._jit_decode is None:
+                self._jit_decode = self._decode_fn(True)
+            f, l, lm, fm = self._jit_decode(stacked)
+        else:
+            f, l, lm, fm = stacked
+        if self._emit_chunks:
+            from deeplearning4j_tpu.datasets.api import ChunkedDataSet
 
-            self._jit_decode = jax.jit(self._device_decode)
-        f, l, lm, fm = self._jit_decode(payload)
-        return DataSet(features=f, labels=l, labels_mask=lm,
-                       features_mask=fm)
+            return ChunkedDataSet(
+                features=f, labels=l, labels_mask=lm,
+                features_mask=fm,
+            )
+        self._pending = [
+            DataSet(
+                features=f[i], labels=l[i],
+                labels_mask=None if lm is None else lm[i],
+                features_mask=None if fm is None else fm[i],
+            )
+            for i in range(k)
+        ]
+        return self._pending.pop(0)
+
+    def reset(self) -> None:
+        self._pending = []
+        super().reset()
 
     def batch(self) -> int:
         return self._user_base.batch()

@@ -118,16 +118,33 @@ class DataSetLossCalculator:
         self.average = average
 
     def calculate_score(self, model) -> float:
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet, DataSet
+
         total, n = 0.0, 0
         for ds in self.iterator:
-            # weight each batch by its example count (reference
-            # DataSetLossCalculator.java:36-41: lossSum += score*nEx)
-            if hasattr(ds, "num_examples"):
-                n_ex = ds.num_examples()
+            if isinstance(ds, ChunkedDataSet):
+                # score() consumes single minibatches; unstack
+                batches = [
+                    DataSet(
+                        features=ds.features[i], labels=ds.labels[i],
+                        features_mask=(None if ds.features_mask is None
+                                       else ds.features_mask[i]),
+                        labels_mask=(None if ds.labels_mask is None
+                                     else ds.labels_mask[i]),
+                    )
+                    for i in range(ds.k)
+                ]
             else:
-                n_ex = int(np.asarray(ds.features).shape[0])
-            total += model.score(ds) * n_ex
-            n += n_ex
+                batches = [ds]
+            for b in batches:
+                # weight each batch by its example count (reference
+                # DataSetLossCalculator.java:36-41: lossSum += score*nEx)
+                if hasattr(b, "num_examples"):
+                    n_ex = b.num_examples()
+                else:
+                    n_ex = int(np.shape(b.features)[0])
+                total += model.score(b) * n_ex
+                n += n_ex
         if hasattr(self.iterator, "reset"):
             self.iterator.reset()
         if n == 0:

@@ -41,15 +41,55 @@ from deeplearning4j_tpu.nlp.vocab import (
 # ---------------------------------------------------------------------------
 
 
+def _dense_rows() -> bool:
+    """Route table lookups through one-hot matmuls instead of gathers
+    on TPU (small vocabs only): the GRADIENT of a gather is a scatter-
+    add, which TPUs execute row-serially — the dominant cost of the NS
+    step at bench scale — while the gradient of ``one_hot @ table`` is
+    a transpose matmul on the MXU. The matmul runs at bf16 input
+    precision (f32 accumulation), so results match the gather path to
+    ~1e-4 — SGD-level rounding, within the statistical-parity contract
+    this trainer already documents vs the reference's racy hogwild
+    (module docstring; SURVEY.md §7 hard part 3). Both engine paths
+    (per-batch and scan) route through the same lookup, so path-
+    equivalence tests stay exact. Env override: DL4J_TPU_W2V_DENSE=1/0."""
+    import os
+
+    from deeplearning4j_tpu.ops.dispatch import effective_platform
+
+    env = os.environ.get("DL4J_TPU_W2V_DENSE", "auto").lower()
+    if env in ("1", "true", "on"):
+        return True
+    if env in ("0", "false", "off"):
+        return False
+    return effective_platform() == "tpu"
+
+
+_DENSE_VOCAB_MAX = 8192  # above this the one-hot outweighs the scatter
+
+
+def _rows(table, ids):
+    """table[ids] with a dense (MXU) gradient when allowed."""
+    if _dense_rows() and table.shape[0] <= _DENSE_VOCAB_MAX:
+        oh = jax.nn.one_hot(
+            ids, table.shape[0], dtype=jnp.bfloat16
+        )
+        return jnp.einsum(
+            "...v,vd->...d", oh, table,
+            preferred_element_type=table.dtype,
+        )
+    return table[ids]
+
+
 def _ns_step_raw(syn0, syn1neg, centers, contexts, negs, mask, alpha):
     """Negative-sampling step (SkipGram: centers=input word ids,
     contexts=predicted word ids; CBOW passes precomputed context means
     through ``_ns_step_cbow`` instead)."""
     def loss_fn(tables):
         s0, s1 = tables
-        v = s0[centers]                      # [B, D]
-        u_pos = s1[contexts]                 # [B, D]
-        u_neg = s1[negs]                     # [B, K, D]
+        v = _rows(s0, centers)               # [B, D]
+        u_pos = _rows(s1, contexts)          # [B, D]
+        u_neg = _rows(s1, negs)              # [B, K, D]
         pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, axis=-1))
         # a drawn negative equal to the true context is masked out (the
         # reference resamples on collision; masking is the static-shape
@@ -73,8 +113,8 @@ def _hs_step_raw(syn0, syn1, centers, codes, points, path_mask, mask,
     -log σ((1-2·code)·(v_center · syn1[point]))."""
     def loss_fn(tables):
         s0, s1 = tables
-        v = s0[centers]                      # [B, D]
-        u = s1[points]                       # [B, L, D]
+        v = _rows(s0, centers)               # [B, D]
+        u = _rows(s1, points)                # [B, L, D]
         x = jnp.einsum("bd,bld->bl", v, u)
         sign = 1.0 - 2.0 * codes
         ll = jax.nn.log_sigmoid(sign * x) * path_mask
@@ -117,7 +157,7 @@ def _sg_scan_steps(syn0, syn1, syn1neg, centers_k, contexts_k, codes_k,
 
 
 def _cbow_hidden(s0, ctx_ids, ctx_mask):
-    ctx = s0[ctx_ids]                        # [B, W, D]
+    ctx = _rows(s0, ctx_ids)                 # [B, W, D]
     denom = jnp.maximum(jnp.sum(ctx_mask, axis=-1, keepdims=True), 1.0)
     return jnp.sum(ctx * ctx_mask[..., None], axis=1) / denom  # [B, D]
 
@@ -130,8 +170,8 @@ def _cbow_ns_step(syn0, syn1neg, ctx_ids, ctx_mask, targets, negs, mask,
     def loss_fn(tables):
         s0, s1 = tables
         h = _cbow_hidden(s0, ctx_ids, ctx_mask)
-        u_pos = s1[targets]
-        u_neg = s1[negs]
+        u_pos = _rows(s1, targets)
+        u_neg = _rows(s1, negs)
         pos = jax.nn.log_sigmoid(jnp.sum(h * u_pos, axis=-1))
         nvalid = (negs != targets[:, None]).astype(h.dtype)
         neg = jnp.sum(
@@ -153,7 +193,7 @@ def _cbow_hs_step(syn0, syn1, ctx_ids, ctx_mask, codes, points, path_mask,
     def loss_fn(tables):
         s0, s1 = tables
         h = _cbow_hidden(s0, ctx_ids, ctx_mask)
-        u = s1[points]                       # [B, L, D]
+        u = _rows(s1, points)                # [B, L, D]
         x = jnp.einsum("bd,bld->bl", h, u)
         sign = 1.0 - 2.0 * codes
         ll = jax.nn.log_sigmoid(sign * x) * path_mask

@@ -340,8 +340,10 @@ class ComputationGraph:
 
     def _ds_scan_sig(self, ds) -> tuple:
         def sh(v):
+            # np.shape, NOT np.asarray(a).shape — asarray would pull
+            # device arrays to host per batch (see multilayer.py)
             return tuple(
-                None if a is None else np.asarray(a).shape
+                None if a is None else tuple(np.shape(a))
                 for a in v
             ) if v else None
         f, l, fm, lm = self._ds_arrays(ds)
@@ -357,10 +359,19 @@ class ComputationGraph:
         return features, labels, fmasks or None, lmasks or None
 
     def _fit_epoch_scan(self, it) -> int:
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
+
         buf: list = []
         sig = None
         n = 0
         for ds in it:
+            if isinstance(ds, ChunkedDataSet):
+                if buf:
+                    self._flush_scan_chunk(buf)
+                    buf, sig = [], None
+                self._run_prestacked_chunk(ds)
+                n += ds.k
+                continue
             s = self._ds_scan_sig(ds)
             if buf and s != sig:
                 self._flush_scan_chunk(buf)
@@ -406,6 +417,32 @@ class ComputationGraph:
             self.fit_minibatch(batches[0])
             return
         self._run_scan_chunk(self._stack_chunk(batches))
+
+    def _run_prestacked_chunk(self, ds) -> None:
+        """One fused dispatch from a single-input ChunkedDataSet's
+        [k, b, ...] arrays (same dtype contract as _stack_on_device)."""
+        dtype = self._dtype()
+
+        def prep(a):
+            if a is None:
+                return None
+            a = a if isinstance(a, jax.Array) else jnp.asarray(a)
+            return (
+                a
+                if a.dtype.kind in ("u", "i") and a.dtype.itemsize <= 2
+                else a.astype(dtype)
+            )
+
+        if ds.k == 1:
+            self.fit_minibatch(ds)  # fit_minibatch unstacks
+            return
+        self._run_scan_chunk((
+            [prep(ds.features)], [prep(ds.labels)],
+            None if ds.features_mask is None
+            else [prep(ds.features_mask)],
+            None if ds.labels_mask is None else [prep(ds.labels_mask)],
+            ds.k,
+        ))
 
     def _run_scan_chunk(self, stacked) -> None:
         from deeplearning4j_tpu.nn.multilayer import (
@@ -628,6 +665,20 @@ class ComputationGraph:
             self.epoch_count += 1
 
     def fit_minibatch(self, ds) -> float:
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet, DataSet
+
+        if isinstance(ds, ChunkedDataSet):
+            # non-scan fallback: unstack and train per batch
+            score = None
+            for i in range(ds.k):
+                score = self.fit_minibatch(DataSet(
+                    features=ds.features[i], labels=ds.labels[i],
+                    features_mask=(None if ds.features_mask is None
+                                   else ds.features_mask[i]),
+                    labels_mask=(None if ds.labels_mask is None
+                                 else ds.labels_mask[i]),
+                ))
+            return score
         if self.params is None:
             self.init()
         if self.conf.optimization_algo != "STOCHASTIC_GRADIENT_DESCENT":

@@ -701,7 +701,11 @@ class MultiLayerNetwork:
 
     def _ds_scan_sig(self, ds) -> tuple:
         def sh(a):
-            return None if a is None else np.asarray(a).shape
+            # np.shape, NOT np.asarray(a).shape: asarray on a device
+            # array is a blocking device->host materialization (~100ms
+            # through a remote tunnel) — per batch, it dwarfed the
+            # training itself on the streamed-iterator path
+            return None if a is None else tuple(np.shape(a))
         return (
             sh(ds.features), sh(ds.labels),
             sh(getattr(ds, "labels_mask", None)),
@@ -710,12 +714,23 @@ class MultiLayerNetwork:
 
     def _fit_epoch_scan(self, it) -> int:
         """Buffer same-shaped minibatches into chunks of
-        ``self.scan_chunk`` and run each chunk as one fused dispatch."""
+        ``self.scan_chunk`` and run each chunk as one fused dispatch.
+        ``ChunkedDataSet`` items (pre-stacked [k, b, ...] payloads from
+        an input pipeline) feed the dispatch directly."""
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
+
         self._reset_recurrent_state()  # scan carries empty rnn entries
         buf: List[Any] = []
         sig = None
         n = 0
         for ds in it:
+            if isinstance(ds, ChunkedDataSet):
+                if buf:
+                    self._flush_scan_chunk(buf)
+                    buf, sig = [], None
+                self._run_prestacked_chunk(ds)
+                n += ds.k
+                continue
             s = self._ds_scan_sig(ds)
             if buf and s != sig:
                 self._flush_scan_chunk(buf)
@@ -758,6 +773,42 @@ class MultiLayerNetwork:
         if self._wants_last_features():
             self._last_features = batches[-1].features
         self._run_scan_chunk(self._stack_chunk(batches))
+
+    def _run_prestacked_chunk(self, ds) -> None:
+        """One fused dispatch from a ChunkedDataSet's [k, b, ...]
+        arrays (same dtype contract as _stack_on_device: narrow ints
+        ride as-is and cast on device)."""
+        dtype = _dtype_of(self.conf)
+
+        def prep(a):
+            if a is None:
+                return None
+            a = a if isinstance(a, jax.Array) else jnp.asarray(a)
+            return (
+                a
+                if a.dtype.kind in ("u", "i") and a.dtype.itemsize <= 2
+                else a.astype(dtype)
+            )
+
+        k = ds.k
+        if k == 1:
+            from deeplearning4j_tpu.datasets.api import DataSet
+
+            def first(a):
+                return None if a is None else a[0]
+
+            self.fit_minibatch(DataSet(
+                features=first(ds.features), labels=first(ds.labels),
+                features_mask=first(ds.features_mask),
+                labels_mask=first(ds.labels_mask),
+            ))
+            return
+        if self._wants_last_features():
+            self._last_features = ds.features[-1]
+        self._run_scan_chunk((
+            prep(ds.features), prep(ds.labels), prep(ds.labels_mask),
+            prep(ds.features_mask), k,
+        ))
 
     def _run_scan_chunk(self, stacked) -> None:
         """One fused k-step dispatch from pre-stacked device arrays."""
@@ -975,6 +1026,20 @@ class MultiLayerNetwork:
         (reference Solver/StochasticGradientDescent.optimize; LBFGS/
         ConjugateGradient/LineGradientDescent route through
         ``optimize.solvers.Solver``)."""
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet, DataSet
+
+        if isinstance(ds, ChunkedDataSet):
+            # non-scan fallback: unstack and train per batch
+            score = None
+            for i in range(ds.k):
+                score = self.fit_minibatch(DataSet(
+                    features=ds.features[i], labels=ds.labels[i],
+                    features_mask=(None if ds.features_mask is None
+                                   else ds.features_mask[i]),
+                    labels_mask=(None if ds.labels_mask is None
+                                 else ds.labels_mask[i]),
+                ))
+            return score
         if self.params is None:
             self.init()
         if self.conf.optimization_algo != "STOCHASTIC_GRADIENT_DESCENT":

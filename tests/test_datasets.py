@@ -293,15 +293,94 @@ def test_packbits_codec_roundtrip_and_fit():
         return MultiLayerNetwork(conf).init()
 
     a = make_net()
+    # batch_group=2: grouped transfers (one device_put per 2 batches,
+    # group-decoded and split on device) must not change training
     it = DevicePrefetchIterator(
         ListDataSetIterator(batches), queue_size=2,
-        host_encode=enc, device_decode=dec,
+        host_encode=enc, device_decode=dec, batch_group=2,
     )
     a.fit(it, epochs=2)
     plain = make_net()
     plain.fit(batches, epochs=2)
     import conftest
 
+    conftest.assert_params_match(a, plain)
+    # emit_chunks: pre-stacked ChunkedDataSets feed the fused scan
+    # directly — identical training again (scan path, chunk >= group)
+    c = make_net()
+    c.scan_chunk = 5
+    it = DevicePrefetchIterator(
+        ListDataSetIterator(batches), queue_size=2,
+        host_encode=enc, device_decode=dec, batch_group=5,
+        emit_chunks=True,
+    )
+    c.fit(it, epochs=2)
+    conftest.assert_params_match(c, plain)
+    # ...and through the non-scan fallback (fit_minibatch unstacks)
+    d = make_net()
+    d.scan_chunk = 1
+    it = DevicePrefetchIterator(
+        ListDataSetIterator(batches), queue_size=2,
+        host_encode=enc, device_decode=dec, batch_group=5,
+        emit_chunks=True,
+    )
+    d.fit(it, epochs=2)
+    conftest.assert_params_match(d, plain)
+
+
+def test_chunked_dataset_feeds_computation_graph():
+    """The graph engine consumes ChunkedDataSets natively too (scan
+    branch + fit_minibatch fallback), matching a plain list fit."""
+    import conftest
+
+    from deeplearning4j_tpu.datasets import (
+        DevicePrefetchIterator,
+        make_packbits_codec,
+    )
+    from deeplearning4j_tpu.nn.conf import (
+        InputType,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    rng = np.random.RandomState(11)
+    d, n_classes, b = 12, 3, 8
+    batches = [
+        DataSet(
+            features=(rng.rand(b, d) > 0.5).astype(np.float32),
+            labels=np.eye(n_classes, dtype=np.float32)[
+                rng.randint(0, n_classes, b)
+            ],
+        )
+        for _ in range(6)
+    ]
+
+    def make_graph():
+        g = (
+            NeuralNetConfiguration.Builder().seed(4).learning_rate(0.1)
+            .updater("SGD").activation("tanh").graph_builder()
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_in=d, n_out=8), "in")
+            .add_layer("out", OutputLayer(n_out=n_classes,
+                                          loss="MCXENT"), "h")
+        )
+        g.set_outputs("out")
+        g.set_input_types(InputType.feed_forward(d))
+        return ComputationGraph(g.build()).init()
+
+    enc, dec = make_packbits_codec(d, n_classes)
+    a = make_graph()
+    a.scan_chunk = 3
+    it = DevicePrefetchIterator(
+        ListDataSetIterator(batches), queue_size=2,
+        host_encode=enc, device_decode=dec, batch_group=3,
+        emit_chunks=True,
+    )
+    a.fit(it, epochs=2)
+    plain = make_graph()
+    plain.scan_chunk = 3
+    plain.fit(batches, epochs=2)
     conftest.assert_params_match(a, plain)
 
 
