@@ -129,6 +129,21 @@ class ChunkedDataSet:
         s = np.shape(self.features)
         return int(s[0]) * int(s[1])
 
+    def to_datasets(self) -> List["DataSet"]:
+        """Unstack into k per-batch DataSets (the fallback for
+        consumers without a fused chunk path)."""
+        def at(a, i):
+            return None if a is None else a[i]
+
+        return [
+            DataSet(
+                features=self.features[i], labels=self.labels[i],
+                features_mask=at(self.features_mask, i),
+                labels_mask=at(self.labels_mask, i),
+            )
+            for i in range(self.k)
+        ]
+
 
 @dataclass
 class MultiDataSet:

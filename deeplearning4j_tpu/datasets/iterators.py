@@ -240,6 +240,7 @@ class DevicePrefetchIterator(AsyncDataSetIterator):
         )
         self._device_decode = device_decode
         self._jit_decode = None
+        self._jit_fallback: dict = {}
         self._user_base = base
         self._pending: list = []
         self._emit_chunks = emit_chunks
@@ -250,11 +251,15 @@ class DevicePrefetchIterator(AsyncDataSetIterator):
     def _decode_fn(self, grouped: bool):
         """Jitted decode, cached ON the codec function so it (and its
         compiled programs) survive iterator recreation — a fresh
-        fit() per epoch/window must not retrace."""
+        fit() per epoch/window must not retrace. Codecs that cannot
+        carry attributes (bound methods, partials) fall back to a
+        per-ITERATOR cache, never a per-call jit."""
         import jax
 
         attr = "_dl4j_jit_group" if grouped else "_dl4j_jit_single"
         fn = getattr(self._device_decode, attr, None)
+        if fn is None:
+            fn = self._jit_fallback.get(attr)
         if fn is None:
             fn = jax.jit(
                 jax.vmap(self._device_decode) if grouped
@@ -263,7 +268,7 @@ class DevicePrefetchIterator(AsyncDataSetIterator):
             try:
                 setattr(self._device_decode, attr, fn)
             except AttributeError:
-                pass  # bound methods etc.: per-instance jit
+                self._jit_fallback[attr] = fn
         return fn
 
     def next(self) -> DataSet:
@@ -283,21 +288,14 @@ class DevicePrefetchIterator(AsyncDataSetIterator):
             f, l, lm, fm = self._jit_decode(stacked)
         else:
             f, l, lm, fm = stacked
-        if self._emit_chunks:
-            from deeplearning4j_tpu.datasets.api import ChunkedDataSet
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
 
-            return ChunkedDataSet(
-                features=f, labels=l, labels_mask=lm,
-                features_mask=fm,
-            )
-        self._pending = [
-            DataSet(
-                features=f[i], labels=l[i],
-                labels_mask=None if lm is None else lm[i],
-                features_mask=None if fm is None else fm[i],
-            )
-            for i in range(k)
-        ]
+        chunk = ChunkedDataSet(
+            features=f, labels=l, labels_mask=lm, features_mask=fm,
+        )
+        if self._emit_chunks:
+            return chunk
+        self._pending = chunk.to_datasets()
         return self._pending.pop(0)
 
     def reset(self) -> None:

@@ -118,22 +118,13 @@ class DataSetLossCalculator:
         self.average = average
 
     def calculate_score(self, model) -> float:
-        from deeplearning4j_tpu.datasets.api import ChunkedDataSet, DataSet
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
 
         total, n = 0.0, 0
         for ds in self.iterator:
             if isinstance(ds, ChunkedDataSet):
                 # score() consumes single minibatches; unstack
-                batches = [
-                    DataSet(
-                        features=ds.features[i], labels=ds.labels[i],
-                        features_mask=(None if ds.features_mask is None
-                                       else ds.features_mask[i]),
-                        labels_mask=(None if ds.labels_mask is None
-                                     else ds.labels_mask[i]),
-                    )
-                    for i in range(ds.k)
-                ]
+                batches = ds.to_datasets()
             else:
                 batches = [ds]
             for b in batches:

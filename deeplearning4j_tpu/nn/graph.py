@@ -421,17 +421,15 @@ class ComputationGraph:
     def _run_prestacked_chunk(self, ds) -> None:
         """One fused dispatch from a single-input ChunkedDataSet's
         [k, b, ...] arrays (same dtype contract as _stack_on_device)."""
+        from deeplearning4j_tpu.nn.multilayer import _cast_stacked
+
         dtype = self._dtype()
 
         def prep(a):
             if a is None:
                 return None
             a = a if isinstance(a, jax.Array) else jnp.asarray(a)
-            return (
-                a
-                if a.dtype.kind in ("u", "i") and a.dtype.itemsize <= 2
-                else a.astype(dtype)
-            )
+            return _cast_stacked(a, dtype)
 
         if ds.k == 1:
             self.fit_minibatch(ds)  # fit_minibatch unstacks
@@ -665,19 +663,13 @@ class ComputationGraph:
             self.epoch_count += 1
 
     def fit_minibatch(self, ds) -> float:
-        from deeplearning4j_tpu.datasets.api import ChunkedDataSet, DataSet
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
 
         if isinstance(ds, ChunkedDataSet):
             # non-scan fallback: unstack and train per batch
             score = None
-            for i in range(ds.k):
-                score = self.fit_minibatch(DataSet(
-                    features=ds.features[i], labels=ds.labels[i],
-                    features_mask=(None if ds.features_mask is None
-                                   else ds.features_mask[i]),
-                    labels_mask=(None if ds.labels_mask is None
-                                 else ds.labels_mask[i]),
-                ))
+            for b in ds.to_datasets():
+                score = self.fit_minibatch(b)
             return score
         if self.params is None:
             self.init()
@@ -943,30 +935,39 @@ class ComputationGraph:
         return float(s)
 
     def evaluate(self, iterator):
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
         e = Evaluation()
-        for ds in iterator:
-            fm = (getattr(ds, "features_masks", None)
-                  or getattr(ds, "features_mask", None))
-            out = self.output(
-                *_as_list(ds.features), features_masks=fm
-            )[0]
-            labels = np.asarray(_as_list(ds.labels)[0])
-            m = _as_list(getattr(ds, "labels_masks", None)
-                         or getattr(ds, "labels_mask", None))
-            mask = m[0] if m else None
-            if mask is None and labels.ndim == 3:
-                # per-timestep labels without a labels mask: fall back
-                # to the features mask (same rule as MLN.evaluate);
-                # 2-d per-sequence labels must not take a [b, t] mask
-                fml = _as_list(fm)
-                mask = fml[0] if fml else None
-            e.eval(labels, np.asarray(out),
-                   mask=np.asarray(mask) if mask is not None else None)
+        for item in iterator:
+            batches = (
+                item.to_datasets() if isinstance(item, ChunkedDataSet)
+                else [item]
+            )
+            for ds in batches:
+                self._evaluate_one(e, ds)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return e
+
+    def _evaluate_one(self, e, ds) -> None:
+        fm = (getattr(ds, "features_masks", None)
+              or getattr(ds, "features_mask", None))
+        out = self.output(
+            *_as_list(ds.features), features_masks=fm
+        )[0]
+        labels = np.asarray(_as_list(ds.labels)[0])
+        m = _as_list(getattr(ds, "labels_masks", None)
+                     or getattr(ds, "labels_mask", None))
+        mask = m[0] if m else None
+        if mask is None and labels.ndim == 3:
+            # per-timestep labels without a labels mask: fall back
+            # to the features mask (same rule as MLN.evaluate);
+            # 2-d per-sequence labels must not take a [b, t] mask
+            fml = _as_list(fm)
+            mask = fml[0] if fml else None
+        e.eval(labels, np.asarray(out),
+               mask=np.asarray(mask) if mask is not None else None)
 
     # ------------------------------------------------------------------
 

@@ -74,6 +74,31 @@ def _cast_floats(tree, dtype):
     )
 
 
+def _iter_unchunked(data):
+    """Iterate minibatches, expanding any ChunkedDataSet elements
+    (streamed pipelines may deliver pre-stacked chunks; consumers
+    without a fused path unstack here)."""
+    from deeplearning4j_tpu.datasets.api import ChunkedDataSet
+
+    for d in data:
+        if isinstance(d, ChunkedDataSet):
+            yield from d.to_datasets()
+        else:
+            yield d
+
+
+def _cast_stacked(a, dtype):
+    """The cast-on-device contract shared by _stack_on_device and the
+    prestacked-chunk paths of both engines: narrow integers ride at
+    native width (the step casts on device); everything else casts to
+    the model dtype."""
+    return (
+        a
+        if a.dtype.kind in ("u", "i") and a.dtype.itemsize <= 2
+        else a.astype(dtype)
+    )
+
+
 def _stack_on_device(arrs, dtype):
     """Stack k same-shaped minibatch arrays for a fused dispatch,
     preserving the cast-on-device contract in ONE place for both
@@ -81,15 +106,9 @@ def _stack_on_device(arrs, dtype):
     trip), narrow integer inputs (uint8 pixels/one-hots) keep their
     native width — the step casts them on device."""
     if all(isinstance(a, jax.Array) for a in arrs):
-        stacked = jnp.stack(arrs)
-    else:
-        return _to_device(
-            np.stack([np.asarray(a) for a in arrs]), dtype
-        )
-    return (
-        stacked
-        if stacked.dtype.kind in ("u", "i") and stacked.dtype.itemsize <= 2
-        else stacked.astype(dtype)
+        return _cast_stacked(jnp.stack(arrs), dtype)
+    return _to_device(
+        np.stack([np.asarray(a) for a in arrs]), dtype
     )
 
 
@@ -784,11 +803,7 @@ class MultiLayerNetwork:
             if a is None:
                 return None
             a = a if isinstance(a, jax.Array) else jnp.asarray(a)
-            return (
-                a
-                if a.dtype.kind in ("u", "i") and a.dtype.itemsize <= 2
-                else a.astype(dtype)
-            )
+            return _cast_stacked(a, dtype)
 
         k = ds.k
         if k == 1:
@@ -1026,19 +1041,13 @@ class MultiLayerNetwork:
         (reference Solver/StochasticGradientDescent.optimize; LBFGS/
         ConjugateGradient/LineGradientDescent route through
         ``optimize.solvers.Solver``)."""
-        from deeplearning4j_tpu.datasets.api import ChunkedDataSet, DataSet
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
 
         if isinstance(ds, ChunkedDataSet):
             # non-scan fallback: unstack and train per batch
             score = None
-            for i in range(ds.k):
-                score = self.fit_minibatch(DataSet(
-                    features=ds.features[i], labels=ds.labels[i],
-                    features_mask=(None if ds.features_mask is None
-                                   else ds.features_mask[i]),
-                    labels_mask=(None if ds.labels_mask is None
-                                 else ds.labels_mask[i]),
-                ))
+            for b in ds.to_datasets():
+                score = self.fit_minibatch(b)
             return score
         if self.params is None:
             self.init()
@@ -1209,12 +1218,14 @@ class MultiLayerNetwork:
         pretrainable layer (VAE/RBM/AutoEncoder) on the activations of
         the stack below it (reference ``pretrain(DataSetIterator)`` →
         per-layer fit at ``MultiLayerNetwork.java:166``)."""
-        from deeplearning4j_tpu.datasets.api import DataSet
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet, DataSet
         from deeplearning4j_tpu.nn.updaters import MultiLayerUpdaterDef
 
         if self.params is None:
             self.init()
-        if hasattr(data, "features"):
+        if isinstance(data, ChunkedDataSet):
+            data = data.to_datasets()
+        elif hasattr(data, "features"):
             data = [data]
         elif (
             isinstance(data, tuple) and len(data) == 2
@@ -1247,7 +1258,7 @@ class MultiLayerNetwork:
             step = self._jit_pretrain_steps[idx]
             it = 0
             for _ in range(epochs):
-                for ds in data:
+                for ds in _iter_unchunked(data):
                     x = jnp.asarray(
                         ds.features if hasattr(ds, "features") else ds, dtype
                     )
@@ -1396,26 +1407,35 @@ class MultiLayerNetwork:
         return np.asarray(jnp.argmax(self.output(x), axis=1))
 
     def evaluate(self, iterator):
+        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
         e = Evaluation()
-        for ds in iterator:
-            out = self.output(
-                ds.features,
-                features_mask=getattr(ds, "features_mask", None),
+        for item in iterator:
+            batches = (
+                item.to_datasets() if isinstance(item, ChunkedDataSet)
+                else [item]
             )
-            labels = np.asarray(ds.labels)
-            m = getattr(ds, "labels_mask", None)
-            if m is None and labels.ndim == 3:
-                # per-timestep eval falls back to the features mask;
-                # 2-d (per-sequence) labels must NOT — a [b, t] mask
-                # cannot index b rows
-                m = getattr(ds, "features_mask", None)
-            e.eval(labels, np.asarray(out),
-                   mask=np.asarray(m) if m is not None else None)
+            for ds in batches:
+                self._evaluate_one(e, ds)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return e
+
+    def _evaluate_one(self, e, ds) -> None:
+        out = self.output(
+            ds.features,
+            features_mask=getattr(ds, "features_mask", None),
+        )
+        labels = np.asarray(ds.labels)
+        m = getattr(ds, "labels_mask", None)
+        if m is None and labels.ndim == 3:
+            # per-timestep eval falls back to the features mask;
+            # 2-d (per-sequence) labels must NOT — a [b, t] mask
+            # cannot index b rows
+            m = getattr(ds, "features_mask", None)
+        e.eval(labels, np.asarray(out),
+               mask=np.asarray(m) if m is not None else None)
 
     # -- listeners ------------------------------------------------------
 

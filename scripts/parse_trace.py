@@ -1,0 +1,68 @@
+"""Aggregate a jax profiler xplane trace into per-op time fractions
+(TPU device plane), without tensorboard: parse xplane_pb2 directly
+and roll up LEAF event durations on the ``XLA Ops`` line by HLO
+category / op name (container events — while wrappers, module/step
+spans — are excluded so fractions sum to wall time).
+
+Usage: python scripts/parse_trace.py <trace_dir> [top_n]
+"""
+import collections
+import glob
+import sys
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+
+def _is_container(name: str) -> bool:
+    return (
+        name.startswith(("%while", "jit_"))
+        or name.isdigit()
+        or name == "?"
+    )
+
+
+def main():
+    trace_dir = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    paths = glob.glob(f"{trace_dir}/plugins/profile/*/*.xplane.pb")
+    sp = xplane_pb2.XSpace()
+    sp.ParseFromString(open(sorted(paths)[-1], "rb").read())
+    for plane in sp.planes:
+        if "TPU" not in plane.name:
+            continue
+        meta = {m.id: m.name for m in plane.event_metadata.values()}
+        smeta = {m.id: m.name for m in plane.stat_metadata.values()}
+        cat_of = {}
+        for m in plane.event_metadata.values():
+            for st in m.stats:
+                if smeta.get(st.metadata_id) == "hlo_category":
+                    cat_of[m.id] = st.str_value
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            evs = [
+                ev for ev in line.events
+                if not _is_container(meta.get(ev.metadata_id, "?"))
+            ]
+            total = sum(ev.duration_ps for ev in evs)
+            if not total:
+                continue
+            by_op = collections.Counter()
+            by_cat = collections.Counter()
+            for ev in evs:
+                by_op[meta.get(ev.metadata_id, "?")] += ev.duration_ps
+                by_cat[
+                    cat_of.get(ev.metadata_id, "uncategorized")
+                ] += ev.duration_ps
+            print(f"== plane: {plane.name}  "
+                  f"leaf busy {total/1e9:.1f} ms")
+            print("-- by category --")
+            for cat, d in by_cat.most_common(12):
+                print(f"  {d/total:6.2%}  {cat}")
+            print(f"-- top {top_n} ops --")
+            for op, d in by_op.most_common(top_n):
+                print(f"  {d/total:6.2%}  {op[:100]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,21 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.zoo import transformer_lm
 
 
+def _tols():
+    """Streaming-vs-full tolerance by backend. CPU: both paths run
+    the same f32 XLA math — tight. TPU: the full forward runs the
+    Pallas flash-attention kernel while incremental decode runs the
+    XLA KV-cache path, and both compute at bf16 input precision — two
+    DIFFERENT kernels at 8-bit mantissa, measured ~2% relative on
+    softmax outputs across layers; the contract on TPU is numerical
+    agreement at bf16 scale, not bitwise equality."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return dict(rtol=3e-2, atol=5e-3)
+    return dict(rtol=2e-4, atol=2e-5)
+
+
 def _net(vocab=17, d_model=24, n_layers=2, kv_cache=32):
     conf = transformer_lm(
         vocab=vocab, d_model=d_model, n_layers=n_layers, n_heads=4,
@@ -45,14 +60,14 @@ def test_streaming_matches_full_forward():
         for i in range(t)
     ]
     stream = np.stack(outs, axis=2)
-    np.testing.assert_allclose(stream, full, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(stream, full, **_tols())
 
     # chunked streaming (4+8) matches too, after a reset
     net.rnn_clear_previous_state()
     c1 = np.asarray(net.rnn_time_step(x[:, :, :4]))
     c2 = np.asarray(net.rnn_time_step(x[:, :, 4:]))
     stream2 = np.concatenate([c1, c2], axis=2)
-    np.testing.assert_allclose(stream2, full, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(stream2, full, **_tols())
 
 
 def test_streaming_after_training_generates():
@@ -169,7 +184,7 @@ def test_graph_engine_streaming_matches_full_forward():
         for i in range(t)
     ]
     stream = np.stack(outs, axis=2)
-    np.testing.assert_allclose(stream, full, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(stream, full, **_tols())
 
     # overflow guard exists on the graph path too
     with pytest.raises(ValueError, match="overflow"):
