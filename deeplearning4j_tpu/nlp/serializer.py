@@ -46,19 +46,23 @@ def write_txt(model, path) -> None:
             f.write(f"{cache.word_at(i)} {vals}\n")
 
 
+def _parse_txt(f) -> Tuple[VocabCache, np.ndarray]:
+    header = f.readline().split()
+    v, d = int(header[0]), int(header[1])
+    cache = VocabCache()
+    m = np.zeros((v, d), np.float32)
+    for i in range(v):
+        # rsplit from the right: the word itself may contain
+        # spaces (n-gram vocab entries)
+        parts = f.readline().rstrip("\n").rsplit(" ", d)
+        cache.add(VocabWord(parts[0]))
+        m[i] = [float(x) for x in parts[1:d + 1]]
+    return cache, m
+
+
 def load_txt(path) -> Tuple[VocabCache, np.ndarray]:
     with open(path, "r", encoding="utf-8") as f:
-        header = f.readline().split()
-        v, d = int(header[0]), int(header[1])
-        cache = VocabCache()
-        m = np.zeros((v, d), np.float32)
-        for i in range(v):
-            # rsplit from the right: the word itself may contain
-            # spaces (n-gram vocab entries)
-            parts = f.readline().rstrip("\n").rsplit(" ", d)
-            cache.add(VocabWord(parts[0]))
-            m[i] = [float(x) for x in parts[1:d + 1]]
-    return cache, m
+        return _parse_txt(f)
 
 
 def write_binary(model, path) -> None:
@@ -190,16 +194,78 @@ def load_full_model(path, sequences: Optional[list] = None):
     return model
 
 
+def write_csv(model, path, sep: str = ",") -> None:
+    """CSV interop (reference ``WordVectorSerializer`` CSV variant):
+    one ``word,v1,...,vD`` row per word, no header. Words containing
+    the separator are quoted per csv rules."""
+    import csv
+
+    cache, m = _resolve(model)
+    with open(path, "w", encoding="utf-8", newline="") as f:
+        w = csv.writer(f, delimiter=sep)
+        for i in range(m.shape[0]):
+            w.writerow([cache.word_at(i)]
+                       + [repr(float(x)) for x in m[i]])
+
+
+def load_csv(path, sep: str = ",") -> Tuple[VocabCache, np.ndarray]:
+    import csv
+
+    cache = VocabCache()
+    rows = []
+    with open(path, "r", encoding="utf-8", newline="") as f:
+        for parts in csv.reader(f, delimiter=sep):
+            if not parts:
+                continue
+            cache.add(VocabWord(parts[0]))
+            rows.append([float(x) for x in parts[1:]])
+    return cache, np.asarray(rows, np.float32)
+
+
+def write_zip(model, path) -> None:
+    """Zip-compressed text vectors (reference zip variant:
+    ``words.txt`` inside a zip — the compressed interchange format for
+    large vocabularies)."""
+    import io
+
+    cache, m = _resolve(model)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        buf = io.StringIO()
+        buf.write(f"{m.shape[0]} {m.shape[1]}\n")
+        for i in range(m.shape[0]):
+            vals = " ".join(repr(float(x)) for x in m[i])
+            buf.write(f"{cache.word_at(i)} {vals}\n")
+        z.writestr("words.txt", buf.getvalue())
+
+
+def load_zip(path) -> Tuple[VocabCache, np.ndarray]:
+    import io
+
+    with zipfile.ZipFile(path, "r") as z:
+        data = z.read("words.txt").decode("utf-8")
+    return _parse_txt(io.StringIO(data))
+
+
 def write_word_vectors(model, path) -> None:
-    """Dispatch on extension (.bin → binary, else txt) — reference
-    ``writeWordVectors`` overloads."""
-    if str(path).endswith(".bin"):
+    """Dispatch on extension (.bin → binary, .csv → csv, .zip → zip,
+    else txt) — reference ``writeWordVectors`` overloads."""
+    p = str(path)
+    if p.endswith(".bin"):
         write_binary(model, path)
+    elif p.endswith(".csv"):
+        write_csv(model, path)
+    elif p.endswith(".zip"):
+        write_zip(model, path)
     else:
         write_txt(model, path)
 
 
 def read_word_vectors(path) -> Tuple[VocabCache, np.ndarray]:
-    if str(path).endswith(".bin"):
+    p = str(path)
+    if p.endswith(".bin"):
         return load_binary(path)
+    if p.endswith(".csv"):
+        return load_csv(path)
+    if p.endswith(".zip"):
+        return load_zip(path)
     return load_txt(path)

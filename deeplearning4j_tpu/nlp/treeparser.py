@@ -194,18 +194,72 @@ def porter_stem(word: str) -> str:
 
 _CLOSED = {
     "the": "DT", "a": "DT", "an": "DT", "this": "DT", "that": "DT",
-    "and": "CC", "or": "CC", "but": "CC",
+    "these": "DT", "those": "DT", "every": "DT", "each": "DT",
+    "and": "CC", "or": "CC", "but": "CC", "nor": "CC",
     "in": "IN", "on": "IN", "at": "IN", "of": "IN", "for": "IN",
-    "with": "IN", "to": "TO", "by": "IN", "from": "IN",
+    "with": "IN", "to": "TO", "by": "IN", "from": "IN", "over": "IN",
+    "under": "IN", "through": "IN", "than": "IN", "as": "IN",
+    "into": "IN", "about": "IN", "after": "IN", "before": "IN",
+    # be/have/do — the irregular auxiliaries every tagger ships as
+    # closed-class entries (OpenNLP's dictionaries do the same)
     "is": "VBZ", "are": "VBP", "was": "VBD", "were": "VBD", "be": "VB",
+    "been": "VBN", "being": "VBG", "am": "VBP",
+    "have": "VBP", "has": "VBZ", "had": "VBD",
+    "do": "VBP", "does": "VBZ", "did": "VBD", "done": "VBN",
+    # modals
+    "can": "MD", "could": "MD", "may": "MD", "might": "MD",
+    "must": "MD", "shall": "MD", "should": "MD", "will": "MD",
+    "would": "MD",
+    # pronouns / possessives / wh
     "he": "PRP", "she": "PRP", "it": "PRP", "they": "PRP", "we": "PRP",
-    "i": "PRP", "you": "PRP", "not": "RB",
+    "i": "PRP", "you": "PRP", "me": "PRP", "him": "PRP", "her": "PRP",
+    "us": "PRP", "them": "PRP",
+    "my": "PRP$", "your": "PRP$", "his": "PRP$", "its": "PRP$",
+    "our": "PRP$", "their": "PRP$",
+    "who": "WP", "what": "WP", "which": "WDT", "whose": "WP$",
+    "there": "EX",
+    # small numerals (larger ones hit the digit rule)
+    "one": "CD", "two": "CD", "three": "CD", "four": "CD",
+    "five": "CD", "six": "CD", "seven": "CD", "eight": "CD",
+    "nine": "CD", "ten": "CD",
+    # frequent irregular verb forms
+    "went": "VBD", "gone": "VBN", "came": "VBD", "come": "VB",
+    "saw": "VBD", "seen": "VBN", "sat": "VBD", "said": "VBD",
+    "made": "VBD", "took": "VBD", "taken": "VBN", "got": "VBD",
+    "gave": "VBD", "given": "VBN", "knew": "VBD", "known": "VBN",
+    "found": "VBD", "thought": "VBD", "told": "VBD", "became": "VBD",
+    "left": "VBD", "kept": "VBD", "held": "VBD", "brought": "VBD",
+    "wrote": "VBD", "written": "VBN", "stood": "VBD", "heard": "VBD",
+    "met": "VBD", "ran": "VBD", "won": "VBD", "threw": "VBD",
+    "blew": "VBD", "grew": "VBD", "flew": "VBD", "drove": "VBD",
+    "rose": "VBD", "fell": "VBD", "built": "VBD", "slept": "VBD",
+    "spoke": "VBD", "broke": "VBD", "broken": "VBN", "bought": "VBD",
+    "caught": "VBD", "taught": "VBD", "felt": "VBD", "lost": "VBD",
+    "rang": "VBD", "sang": "VBD", "swam": "VBD", "forgot": "VBD",
+    # frequent adverbs that morphology misses
+    "not": "RB", "very": "RB", "too": "RB", "also": "RB", "often": "RB",
+    "never": "RB", "always": "RB", "again": "RB", "soon": "RB",
+    "twice": "RB", "once": "RB", "here": "RB", "now": "RB",
+    "then": "RB", "together": "RB", "away": "RB",
 }
 
 
 def pos_tag(tokens: Sequence[str]) -> List[str]:
-    """Suffix-heuristic POS tags (closed-class lexicon + morphology;
-    the reference loads statistical ClearTK/OpenNLP models)."""
+    """Statistical POS tags via the averaged-perceptron tagger
+    (reference ``PoStagger.java:54`` wraps a trained OpenNLP model;
+    :mod:`deeplearning4j_tpu.nlp.pos_tagger` is the trained-model
+    analog). The rule tagger below stays as the dependency-free
+    fallback (``pos_tag_rules``) and backstops unseen feature sets."""
+    if not tokens:
+        return []
+    from deeplearning4j_tpu.nlp.pos_tagger import default_tagger
+
+    return [t for _, t in default_tagger().tag(list(tokens))]
+
+
+def pos_tag_rules(tokens: Sequence[str]) -> List[str]:
+    """Suffix-heuristic POS tags (closed-class lexicon + morphology) —
+    the pre-statistical fallback."""
     tags = []
     for tok in tokens:
         low = tok.lower()

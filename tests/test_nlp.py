@@ -184,6 +184,50 @@ def test_serializer_roundtrips(tmp_path):
     )
 
 
+def test_serializer_csv_and_zip_roundtrips(tmp_path):
+    """The reference WordVectorSerializer's CSV and zip variants: both
+    round-trip bit-exact (repr floats) and route through the
+    write/read_word_vectors extension dispatch."""
+    from deeplearning4j_tpu.nlp.serializer import (
+        load_csv,
+        load_zip,
+        read_word_vectors,
+        write_csv,
+        write_word_vectors,
+        write_zip,
+    )
+
+    w2v = (
+        Word2Vec.Builder().min_word_frequency(1).layer_size(5)
+        .epochs(1).seed(4).batch_size(16)
+        .iterate(CollectionSentenceIterator(
+            ["red green blue", "green blue yellow"]))
+        .build()
+    )
+    w2v.fit()
+    csv_p = tmp_path / "vecs.csv"
+    write_csv(w2v, csv_p)
+    cache, m = load_csv(csv_p)
+    i = cache.index_of("green")
+    np.testing.assert_array_equal(m[i], w2v.get_word_vector("green"))
+
+    zip_p = tmp_path / "vecs.zip"
+    write_zip(w2v, zip_p)
+    cache2, m2 = load_zip(zip_p)
+    np.testing.assert_array_equal(
+        m2[cache2.index_of("blue")], w2v.get_word_vector("blue")
+    )
+    # extension dispatch picks the right codec both ways
+    for name in ("d.csv", "d.zip", "d.bin", "d.txt"):
+        p = tmp_path / name
+        write_word_vectors(w2v, p)
+        c3, m3 = read_word_vectors(p)
+        np.testing.assert_allclose(
+            m3[c3.index_of("red")], w2v.get_word_vector("red"),
+            rtol=1e-6,
+        )
+
+
 def test_serializer_ngram_words(tmp_path):
     """Vocab words containing spaces (n-grams) round-trip through txt
     (rsplit parsing) and map to '_' in binary (format limitation)."""

@@ -97,6 +97,60 @@ def test_tree_vectorizer_attaches_vectors():
     np.testing.assert_array_equal(by_word["dogs"], np.ones(4))
 
 
+def test_perceptron_tagger_heldout_accuracy():
+    """The statistical tagger (OpenNLP-analog) beats the rule tagger
+    on held-out sentences from the bundled treebank."""
+    from deeplearning4j_tpu.nlp.pos_tagger import (
+        AveragedPerceptronTagger,
+        load_treebank,
+    )
+    from deeplearning4j_tpu.nlp.treeparser import pos_tag_rules
+
+    bank = load_treebank()
+    assert len(bank) >= 70
+    held = bank[::5]          # every 5th sentence held out
+    train = [s for i, s in enumerate(bank) if i % 5]
+    tagger = AveragedPerceptronTagger().train(train, seed=7)
+
+    def acc(tag_fn):
+        good = total = 0
+        for sent in held:
+            words = [w for w, _ in sent]
+            tags = tag_fn(words)
+            for (w, gold), got in zip(sent, tags):
+                good += int(gold == got)
+                total += 1
+        return good / total
+
+    a_stat = acc(lambda ws: [t for _, t in tagger.tag(ws)])
+    a_rule = acc(lambda ws: pos_tag_rules(ws))
+    assert a_stat > 0.85, a_stat
+    assert a_stat > a_rule, (a_stat, a_rule)
+
+
+def test_perceptron_tagger_save_load_and_default(tmp_path):
+    from deeplearning4j_tpu.nlp.pos_tagger import (
+        default_tagger,
+        AveragedPerceptronTagger,
+    )
+    from deeplearning4j_tpu.nlp.treeparser import pos_tag
+
+    t = default_tagger()
+    sent = "The engineers quickly fixed the broken server".split()
+    tags = [tag for _, tag in t.tag(sent)]
+    assert tags == pos_tag(sent)  # treeparser routes through it
+    assert tags[0] == "DT" and tags[1] == "NNS"
+    assert tags[2] == "RB" and tags[3] == "VBD"
+    # persistence round-trip predicts identically
+    p = tmp_path / "tagger.json"
+    t.save(p)
+    t2 = AveragedPerceptronTagger.load(p)
+    assert [x for _, x in t2.tag(sent)] == tags
+    # wholly unseen tokens fall back to morphology, never crash
+    weird = [tag for _, tag in t.tag(["zzzqqq", "flumming"])]
+    assert len(weird) == 2
+
+
 def test_japanese_dict_segmentation_beats_script_runs():
     """The Viterbi/dictionary segmenter (Kuromoji analog,
     nlp/japanese.py) splits inside same-script runs where the
