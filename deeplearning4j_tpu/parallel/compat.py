@@ -1,0 +1,35 @@
+"""jax version-compat shims shared by every parallel module.
+
+The public home of the ``shard_map`` wrapper previously tucked into
+``sequence.py`` — trainer, pipeline, expert, the profile scripts and
+``__graft_entry__`` all depend on it, so it lives here rather than
+inside the ring-attention module.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map_compat():
+    """shard_map across jax versions: >=0.8 renamed ``check_rep`` to
+    ``check_vma`` and moved the function out of ``jax.experimental``.
+    Returns a wrapper with the stable pre-0.8 keyword surface."""
+    import inspect
+
+    try:
+        fn = jax.shard_map  # jax >= 0.8
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as fn
+
+    params = inspect.signature(fn).parameters
+
+    def wrapper(f, *, mesh, in_specs, out_specs, check_rep=False):
+        kw = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+        if "check_rep" in params:
+            kw["check_rep"] = check_rep
+        elif "check_vma" in params:
+            kw["check_vma"] = check_rep
+        return fn(f, **kw)
+
+    return wrapper

@@ -28,27 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _NEG = -1e9  # masked-score fill; exp(_NEG - m) underflows to exactly 0
 
 
-def _shard_map():
-    """shard_map across jax versions: >=0.8 renamed check_rep to
-    check_vma and moved out of experimental."""
-    import inspect
-
-    try:
-        fn = jax.shard_map  # jax >= 0.8
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map as fn
-
-    params = inspect.signature(fn).parameters
-
-    def wrapper(f, *, mesh, in_specs, out_specs, check_rep=False):
-        kw = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
-        if "check_rep" in params:
-            kw["check_rep"] = check_rep
-        elif "check_vma" in params:
-            kw["check_vma"] = check_rep
-        return fn(f, **kw)
-
-    return wrapper
+from deeplearning4j_tpu.parallel.compat import shard_map_compat as _shard_map
 
 
 def attention(q, k, v, causal: bool = False, mask=None):

@@ -41,13 +41,30 @@ def _fused_pmean(tree, axis_name: str):
     separately-timed pieces (compute / reduction / update) do not
     show. XLA's all-reduce combiner does this in some pipelines, but
     not across the pattern the shard_map step emits.
+
+    Only floating-point leaves ride the flat bucket (ravel_pytree
+    promotes to a common dtype — averaging an int step counter or bool
+    flag through f32 would silently truncate); non-inexact leaves
+    (step counters, flags — identical across replicas by construction,
+    like the reference's per-worker iteration counts) pass through
+    unchanged rather than being float-averaged.
     """
     from jax.flatten_util import ravel_pytree
 
-    if len(jax.tree_util.tree_leaves(tree)) <= 1:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    inexact = [jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+               for l in leaves]
+    if not any(inexact):
+        return tree  # nothing to average; skip the collective
+    if all(inexact) and len(leaves) <= 1:
         return jax.lax.pmean(tree, axis_name)
-    flat, unravel = ravel_pytree(tree)
-    return unravel(jax.lax.pmean(flat, axis_name))
+    flat, unravel = ravel_pytree(
+        [l for l, fl in zip(leaves, inexact) if fl]
+    )
+    fused = iter(unravel(jax.lax.pmean(flat, axis_name)))
+    out = [next(fused) if fl else l
+           for l, fl in zip(leaves, inexact)]
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def default_partition_rules(layer, param_name: str, shape) -> P:
@@ -250,7 +267,9 @@ class DistributedTrainer:
         state and parameters across workers the same way. Dropout keys
         fold in the device index (reference workers draw independent
         RNG streams)."""
-        from jax.experimental.shard_map import shard_map
+        from deeplearning4j_tpu.parallel.compat import shard_map_compat
+
+        shard_map = shard_map_compat()
 
         m = self.model
         mesh = self.mesh

@@ -28,7 +28,8 @@ _ensure_devices(8)
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.parallel.compat import shard_map_compat
+shard_map = shard_map_compat()
 from deeplearning4j_tpu.datasets.api import DataSet
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.parallel import build_mesh
