@@ -209,16 +209,42 @@ def write_csv(model, path, sep: str = ",") -> None:
 
 
 def load_csv(path, sep: str = ",") -> Tuple[VocabCache, np.ndarray]:
+    """Headerless CSV has no declared dimensionality, so each row is
+    validated against the first (the txt/bin loaders get this from
+    their header)."""
     import csv
 
     cache = VocabCache()
     rows = []
+    dim = None
     with open(path, "r", encoding="utf-8", newline="") as f:
-        for parts in csv.reader(f, delimiter=sep):
+        for lineno, parts in enumerate(csv.reader(f, delimiter=sep), 1):
             if not parts:
                 continue
+            vec = parts[1:]
+            if dim is None:
+                dim = len(vec)
+                if dim == 0:
+                    raise ValueError(
+                        f"{path}:{lineno}: row {parts[0]!r} has no "
+                        "vector components"
+                    )
+            elif len(vec) != dim:
+                raise ValueError(
+                    f"{path}:{lineno}: row {parts[0]!r} has "
+                    f"{len(vec)} components, expected {dim}"
+                )
+            try:
+                row = [float(x) for x in vec]
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}:{lineno}: non-numeric component in row "
+                    f"{parts[0]!r}: {e}"
+                ) from None
             cache.add(VocabWord(parts[0]))
-            rows.append([float(x) for x in parts[1:]])
+            rows.append(row)
+    if not rows:
+        return cache, np.zeros((0, 0), np.float32)
     return cache, np.asarray(rows, np.float32)
 
 

@@ -68,9 +68,15 @@ def _dense_rows() -> bool:
 _DENSE_VOCAB_MAX = 8192  # above this the one-hot outweighs the scatter
 
 
-def _rows(table, ids):
-    """table[ids] with a dense (MXU) gradient when allowed."""
-    if _dense_rows() and table.shape[0] <= _DENSE_VOCAB_MAX:
+def _rows(table, ids, dense):
+    """table[ids] with a dense (MXU) gradient when allowed.
+
+    ``dense`` is REQUIRED and must be threaded in as a STATIC jit
+    argument by the callers — reading the env var at trace time would
+    let a flipped ``DL4J_TPU_W2V_DENSE`` silently keep the previously
+    compiled path for already-seen shapes (the compile cache is keyed
+    only on shapes/dtypes)."""
+    if dense and table.shape[0] <= _DENSE_VOCAB_MAX:
         oh = jax.nn.one_hot(
             ids, table.shape[0], dtype=jnp.bfloat16
         )
@@ -81,15 +87,16 @@ def _rows(table, ids):
     return table[ids]
 
 
-def _ns_step_raw(syn0, syn1neg, centers, contexts, negs, mask, alpha):
+def _ns_step_raw(syn0, syn1neg, centers, contexts, negs, mask, alpha,
+                 dense):
     """Negative-sampling step (SkipGram: centers=input word ids,
     contexts=predicted word ids; CBOW passes precomputed context means
     through ``_ns_step_cbow`` instead)."""
     def loss_fn(tables):
         s0, s1 = tables
-        v = _rows(s0, centers)               # [B, D]
-        u_pos = _rows(s1, contexts)          # [B, D]
-        u_neg = _rows(s1, negs)              # [B, K, D]
+        v = _rows(s0, centers, dense)        # [B, D]
+        u_pos = _rows(s1, contexts, dense)   # [B, D]
+        u_neg = _rows(s1, negs, dense)       # [B, K, D]
         pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, axis=-1))
         # a drawn negative equal to the true context is masked out (the
         # reference resamples on collision; masking is the static-shape
@@ -107,14 +114,14 @@ def _ns_step_raw(syn0, syn1neg, centers, contexts, negs, mask, alpha):
 
 
 def _hs_step_raw(syn0, syn1, centers, codes, points, path_mask, mask,
-                 alpha):
+                 alpha, dense):
     """Hierarchical-softmax step: codes/points are the context word's
     padded Huffman path ([B, L]); loss per node is
     -log σ((1-2·code)·(v_center · syn1[point]))."""
     def loss_fn(tables):
         s0, s1 = tables
-        v = _rows(s0, centers)               # [B, D]
-        u = _rows(s1, points)                # [B, L, D]
+        v = _rows(s0, centers, dense)        # [B, D]
+        u = _rows(s1, points, dense)         # [B, L, D]
         x = jnp.einsum("bd,bld->bl", v, u)
         sign = 1.0 - 2.0 * codes
         ll = jax.nn.log_sigmoid(sign * x) * path_mask
@@ -124,13 +131,22 @@ def _hs_step_raw(syn0, syn1, centers, codes, points, path_mask, mask,
     return syn0 - alpha * g0, syn1 - alpha * g1, loss
 
 
-_ns_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_ns_step_raw)
-_hs_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_hs_step_raw)
+# ``dense`` is a STATIC argument so the env-var/platform choice
+# participates in the compilation cache key (flipping it recompiles
+# instead of silently reusing the other path's executable).
+_ns_step = functools.partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("dense",)
+)(_ns_step_raw)
+_hs_step = functools.partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("dense",)
+)(_hs_step_raw)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                   static_argnames=("dense",))
 def _sg_scan_steps(syn0, syn1, syn1neg, centers_k, contexts_k, codes_k,
-                   points_k, pmask_k, negs_k, mask_k, alphas_k):
+                   points_k, pmask_k, negs_k, mask_k, alphas_k,
+                   dense):
     """k skip-gram batches fused into ONE dispatch via lax.scan (same
     rationale as MultiLayerNetwork._build_multi_step: per-batch
     host->device transfers+dispatches bound throughput). hs/ns legs
@@ -141,10 +157,11 @@ def _sg_scan_steps(syn0, syn1, syn1neg, centers_k, contexts_k, codes_k,
         c, o, cd, pt, pm, ng, m, a = per
         loss = 0.0
         if s1 is not None:
-            s0, s1, l1 = _hs_step_raw(s0, s1, c, cd, pt, pm, m, a)
+            s0, s1, l1 = _hs_step_raw(s0, s1, c, cd, pt, pm, m, a,
+                                      dense)
             loss = loss + l1
         if s1n is not None:
-            s0, s1n, l2 = _ns_step_raw(s0, s1n, c, o, ng, m, a)
+            s0, s1n, l2 = _ns_step_raw(s0, s1n, c, o, ng, m, a, dense)
             loss = loss + l2
         return (s0, s1, s1n), loss
 
@@ -156,22 +173,134 @@ def _sg_scan_steps(syn0, syn1, syn1neg, centers_k, contexts_k, codes_k,
     return syn0, syn1, syn1neg, losses
 
 
-def _cbow_hidden(s0, ctx_ids, ctx_mask):
-    ctx = _rows(s0, ctx_ids)                 # [B, W, D]
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1),
+    static_argnames=("W", "K", "B", "dense"),
+)
+def _sg_device_epoch(syn0, syn1neg, ids, pos, slen, kp_pos, neg_pool,
+                     key, alphas, *, W, K, B, dense):
+    """ONE dispatch = one full skip-gram/NS epoch, generated and
+    trained on device (VERDICT r4 #2: the cold path was bounded by
+    host pair-generation + host->device transfer of ~90 bytes/word;
+    here the corpus ids live in HBM and the epoch's subsampling,
+    reduced windows, negatives and updates are all device work — the
+    TPU-shaped equivalent of the reference's producer thread
+    (``SequenceVectors.java:935`` AsyncSequencer), which exists to
+    hide exactly this host prep).
+
+    Formulation: per-CENTER padded contexts. Each corpus position is a
+    center with up to 2W context slots (validity mask = reduced
+    window + sentence bounds + subsampling), and negatives are drawn
+    per center, shared across its pairs. The loss is the exact pair
+    sum Σ_pairs [log σ(v_c·u_o) + Σ_k log σ(-v_c·u_nk)] with the
+    negative term factored per center (weighted by its surviving pair
+    count, collision-masked per pair) — word2vec.c semantics up to
+    negative-sample sharing, which trades per-pair draws for a ~3x
+    FLOP cut in the dominant one-hot lookups (statistical parity,
+    module docstring). Alphas come in precomputed per batch.
+
+    Divergences from the host generator (documented): subsampling
+    masks pairs in place rather than compacting the corpus first (so
+    windows do not stretch across removed frequent words), and
+    negatives come from a host-presampled unigram^0.75 pool rotated by
+    a random per-epoch offset rather than fresh per-epoch table draws
+    — the marginal distribution is identical (the pool is itself
+    table-sampled), only cross-epoch independence is relaxed.
+
+    The generation phase is deliberately GATHER-FREE: contexts and
+    keep-flags are built by 2W static shifts of the corpus array,
+    per-position keep probabilities and the negative pool come in
+    precomputed — TPUs execute large scalar gathers row-serially, and
+    a gather-based first cut of this generator cost more than the
+    training matmuls it feeds.
+    """
+    N = ids.shape[0]
+    n_batches = N // B
+    k1, k2, k3 = jax.random.split(key, 3)
+    ids32 = ids.astype(jnp.int32)
+    keep = jax.random.uniform(k1, (N,)) < kp_pos
+    b = jax.random.randint(k2, (N,), 1, W + 1)
+    offsets = [o for o in range(-W, W + 1) if o != 0]
+    offs = jnp.asarray(offsets, jnp.int32)
+    p = pos[:, None] + offs[None, :]
+    inb = (p >= 0) & (p < slen[:, None])
+    # context ids / keep flags via static shifts, not gathers
+    pad_ids = jnp.pad(ids32, (W, W))
+    pad_keep = jnp.pad(keep, (W, W))
+    ctx = jnp.stack(
+        [pad_ids[W + o:W + o + N] for o in offsets], axis=1
+    )                                                   # [N, 2W]
+    keep_ctx = jnp.stack(
+        [pad_keep[W + o:W + o + N] for o in offsets], axis=1
+    )
+    cmask = (
+        inb
+        & (jnp.abs(offs)[None, :] <= b[:, None])
+        & keep[:, None] & keep_ctx
+    ).astype(syn0.dtype)
+    shift = jax.random.randint(k3, (), 0, neg_pool.size)
+    negs = jnp.roll(neg_pool.reshape(-1), shift).reshape(N, K)
+
+    def body(tables, per):
+        s0, s1n = tables
+        c, cx, cm, ng, a = per
+
+        def loss_fn(ts):
+            t0, t1 = ts
+            v = _rows(t0, c, dense)                     # [B, D]
+            u_c = _rows(t1, cx, dense)                  # [B, 2W, D]
+            u_n = _rows(t1, ng, dense)                  # [B, K, D]
+            pos_ll = jax.nn.log_sigmoid(
+                jnp.einsum("bd,bwd->bw", v, u_c)
+            )
+            # per-pair collision mask (reference resamples a negative
+            # equal to the true context; masking is the static-shape
+            # equivalent): weight of negative k = count of this
+            # center's valid pairs whose context != negs[k]
+            w_k = jnp.einsum(
+                "bw,bkw->bk", cm,
+                (ng[:, :, None] != cx[:, None, :]).astype(cm.dtype),
+            )
+            neg_ll = jax.nn.log_sigmoid(
+                -jnp.einsum("bd,bkd->bk", v, u_n)
+            )
+            npairs = jnp.maximum(jnp.sum(cm), 1.0)
+            return -(jnp.sum(cm * pos_ll)
+                     + jnp.sum(w_k * neg_ll)) / npairs
+
+        loss, (g0, g1) = jax.value_and_grad(loss_fn)((s0, s1n))
+        return (s0 - a * g0, s1n - a * g1), loss
+
+    per = (
+        ids32[: n_batches * B].reshape(n_batches, B),
+        ctx[: n_batches * B].reshape(n_batches, B, -1),
+        cmask[: n_batches * B].reshape(n_batches, B, -1),
+        negs[: n_batches * B].reshape(n_batches, B, -1),
+        alphas,
+    )
+    (syn0, syn1neg), losses = jax.lax.scan(
+        body, (syn0, syn1neg), per
+    )
+    return syn0, syn1neg, losses
+
+
+def _cbow_hidden(s0, ctx_ids, ctx_mask, dense):
+    ctx = _rows(s0, ctx_ids, dense)          # [B, W, D]
     denom = jnp.maximum(jnp.sum(ctx_mask, axis=-1, keepdims=True), 1.0)
     return jnp.sum(ctx * ctx_mask[..., None], axis=1) / denom  # [B, D]
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("dense",))
 def _cbow_ns_step(syn0, syn1neg, ctx_ids, ctx_mask, targets, negs, mask,
-                  alpha):
+                  alpha, dense):
     """CBOW + negative sampling: mean of context vectors predicts the
     center word (reference ``CBOW.java`` iterateSample)."""
     def loss_fn(tables):
         s0, s1 = tables
-        h = _cbow_hidden(s0, ctx_ids, ctx_mask)
-        u_pos = _rows(s1, targets)
-        u_neg = _rows(s1, negs)
+        h = _cbow_hidden(s0, ctx_ids, ctx_mask, dense)
+        u_pos = _rows(s1, targets, dense)
+        u_neg = _rows(s1, negs, dense)
         pos = jax.nn.log_sigmoid(jnp.sum(h * u_pos, axis=-1))
         nvalid = (negs != targets[:, None]).astype(h.dtype)
         neg = jnp.sum(
@@ -185,15 +314,16 @@ def _cbow_ns_step(syn0, syn1neg, ctx_ids, ctx_mask, targets, negs, mask,
     return syn0 - alpha * g0, syn1neg - alpha * g1, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("dense",))
 def _cbow_hs_step(syn0, syn1, ctx_ids, ctx_mask, codes, points, path_mask,
-                  mask, alpha):
+                  mask, alpha, dense):
     """CBOW + hierarchical softmax: context mean against the TARGET
     word's Huffman path."""
     def loss_fn(tables):
         s0, s1 = tables
-        h = _cbow_hidden(s0, ctx_ids, ctx_mask)
-        u = _rows(s1, points)                # [B, L, D]
+        h = _cbow_hidden(s0, ctx_ids, ctx_mask, dense)
+        u = _rows(s1, points, dense)         # [B, L, D]
         x = jnp.einsum("bd,bld->bl", h, u)
         sign = 1.0 - 2.0 * codes
         ll = jax.nn.log_sigmoid(sign * x) * path_mask
@@ -300,6 +430,12 @@ class SequenceVectors:
         self.epoch_cache_budget_bytes = 256 * 2 ** 20
         self._epoch_cache: dict = {}
         self._epoch_cache_bytes = 0
+        # On-device epoch generation (skip-gram/NS only): "auto" =
+        # enabled on TPU, where the cold path is otherwise bounded by
+        # host pair-gen + transfer; True/False force. Env override:
+        # DL4J_TPU_W2V_DEVICE_GEN=1/0.
+        self.device_epoch_gen = "auto"
+        self._dev_corpus = None  # (key, (ids, pos, slen, kp_pos, pool, n))
         self.lookup = InMemoryLookupTable(
             cache, layer_size, seed=seed, use_hs=use_hierarchic_softmax,
             negative=negative,
@@ -415,19 +551,24 @@ class SequenceVectors:
     # -- training -----------------------------------------------------------
 
     def clear_epoch_cache(self) -> None:
-        """Drop the device-resident epoch replay cache (required after
-        mutating the corpus without changing the seed)."""
+        """Drop the device-resident epoch replay cache AND the
+        device-generation corpus arrays (required after mutating the
+        corpus without changing the seed)."""
         self._epoch_cache.clear()
         self._epoch_cache_bytes = 0
+        self._dev_corpus = None
 
     def _epoch_cache_key(self, ep_seed: int, step: int):
         """Everything that shapes the prepared chunk arrays: epoch
-        seed + step offset (negatives, alpha offsets), geometry, and
-        the hyperparameters baked into alphas/negatives/hs-paths."""
+        seed + step offset (negatives, alpha offsets), geometry, the
+        hyperparameters baked into alphas/negatives/hs-paths, and the
+        pair-generation knobs (window/sample/algorithm shape
+        ``_gen_pairs`` output via ``_flatten_corpus``)."""
         return (
             ep_seed, step, self.batch_size, self.scan_chunk,
             self.learning_rate, self.min_learning_rate, self.epochs,
             self.negative, self.use_hs,
+            self.window, self.sample, self.algorithm,
         )
 
     @staticmethod
@@ -439,7 +580,119 @@ class SequenceVectors:
                     total += int(np.prod(a.shape)) * a.dtype.itemsize
         return total
 
+    def _use_device_gen(self) -> bool:
+        import os
+
+        from deeplearning4j_tpu.ops.dispatch import effective_platform
+
+        if not (self.algorithm == "SkipGram" and self.negative > 0
+                and not self.use_hs and self.iterations == 1
+                and self._scan_path_ok()):
+            return False
+        env = os.environ.get("DL4J_TPU_W2V_DEVICE_GEN", "").lower()
+        if env in ("1", "true", "on"):
+            return True
+        if env in ("0", "false", "off"):
+            return False
+        flag = self.device_epoch_gen
+        if flag == "auto":
+            return effective_platform() == "tpu"
+        return bool(flag)
+
+    def _flat_corpus_static(self):
+        """One-time (ids, pos, slen) over the UNsubsampled corpus for
+        the device-generation path — subsampling is drawn on device
+        per epoch, so these arrays are epoch-independent."""
+        seqs = [np.asarray(ids, np.int32) for ids in self._sequences()]
+        seqs = [s for s in seqs if len(s) > 0]
+        if not seqs:
+            return None
+        all_ids = np.concatenate(seqs)
+        lens = np.array([len(s) for s in seqs], np.int32)
+        starts = np.repeat(
+            np.cumsum(lens, dtype=np.int64).astype(np.int32) - lens, lens
+        )
+        pos = np.arange(len(all_ids), dtype=np.int32) - starts
+        slen = np.repeat(lens, lens)
+        return all_ids, pos, slen
+
+    def _keep_probs(self) -> np.ndarray:
+        """Per-word P(keep) of frequent-word subsampling (reference
+        SkipGram sample branch), as a [V] table for device draws."""
+        v = len(self._counts)
+        if self.sample <= 0:
+            return np.ones(v, np.float32)
+        total = max(self.cache.total_word_count, 1)
+        freq = self._counts / total
+        kp = (np.sqrt(freq / self.sample) + 1) * (
+            self.sample / np.maximum(freq, 1e-12)
+        )
+        return np.minimum(kp, 1.0).astype(np.float32)
+
+    def _fit_device_gen(self) -> None:
+        """Epoch loop for the on-device generation path: one
+        ``_sg_device_epoch`` dispatch per epoch; the only recurring
+        host work is the [n_batches] alpha schedule."""
+        B = self.batch_size
+        # staleness key: everything baked into the cached device arrays
+        # (kp_pos bakes sample; the pool bakes negative+seed; padding
+        # bakes batch_size) — same discipline as _epoch_cache_key
+        dev_key = (B, self.negative, self.sample, self.seed)
+        if self._dev_corpus is not None and self._dev_corpus[0] != dev_key:
+            self._dev_corpus = None
+        if self._dev_corpus is None:
+            flat = self._flat_corpus_static()
+            if flat is None:
+                return
+            all_ids, pos, slen = flat
+            n = len(all_ids)
+            pad = (-n) % B
+            if pad:
+                all_ids = np.pad(all_ids, (0, pad))
+                pos = np.pad(pos, (0, pad))
+                slen = np.pad(slen, (0, pad))  # slen 0 -> no pairs
+            idt = np.uint16 if len(self._counts) < 2 ** 16 else np.int32
+            # per-POSITION keep probs and a presampled negative pool:
+            # the epoch program takes these ready-made so its
+            # generation phase needs no device gathers (see
+            # _sg_device_epoch docstring)
+            kp_pos = self._keep_probs()[all_ids].astype(np.float32)
+            pool_rng = np.random.RandomState(self.seed ^ 0x5EED)
+            pool = self._table[
+                pool_rng.randint(0, len(self._table),
+                                 (len(all_ids), self.negative))
+            ].astype(idt)
+            self._dev_corpus = (dev_key, (
+                jnp.asarray(all_ids.astype(idt)), jnp.asarray(pos),
+                jnp.asarray(slen), jnp.asarray(kp_pos),
+                jnp.asarray(pool), n,
+            ))
+        ids_d, pos_d, slen_d, kp_d, pool_d, n_words = self._dev_corpus[1]
+        n_batches = ids_d.shape[0] // B
+        lr0, lr_min = self.learning_rate, self.min_learning_rate
+        total = max(n_batches * self.epochs * B, 1)
+        lk = self.lookup
+        base = jax.random.PRNGKey(self.seed)
+        step = 0
+        for epoch in range(self.epochs):
+            frac = np.minimum((step + np.arange(n_batches)) * B / total,
+                              1.0)
+            alphas = np.maximum(lr0 * (1 - frac), lr_min).astype(
+                np.float32
+            )
+            lk.syn0, lk.syn1neg, _ = _sg_device_epoch(
+                lk.syn0, lk.syn1neg, ids_d, pos_d, slen_d, kp_d,
+                pool_d, jax.random.fold_in(base, epoch),
+                jnp.asarray(alphas),
+                W=self.window, K=self.negative, B=B,
+                dense=_dense_rows(),
+            )
+            step += n_batches
+        lk.invalidate_norms()
+
     def fit(self) -> None:
+        if self._use_device_gen():
+            return self._fit_device_gen()
         B = self.batch_size
         lr0, lr_min = self.learning_rate, self.min_learning_rate
         total_items = None
@@ -586,7 +839,7 @@ class SequenceVectors:
         for (ck, ok, ckd, ptd, pmd, negs, mk, alphas, k) in chunks:
             lk.syn0, lk.syn1, lk.syn1neg, _ = _sg_scan_steps(
                 lk.syn0, lk.syn1, lk.syn1neg, ck, ok, ckd, ptd, pmd,
-                negs, mk, alphas,
+                negs, mk, alphas, dense=_dense_rows(),
             )
             step += k
         return step
@@ -615,12 +868,14 @@ class SequenceVectors:
         if self.use_hs:
             codes, points, pmask = self._path_arrays(contexts)
             lk.syn0, lk.syn1, _ = _hs_step(
-                lk.syn0, lk.syn1, cb, codes, points, pmask, mask, alpha
+                lk.syn0, lk.syn1, cb, codes, points, pmask, mask, alpha,
+                dense=_dense_rows(),
             )
         if self.negative > 0:
             negs = self._sample_negatives(len(centers), step)
             lk.syn0, lk.syn1neg, _ = _ns_step(
-                lk.syn0, lk.syn1neg, cb, ob, jnp.asarray(negs), mask, alpha
+                lk.syn0, lk.syn1neg, cb, ob, jnp.asarray(negs), mask, alpha,
+                dense=_dense_rows(),
             )
 
     def _apply_cbow_batch(self, targets, ctx_ids, ctx_mask, mask, alpha,
@@ -634,12 +889,14 @@ class SequenceVectors:
         if self.use_hs:
             codes, points, pmask = self._path_arrays(targets)
             lk.syn0, lk.syn1, _ = _cbow_hs_step(
-                lk.syn0, lk.syn1, cb, cm, codes, points, pmask, mask, alpha
+                lk.syn0, lk.syn1, cb, cm, codes, points, pmask, mask, alpha,
+                dense=_dense_rows(),
             )
         if self.negative > 0:
             negs = jnp.asarray(self._sample_negatives(len(targets), step))
             lk.syn0, lk.syn1neg, _ = _cbow_ns_step(
-                lk.syn0, lk.syn1neg, cb, cm, tb, negs, mask, alpha
+                lk.syn0, lk.syn1neg, cb, cm, tb, negs, mask, alpha,
+                dense=_dense_rows(),
             )
 
     def _sample_negatives(self, b: int, step: int) -> np.ndarray:
