@@ -39,7 +39,7 @@ from deeplearning4j_tpu.nn.layers.base import (
 # default (reference: per-layer clone of the global conf).
 _GLOBAL_LAYER_FIELDS = (
     "activation", "weight_init", "dist", "bias_init", "dropout",
-    "updater", "learning_rate", "bias_learning_rate", "momentum",
+    "drop_connect", "updater", "learning_rate", "bias_learning_rate", "momentum",
     "adam_mean_decay", "adam_var_decay", "rho", "rms_decay", "epsilon",
     "l1", "l2", "gradient_normalization",
     "gradient_normalization_threshold", "lr_policy",
@@ -350,6 +350,13 @@ class NeuralNetConfiguration:
 
         def minimize(self, m: bool):
             self._minimize = m
+            return self
+
+        def use_drop_connect(self, use: bool = True):
+            """Reference ``Builder.useDropConnect``
+            (NeuralNetConfiguration.java:534): route each layer's
+            ``dropout`` rate to its WEIGHTS instead of its input."""
+            self._globals["drop_connect"] = bool(use)
             return self
 
         def regularization(self, use: bool):

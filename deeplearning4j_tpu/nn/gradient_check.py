@@ -58,6 +58,7 @@ def check_gradients(
     seed: int = 0,
     train: bool = False,
     features_mask: Optional[np.ndarray] = None,
+    rng_key=None,
 ) -> bool:
     """Returns True if all checked parameters pass.
 
@@ -71,13 +72,14 @@ def check_gradients(
             eps=eps, max_rel_error=max_rel_error,
             min_abs_error=min_abs_error, max_per_param=max_per_param,
             print_results=print_results, seed=seed, train=train,
-            features_mask=features_mask,
+            features_mask=features_mask, rng_key=rng_key,
         )
 
 
 def _check_gradients_x64(
     model, x, labels, mask=None, *, eps, max_rel_error, min_abs_error,
     max_per_param, print_results, seed, train, features_mask,
+    rng_key=None,
 ) -> bool:
     if model.params is None:
         model.init()
@@ -96,8 +98,11 @@ def _check_gradients_x64(
     )
 
     def score_fn(p):
+        # rng_key (when given) is FIXED across every central-difference
+        # evaluation, so stochastic regularizers (dropout/DropConnect)
+        # present one frozen mask to both sides of the check
         s, _ = model._score_pure(
-            p, state, x64, y64, m64, None, train=train, fmask=fm64
+            p, state, x64, y64, m64, rng_key, train=train, fmask=fm64
         )
         return s
 

@@ -208,7 +208,11 @@ class ComputationGraph:
                     if v.preprocessor is not None:
                         x = v.preprocessor.preprocess(x, gctx)
                     x = layer.maybe_dropout(x, train=train, rng=lrng)
-                    preouts[name] = layer.pre_output(params[name], x)
+                    # same lrng as apply -> identical DropConnect mask
+                    pw = layer.maybe_drop_connect(
+                        params[name], train=train, rng=lrng
+                    )
+                    preouts[name] = layer.pre_output(pw, x)
             values[name] = out
         return values, preouts, new_state
 

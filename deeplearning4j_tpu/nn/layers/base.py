@@ -117,6 +117,11 @@ class LayerSpec:
     dist: Distribution | None = None
     bias_init: float = 0.0
     dropout: float = 0.0
+    # weight-level DropConnect (reference NeuralNetConfiguration
+    # ``useDropConnect``, NeuralNetConfiguration.java:96,509): when
+    # True the ``dropout`` rate masks WEIGHTS in pre-output instead of
+    # masking the layer input (BaseLayer.java:365,480)
+    drop_connect: bool = False
     # optimizer settings (per-layer overrides; reference clones the
     # global NeuralNetConfiguration per layer)
     updater: str = "SGD"
@@ -205,14 +210,60 @@ class LayerSpec:
     def activate_fn(self):
         return activations.get(self.activation)
 
+    def supports_drop_connect(self) -> bool:
+        """True for layers whose ``apply`` routes weights through
+        :meth:`maybe_drop_connect` (dense/conv/LSTM/pretrain families,
+        mirroring the reference's BaseLayer/ConvolutionLayer/
+        LSTMHelpers DropConnect sites). Layers without weight-level
+        masking keep their INPUT dropout even when the global
+        ``drop_connect`` flag is set — otherwise the flag would
+        silently strip their only regularization."""
+        return False
+
     def maybe_dropout(self, x, *, train: bool, rng):
         """Inverted dropout on the layer *input* (reference BaseLayer
-        applies dropout to input when training, ``conf.dropOut``)."""
-        if not train or self.dropout <= 0.0 or rng is None:
+        applies dropout to input when training, ``conf.dropOut``).
+        Suppressed when ``drop_connect`` is set AND this layer
+        implements weight masking — the reference routes the rate to
+        the weights instead (BaseLayer.java:480 checks
+        ``!conf.isUseDropConnect()``)."""
+        if (not train or self.dropout <= 0.0 or rng is None
+                or (self.drop_connect and self.supports_drop_connect())):
             return x
         keep = 1.0 - self.dropout
         mask = jax.random.bernoulli(rng, keep, x.shape)
         return jnp.where(mask, x / keep, 0.0)
+
+    # distinct stream from input dropout so a hypothetical layer using
+    # both would not correlate masks
+    _DROP_CONNECT_SALT = 0x7C
+
+    def maybe_drop_connect(self, params, *, train: bool, rng,
+                           keys=("W",)):
+        """DropConnect: return ``params`` with the weight tensors in
+        ``keys`` masked at rate ``dropout`` (reference
+        ``Dropout.applyDropConnect``, applied by BaseLayer.java:365,
+        ConvolutionLayer.java:223 and LSTMHelpers.java:93 to the
+        input-weight matrices). Inverted scaling (W/keep) keeps
+        pre-activation expectations unchanged, matching this
+        framework's input-dropout convention. Deterministic in ``rng``
+        so the engine's separate pre-output call sees the same mask as
+        ``apply``."""
+        if (not train or not self.drop_connect or self.dropout <= 0.0
+                or rng is None or not self.supports_drop_connect()):
+            return params
+        keep = 1.0 - self.dropout
+        out = dict(params)
+        for i, k in enumerate(keys):
+            if k not in out:
+                continue
+            w = out[k]
+            m = jax.random.bernoulli(
+                jax.random.fold_in(rng, self._DROP_CONNECT_SALT + i),
+                keep, w.shape,
+            )
+            out[k] = jnp.where(m, w / keep, 0.0)
+        return out
 
     def updater_settings(self) -> UpdaterSettings:
         return UpdaterSettings(

@@ -31,6 +31,9 @@ class DenseLayer(FeedForwardLayerSpec):
     """Fully connected layer (reference ``nn/conf/layers/DenseLayer`` +
     ``nn/layers/feedforward/dense/DenseLayer.java``)."""
 
+    def supports_drop_connect(self) -> bool:
+        return True
+
     def init_params(self, key, dtype=jnp.float32) -> dict:
         w = init_weights(
             key, (self.n_in, self.n_out), self.weight_init,
@@ -45,6 +48,7 @@ class DenseLayer(FeedForwardLayerSpec):
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
+        params = self.maybe_drop_connect(params, train=train, rng=rng)
         return self.activate_fn()(self.pre_output(params, x)), state
 
 

@@ -63,8 +63,14 @@ class AutoEncoder(FeedForwardLayerSpec):
     def decode(self, params, h):
         return self.activate_fn()(h @ params["W"].T + params["vb"])
 
+    def supports_drop_connect(self) -> bool:
+        return True
+
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
+        # reference BasePretrainNetwork inherits BaseLayer.preOutput's
+        # DropConnect (BaseLayer.java:365)
+        params = self.maybe_drop_connect(params, train=train, rng=rng)
         return self.encode(params, x), state
 
     def pretrain_loss(self, params, x, rng):
@@ -180,8 +186,12 @@ class RBM(FeedForwardLayerSpec):
 
     # -- supervised forward: propUp -----------------------------------------
 
+    def supports_drop_connect(self) -> bool:
+        return True
+
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
+        params = self.maybe_drop_connect(params, train=train, rng=rng)
         return self._hidden_mean(params, x), state
 
     # -- CD-k ---------------------------------------------------------------

@@ -182,8 +182,14 @@ class GravesLSTM(LayerSpec):
             "c": jnp.zeros((batch, self.n_out), dtype),
         }
 
+    def supports_drop_connect(self) -> bool:
+        return True
+
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
+        # reference LSTMHelpers.java:93 drops the INPUT weights only
+        params = self.maybe_drop_connect(params, train=train, rng=rng,
+                                         keys=("W",))
         if "h" in state:
             h0, c0 = state["h"], state["c"]
         else:
@@ -226,6 +232,8 @@ class GravesBidirectionalLSTM(GravesLSTM):
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
+        params = self.maybe_drop_connect(params, train=train, rng=rng,
+                                         keys=("WF", "WB"))
         h0, c0 = self._carry_init(x.shape[0], x.dtype)
         gate_fn = act_mod.get(self.gate_activation)
         act_fn = act_mod.get(self.activation)
@@ -282,6 +290,8 @@ class RnnOutputLayer(BaseOutputLayerSpec):
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
+        # reference RnnOutputLayer.java:167
+        params = self.maybe_drop_connect(params, train=train, rng=rng)
         pre = self.pre_output(params, x)
         if self.activation == "softmax":
             y = jax.nn.softmax(pre, axis=1)  # class axis

@@ -409,7 +409,11 @@ class MultiLayerNetwork:
                 lrng = jax.random.fold_in(rng, i)
             if i == n - 1 and hasattr(layer, "pre_output") and layer.has_loss():
                 xin = layer.maybe_dropout(x, train=train, rng=lrng)
-                preout = layer.pre_output(params[name], xin)
+                # same lrng as apply -> identical DropConnect mask
+                pw = layer.maybe_drop_connect(
+                    params[name], train=train, rng=lrng
+                )
+                preout = layer.pre_output(pw, xin)
             x, st = layer.apply(
                 params[name], x, state.get(name, {}), train=train, rng=lrng,
                 mask=fmask,
