@@ -10,11 +10,12 @@ from deeplearning4j_tpu.clustering.cluster import (
 )
 from deeplearning4j_tpu.clustering.kdtree import HyperRect, KDTree
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
-from deeplearning4j_tpu.clustering.sptree import Cell, QuadTree, SPTree
+from deeplearning4j_tpu.clustering.quadtree import Cell as QuadCell, QuadTree
+from deeplearning4j_tpu.clustering.sptree import Cell, SPTree
 from deeplearning4j_tpu.clustering.vptree import DataPoint, VPTree
 
 __all__ = [
     "Cluster", "ClusterSet", "Point", "PointClassification",
     "HyperRect", "KDTree", "KMeansClustering", "Cell", "QuadTree",
-    "SPTree", "DataPoint", "VPTree",
+    "SPTree", "DataPoint", "VPTree", "QuadCell",
 ]

@@ -160,13 +160,3 @@ class SPTree:
         w = (vals * q)[:, None] * diff
         np.add.at(pos_f, src, w)
 
-
-class QuadTree(SPTree):
-    """2-D special case (reference ``clustering/quadtree/QuadTree.java``)
-    — same insert/force machinery with 4 children."""
-
-    def __init__(self, data: np.ndarray):
-        data = np.asarray(data, np.float64)
-        if data.shape[1] != 2:
-            raise ValueError("QuadTree requires 2-D data")
-        super().__init__(data)

@@ -185,3 +185,90 @@ class TestTsne:
             for i in range(3) for j in range(i + 1, 3)
         )
         assert min_gap > spread
+
+
+class TestQuadTree:
+    """Dedicated 2-D quadtree (reference
+    ``clustering/quadtree/QuadTree.java``; VERDICT r4 #8)."""
+
+    def test_build_and_invariants(self):
+        rng = np.random.RandomState(3)
+        pts = rng.randn(200, 2)
+        t = QuadTree(pts)
+        assert t.cum_size == 200
+        assert t.is_correct()
+        assert t.depth() >= 2
+        np.testing.assert_allclose(
+            t.center_of_mass, pts.mean(axis=0), rtol=1e-8
+        )
+
+    def test_duplicate_points(self):
+        pts = np.array([[0.0, 0.0], [0.0, 0.0], [1.0, 1.0]])
+        t = QuadTree(pts)
+        assert t.cum_size == 3  # duplicates counted in mass
+        assert t.is_correct()
+
+    def test_non_edge_forces_match_exact_at_theta_zero(self):
+        rng = np.random.RandomState(5)
+        pts = rng.randn(40, 2)
+        t = QuadTree(pts)
+        i = 7
+        neg = np.zeros(2)
+        sum_q = t.compute_non_edge_forces(i, 0.0, neg)
+        diff = pts[i] - pts
+        d2 = (diff ** 2).sum(axis=1)
+        q = 1.0 / (1.0 + d2)
+        q[i] = 0.0
+        np.testing.assert_allclose(sum_q, q.sum(), rtol=1e-8)
+        np.testing.assert_allclose(
+            neg, ((q * q)[:, None] * diff).sum(axis=0), rtol=1e-8
+        )
+
+    def test_non_edge_forces_bh_approximates(self):
+        rng = np.random.RandomState(6)
+        pts = rng.randn(150, 2)
+        t = QuadTree(pts)
+        neg_a = np.zeros(2)
+        sq_a = t.compute_non_edge_forces(0, 0.5, neg_a)
+        neg_e = np.zeros(2)
+        sq_e = t.compute_non_edge_forces(0, 0.0, neg_e)
+        assert abs(sq_a - sq_e) / sq_e < 0.1
+        np.testing.assert_allclose(neg_a, neg_e, rtol=0.35, atol=1e-3)
+
+    def test_edge_forces_csr(self):
+        pts = np.array([[0.0, 0.0], [1.0, 0.0]])
+        t = QuadTree(pts)
+        pos = np.zeros_like(pts)
+        t.compute_edge_forces(
+            np.array([0, 1, 2]), np.array([1, 0]),
+            np.array([0.5, 0.5]), 2, pos,
+        )
+        np.testing.assert_allclose(pos[0], -pos[1])
+        assert pos[0][0] < 0
+
+    def test_knn_matches_bruteforce(self):
+        rng = np.random.RandomState(9)
+        pts = rng.randn(300, 2)
+        t = QuadTree(pts)
+        for qi in (0, 17, 123):
+            q = pts[qi] + 0.01
+            idxs, dists = t.knn(q, 5)
+            d = np.linalg.norm(pts - q, axis=1)
+            expect = np.argsort(d)[:5]
+            np.testing.assert_array_equal(idxs, expect)
+            np.testing.assert_allclose(dists, d[expect], rtol=1e-10)
+
+    def test_requires_2d_data(self):
+        with pytest.raises(ValueError):
+            QuadTree(np.zeros((4, 3)))
+
+    def test_non_edge_forces_duplicates_counted(self):
+        pts = np.array([[0.0, 0.0], [0.0, 0.0], [3.0, 4.0]])
+        t = QuadTree(pts)
+        neg = np.zeros(2)
+        # query from the STORED duplicate index: its twin (absorbed
+        # into the same leaf) must still contribute q=1 to sum_Q
+        sum_q = t.compute_non_edge_forces(0, 0.0, neg)
+        d2 = 25.0
+        expect = 1.0 + 1.0 / (1.0 + d2)   # twin at d=0 + far point
+        np.testing.assert_allclose(sum_q, expect, rtol=1e-8)
