@@ -1,0 +1,65 @@
+"""A/B timing of the ResNet-50 bench step for BN-pass experiments
+(VERDICT r5 #1). Times the same HBM-resident scan-fused fit window as
+``bench.bench_resnet50`` and prints sec/step + MFU, so BN changes can
+be iterated quickly on the chip.
+
+Usage: python scripts/bn_ab.py [label]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    label = sys.argv[1] if len(sys.argv) > 1 else "run"
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.util.flops import (
+        device_peak_flops,
+        train_step_cost,
+    )
+    from deeplearning4j_tpu.zoo import resnet50
+    from bench import _to_hbm
+
+    batch, chunk, epochs = 128, 2, 8
+    g = ComputationGraph(
+        resnet50(dtype="bfloat16", learning_rate=0.01)
+    ).init()
+    g.scan_chunk = chunk
+    rng = np.random.RandomState(0)
+    batches = _to_hbm([
+        DataSet(
+            features=rng.randint(
+                0, 256, (batch, 3, 224, 224), dtype=np.uint8
+            ),
+            labels=np.eye(1000, dtype=np.uint8)[
+                rng.randint(0, 1000, batch)
+            ],
+        )
+        for _ in range(chunk)
+    ])
+    flops_ex = train_step_cost(g, batches[0])["flops_per_example"]
+    g.fit(batches, epochs=1)
+    _ = float(g.score_value)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        g.fit(batches, epochs=epochs)
+        _ = float(g.score_value)
+        dt = time.perf_counter() - t0
+        best = dt if best is None or dt < best else best
+    n_ex = epochs * chunk * batch
+    rate = n_ex / best
+    peak, _kind = device_peak_flops()
+    mfu = flops_ex * rate / peak
+    print(f"[{label}] {rate:.1f} ex/s  "
+          f"{best / (epochs * chunk) * 1000:.2f} ms/step  MFU {mfu:.4f}")
+
+
+if __name__ == "__main__":
+    main()

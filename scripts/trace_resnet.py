@@ -1,0 +1,51 @@
+"""Capture a jax profiler trace of the exact bench ResNet-50 fit
+window (HBM-resident batches, scan-fused steps) and print the leaf-op
+attribution via parse_trace. Usage:
+  python scripts/trace_resnet.py [outdir]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else \
+        "artifacts/resnet50_trace_r5"
+    import jax
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.zoo import resnet50
+    from bench import _to_hbm
+
+    batch, chunk = 128, 2
+    g = ComputationGraph(
+        resnet50(dtype="bfloat16", learning_rate=0.01)
+    ).init()
+    g.scan_chunk = chunk
+    rng = np.random.RandomState(0)
+    batches = _to_hbm([
+        DataSet(
+            features=rng.randint(0, 256, (batch, 3, 224, 224),
+                                 dtype=np.uint8),
+            labels=np.eye(1000, dtype=np.uint8)[
+                rng.randint(0, 1000, batch)
+            ],
+        )
+        for _ in range(chunk)
+    ])
+    g.fit(batches, epochs=1)  # compile
+    _ = float(g.score_value)
+    jax.profiler.start_trace(outdir)
+    g.fit(batches, epochs=3)
+    _ = float(g.score_value)
+    jax.profiler.stop_trace()
+    print("trace written to", outdir)
+
+
+if __name__ == "__main__":
+    main()
