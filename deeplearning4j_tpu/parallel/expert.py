@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.sequence import _shard_map
+from deeplearning4j_tpu.parallel.compat import shard_map_compat as _shard_map
 
 
 def build_expert_mesh(n_devices: int = None, devices=None) -> Mesh:

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.sequence import _shard_map
+from deeplearning4j_tpu.parallel.compat import shard_map_compat as _shard_map
 
 
 def _loss_cache_key(fn):
