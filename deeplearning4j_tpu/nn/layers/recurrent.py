@@ -85,12 +85,30 @@ def _lstm_scan(p, x_bnt, h0, c0, mask_bt, gate_fn, act_fn, peephole,
     # Pallas kernel (one VMEM-resident matmul+gates program per step)
     from deeplearning4j_tpu.nn import activations as _act
     from deeplearning4j_tpu.ops import lstm_cell_diff, use_pallas_lstm
+    from deeplearning4j_tpu.ops.lstm_cell import (
+        lstm_sequence,
+        lstm_sequence_ok,
+    )
 
     fused = (
         use_pallas_lstm()
         and gate_fn is _act.get("sigmoid")
         and act_fn is _act.get("tanh")
     )
+    # whole-sequence kernel: RW stays VMEM-resident across ALL
+    # timesteps instead of being re-fetched from HBM per step — the
+    # per-step reload is the HBM roofline that caps the scan cell
+    # (artifacts/lstm_roofline_r5.md). Standard gates, no peephole/
+    # mask, RW small enough for VMEM.
+    if (fused and not peephole and m_tb is None
+            and lstm_sequence_ok(n, 4 * n, p["RW"].dtype,
+                                 x_bnt.shape[0])):
+        outs, hT, cT = lstm_sequence(
+            xin, h0, c0, p["RW"]
+        )
+        if reverse:
+            outs = jnp.flip(outs, axis=0)
+        return jnp.transpose(outs, (1, 2, 0)), (hT, cT)
 
     def cell(carry, inp):
         h, c = carry

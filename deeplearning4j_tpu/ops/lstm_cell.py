@@ -127,3 +127,308 @@ def use_pallas_lstm() -> bool:
     from deeplearning4j_tpu.ops.dispatch import use_pallas
 
     return use_pallas()
+
+
+# ---------------------------------------------------------------------------
+# Sequence-level kernel: weights resident in VMEM across ALL timesteps
+# ---------------------------------------------------------------------------
+#
+# The per-step cell above re-fetches RW [n, 4n] from HBM every
+# timestep (lax.scan invokes the kernel T times): at the saturated
+# bench shape (n=1024, b=256, bf16) that is 8 MB of weight traffic per
+# step against 2 MB of actual data (xproj) — the measured 12.5% MFU is
+# the HBM roofline of that reload (artifacts/lstm_roofline_r5.md).
+# Here ONE pallas_call runs the whole sequence: grid=(T,), RW's block
+# index is constant so Mosaic's pipeline fetches it once and keeps it
+# in VMEM; h/c carry lives in f32 VMEM scratch across grid steps
+# (the TPU grid is sequential). The backward kernel streams dgates
+# out per step with RW again resident; dW/dRW reduce to two big MXU
+# matmuls outside the kernel.
+#
+# VMEM budget at the saturated shape: RW 8 MB (bf16) + xproj block
+# 2 MB + h/c scratch 2x1 MB (f32) + out blocks 2x0.5 MB + z temp 4 MB
+# (f32) ~ 16 MB — one core's VMEM. Larger n needs batch-blocking
+# (outer batch grid dim); gated to n*4n*2 <= _SEQ_RW_BYTES_MAX.
+
+
+_SEQ_RW_BYTES_MAX = 9 * 2 ** 20
+
+
+def _seq_fwd_core(xproj_ref, rw_ref, h0_ref, c0_ref,
+                  hseq_ref, cseq_ref, hT_ref, cT_ref,
+                  h_scr, c_scr):
+    t = pl.program_id(1)   # grid = (batch blocks, T); t innermost
+    n = h0_ref.shape[1]
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(h_scr.dtype)
+        c_scr[:] = c0_ref[:].astype(c_scr.dtype)
+
+    z = xproj_ref[0].astype(jnp.float32) + jnp.dot(
+        h_scr[:].astype(rw_ref.dtype), rw_ref[:],
+        preferred_element_type=jnp.float32,
+    )
+    zi = z[:, 0 * n:1 * n]
+    zf = z[:, 1 * n:2 * n]
+    zo = z[:, 2 * n:3 * n]
+    zg = z[:, 3 * n:4 * n]
+    c = c_scr[:]
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    g = jnp.tanh(zg)
+    c_new = f * c + i * g
+    o = jax.nn.sigmoid(zo)
+    h_new = o * jnp.tanh(c_new)
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+    hseq_ref[0] = h_new.astype(hseq_ref.dtype)
+    if cseq_ref is not None:
+        cseq_ref[0] = c_new.astype(cseq_ref.dtype)
+    hT_ref[:] = h_new.astype(hT_ref.dtype)
+    cT_ref[:] = c_new.astype(cT_ref.dtype)
+
+
+def _seq_fwd_kernel(xproj_ref, rw_ref, h0_ref, c0_ref,
+                    hseq_ref, cseq_ref, hT_ref, cT_ref,
+                    h_scr, c_scr):
+    _seq_fwd_core(xproj_ref, rw_ref, h0_ref, c0_ref,
+                  hseq_ref, cseq_ref, hT_ref, cT_ref, h_scr, c_scr)
+
+
+def _seq_fwd_kernel_nocseq(xproj_ref, rw_ref, h0_ref, c0_ref,
+                           hseq_ref, hT_ref, cT_ref, h_scr, c_scr):
+    """Inference variant: c_seq is only a vjp residual — skipping it
+    saves a T*b*n HBM stream per forward call."""
+    _seq_fwd_core(xproj_ref, rw_ref, h0_ref, c0_ref,
+                  hseq_ref, None, hT_ref, cT_ref, h_scr, c_scr)
+
+
+def _seq_bwd_kernel(xproj_ref, hprev_ref, cprev_ref, cseq_ref, rw_ref,
+                    dhseq_ref, dhT_ref, dcT_ref,
+                    dgates_ref, dh0_ref, dc0_ref,
+                    dh_scr, dc_scr):
+    """Reverse-time pass (the grid index maps feed blocks in reverse
+    order): recompute gates from the saved h_{t-1}/c_{t-1}/c_t, chain
+    dh/dc through VMEM scratch, stream dgates to HBM."""
+    t = pl.program_id(1)           # 0 .. T-1 in REVERSE time order
+    T = pl.num_programs(1)
+    n = dh0_ref.shape[1]
+
+    @pl.when(t == 0)
+    def _():
+        dh_scr[:] = dhT_ref[:].astype(dh_scr.dtype)
+        dc_scr[:] = dcT_ref[:].astype(dc_scr.dtype)
+
+    z = xproj_ref[0].astype(jnp.float32) + jnp.dot(
+        hprev_ref[0].astype(rw_ref.dtype), rw_ref[:],
+        preferred_element_type=jnp.float32,
+    )
+    zi = z[:, 0 * n:1 * n]
+    zf = z[:, 1 * n:2 * n]
+    zo = z[:, 2 * n:3 * n]
+    zg = z[:, 3 * n:4 * n]
+    c_prev = cprev_ref[0].astype(jnp.float32)
+    c_t = cseq_ref[0].astype(jnp.float32)
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    o = jax.nn.sigmoid(zo)
+    g = jnp.tanh(zg)
+    tc = jnp.tanh(c_t)
+    dh = dhseq_ref[0].astype(jnp.float32) + dh_scr[:]
+    do = dh * tc
+    dct = dh * o * (1.0 - tc * tc) + dc_scr[:]
+    dzo = do * o * (1.0 - o)
+    dzf = (dct * c_prev) * f * (1.0 - f)
+    dzi = (dct * g) * i * (1.0 - i)
+    dzg = (dct * i) * (1.0 - g * g)
+    dc_scr[:] = dct * f
+    dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=1)
+    # dh_{t-1} = dz @ RW^T without materializing the transpose
+    dh_prev = jax.lax.dot_general(
+        dz.astype(rw_ref.dtype), rw_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dh_scr[:] = dh_prev
+    dgates_ref[0] = dz.astype(dgates_ref.dtype)
+    dh0_ref[:] = dh_prev.astype(dh0_ref.dtype)
+    dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)
+
+
+def _seq_batch_block(b: int, n: int, four_n: int, itemsize: int,
+                     bwd: bool = False):
+    """Largest batch block DIVIDING b that keeps the kernel's VMEM
+    residents under ~13 MB of the core's 16 MB. The backward kernel
+    holds roughly twice the forward's per-row state (extra saved
+    blocks, the 4n dgates stream and f32 dz temps), so it sizes with
+    its own formula. None when even the smallest divisor overflows
+    (callers fall back to the per-step cell)."""
+    budget = 13 * 2 ** 20
+    rw_bytes = n * four_n * itemsize
+    if bwd:
+        # xproj + dgates blocks + dz/z f32 temps on the 4n axis;
+        # hprev/cprev/cseq/dhseq blocks + dh0/dc0 + scratches on n
+        per_row = (four_n * (2 * itemsize + 8)
+                   + n * (4 * itemsize + 4 * 4))
+    else:
+        per_row = (four_n * (itemsize + 4)   # xproj block + z f32
+                   + n * (4 * 4 + 2 * itemsize))  # scratches + outs
+    bb = b
+    while bb >= 1:
+        if b % bb == 0 and rw_bytes + bb * per_row <= budget:
+            return bb
+        bb //= 2
+    return None
+
+
+def _lstm_sequence_fwd_call(xproj, h0, c0, rw, interpret,
+                            save_cseq=True):
+    T, b, four_n = xproj.shape
+    n = four_n // 4
+    dt = h0.dtype
+    bb = _seq_batch_block(b, n, four_n, jnp.dtype(rw.dtype).itemsize)
+    if bb is None:
+        raise ValueError("lstm_sequence: no VMEM-fitting batch block "
+                         "(callers must gate on lstm_sequence_ok)")
+    nb = b // bb
+    seq_out = lambda: pl.BlockSpec(
+        (1, bb, n), lambda j, t: (t, j, 0), memory_space=pltpu.VMEM
+    )
+    fin_out = lambda: pl.BlockSpec(
+        (bb, n), lambda j, t: (j, 0), memory_space=pltpu.VMEM
+    )
+    out_specs = [seq_out()]
+    out_shape = [jax.ShapeDtypeStruct((T, b, n), dt)]   # h_seq
+    if save_cseq:
+        out_specs.append(seq_out())
+        out_shape.append(jax.ShapeDtypeStruct((T, b, n), dt))
+    out_specs += [fin_out(), fin_out()]
+    out_shape += [jax.ShapeDtypeStruct((b, n), dt),
+                  jax.ShapeDtypeStruct((b, n), dt)]
+    out = pl.pallas_call(
+        _seq_fwd_kernel if save_cseq else _seq_fwd_kernel_nocseq,
+        grid=(nb, T),
+        in_specs=[
+            pl.BlockSpec((1, bb, four_n), lambda j, t: (t, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, four_n), lambda j, t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, n), lambda j, t: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, n), lambda j, t: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        scratch_shapes=[
+            pltpu.VMEM((bb, n), jnp.float32),
+            pltpu.VMEM((bb, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xproj, rw, h0, c0)
+    if save_cseq:
+        return out
+    hseq, hT, cT = out
+    return hseq, None, hT, cT
+
+
+def _lstm_sequence_bwd_call(xproj, hprev, cprev, cseq, rw, dhseq,
+                            dhT, dcT, interpret):
+    T, b, four_n = xproj.shape
+    n = four_n // 4
+    dt = rw.dtype
+    bb = _seq_batch_block(b, n, four_n, jnp.dtype(rw.dtype).itemsize,
+                          bwd=True)
+    if bb is None:
+        raise ValueError("lstm_sequence: no VMEM-fitting batch block "
+                         "(callers must gate on lstm_sequence_ok)")
+    rev = lambda j, t: (T - 1 - t, j, 0)
+    blk = lambda j, t: (j, 0)
+    cst = lambda j, t: (0, 0)
+    return pl.pallas_call(
+        _seq_bwd_kernel,
+        grid=(b // bb, T),
+        in_specs=[
+            pl.BlockSpec((1, bb, four_n), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, n), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, n), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, n), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, four_n), cst, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, n), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, n), blk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, n), blk, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bb, four_n), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, n), blk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, n), blk, memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((T, b, four_n), dt),  # dgates
+            jax.ShapeDtypeStruct((b, n), jnp.float32),  # dh0
+            jax.ShapeDtypeStruct((b, n), jnp.float32),  # dc0
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bb, n), jnp.float32),
+            pltpu.VMEM((bb, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xproj, hprev, cprev, cseq, rw, dhseq, dhT, dcT)
+
+
+def lstm_sequence_ok(n: int, four_n: int, dtype, b: int) -> bool:
+    """Gate: standard gates, no peephole/mask, RW small enough to sit
+    resident in VMEM, and a batch block exists that divides b and
+    fits BOTH kernels' VMEM budgets."""
+    import numpy as _np
+
+    itemsize = _np.dtype(dtype).itemsize
+    return (
+        four_n == 4 * n
+        and itemsize * n * four_n <= _SEQ_RW_BYTES_MAX
+        and _seq_batch_block(b, n, four_n, itemsize) is not None
+        and _seq_batch_block(b, n, four_n, itemsize, bwd=True)
+        is not None
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def lstm_sequence(xproj, h0, c0, rw, interpret=False):
+    """Whole-sequence fused LSTM (no peephole, no mask):
+    xproj [T, b, 4n] = x@W+b precomputed, h0/c0 [b, n], rw [n, 4n].
+    Returns (h_seq [T, b, n], hT, cT)."""
+    hseq, _cseq, hT, cT = _lstm_sequence_fwd_call(
+        xproj, h0, c0, rw, interpret, save_cseq=False
+    )
+    return hseq, hT, cT
+
+
+def _lstm_sequence_fwd(xproj, h0, c0, rw, interpret):
+    hseq, cseq, hT, cT = _lstm_sequence_fwd_call(
+        xproj, h0, c0, rw, interpret
+    )
+    return (hseq, hT, cT), (xproj, h0, c0, rw, hseq, cseq)
+
+
+def _lstm_sequence_bwd(interpret, res, grads):
+    xproj, h0, c0, rw, hseq, cseq = res
+    dhseq, dhT, dcT = grads
+    hprev = jnp.concatenate([h0[None], hseq[:-1]], axis=0)
+    cprev = jnp.concatenate([c0[None], cseq[:-1]], axis=0)
+    dgates, dh0, dc0 = _lstm_sequence_bwd_call(
+        xproj, hprev, cprev, cseq, rw, dhseq, dhT, dcT, interpret
+    )
+    # weight gradient: ONE MXU matmul over the whole sequence
+    T, b, four_n = dgates.shape
+    n = rw.shape[0]
+    drw = jax.lax.dot_general(
+        hprev.reshape(T * b, n), dgates.reshape(T * b, four_n),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(rw.dtype)
+    return (dgates.astype(xproj.dtype), dh0.astype(h0.dtype),
+            dc0.astype(c0.dtype), drw)
+
+
+lstm_sequence.defvjp(_lstm_sequence_fwd, _lstm_sequence_bwd)
