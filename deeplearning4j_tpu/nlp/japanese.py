@@ -62,12 +62,22 @@ ADJ = 9    # i-adjective
 NUM = 10   # number run
 SYM = 11   # symbol / punctuation
 UNK = 12   # unknown span (non-katakana)
+ADV = 13   # adverb (とても もう ゆっくり) — r5, scaled lexicon
 
 _CLASS_NAMES = {
     BOS: "BOS", EOS: "EOS", N: "noun", PRON: "pronoun",
     PRT: "particle", PRT_F: "particle", V: "verb", VSTEM: "verb",
     AUX: "auxiliary", ADJ: "adjective", NUM: "number", SYM: "symbol",
-    UNK: "unknown",
+    UNK: "unknown", ADV: "adverb",
+}
+
+# loader names -> class ids (the TSV lexicon and user dictionaries
+# name classes; VSTEM/PRT_F disambiguate via the detail column)
+_NAME_TO_CLASS = {
+    "noun": N, "pronoun": PRON, "particle": PRT,
+    "final-particle": PRT_F, "verb": V, "verb-stem": VSTEM,
+    "auxiliary": AUX, "adjective": ADJ, "adverb": ADV,
+    "number": NUM, "symbol": SYM,
 }
 
 # Bigram connection costs (left_class, right_class) -> cost, the
@@ -105,6 +115,11 @@ _CONN: Dict[Tuple[int, int], int] = {
     (ADJ, N): -50, (ADJ, EOS): -50, (ADJ, AUX): 0, (ADJ, PRT): 100,
     (NUM, N): -100, (NUM, PRT): -50, (NUM, EOS): 0,
     (UNK, PRT): -50, (UNK, AUX): 0, (UNK, EOS): 100,
+
+    # adverbs: sentence-initial or mid-clause, preceding predicates
+    (BOS, ADV): 20, (ADV, V): -100, (ADV, VSTEM): -100,
+    (ADV, ADJ): -100, (ADV, ADV): 150, (ADV, N): 150,
+    (ADV, EOS): 300, (PRT, ADV): 0, (ADV, PRT): 250,
 }
 
 
@@ -208,7 +223,124 @@ LEXICON: Dict[str, List[Entry]] = {
     "李": [_e(290, N)],
 }
 
-_MAX_LEN = max(len(w) for w in LEXICON)
+
+class JapaneseDictionary:
+    """Compiled lexicon with a per-first-character prefix index — the
+    compact analog of the trie Kuromoji compiles IPADIC into
+    (``com/atilika/kuromoji/trie/PatriciaTrie.java:1``,
+    ``dict/TokenInfoDictionary``): at each lattice position only the
+    lengths up to the longest dictionary word starting with that
+    character are probed, so lookup cost scales with per-character
+    fan-out instead of the global longest surface.
+
+    Sources, merged in order (later entries append, same format):
+    the hand-set core ``LEXICON``, the generated TSV shipped in
+    ``nlp/data/ja_lexicon.tsv`` (scripts/gen_ja_lexicon.py — base
+    vocabulary expanded through godan/ichidan/i-adjective
+    conjugation), and user dictionaries via :meth:`add_word` /
+    :meth:`load_tsv` (Kuromoji's user-dictionary seam)."""
+
+    def __init__(self, entries: Optional[Dict[str, List[Entry]]] = None):
+        self._entries: Dict[str, List[Entry]] = {}
+        self._max_by_first: Dict[str, int] = {}
+        if entries:
+            for surface, es in entries.items():
+                for e in es:
+                    self._add(surface, e)
+
+    def _add(self, surface: str, entry: Entry) -> None:
+        if not surface:
+            raise ValueError("empty surface")
+        lst = self._entries.setdefault(surface, [])
+        if entry not in lst:
+            lst.append(entry)
+        c = surface[0]
+        if len(surface) > self._max_by_first.get(c, 0):
+            self._max_by_first[c] = len(surface)
+
+    def add_word(self, surface: str, pos: str = "noun",
+                 cost: int = 250, detail: str = "user",
+                 base: Optional[str] = None) -> None:
+        """User-dictionary seam: register one surface with a named
+        POS class (kuromoji UserDictionary analog)."""
+        cls = _NAME_TO_CLASS.get(pos)
+        if cls is None:
+            raise ValueError(
+                f"unknown POS class {pos!r}; one of "
+                f"{sorted(_NAME_TO_CLASS)}"
+            )
+        self._add(surface, (cost, cls, _CLASS_NAMES[cls], detail,
+                            base))
+
+    def load_tsv(self, path) -> int:
+        """Load ``surface<TAB>cost<TAB>class<TAB>detail<TAB>base``
+        rows (the generated-lexicon / user-dictionary format);
+        returns the number of entries added."""
+        n = 0
+        with open(path, "r", encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split("\t")
+                if len(parts) != 5:
+                    raise ValueError(
+                        f"{path}:{lineno}: expected 5 tab-separated "
+                        f"fields, got {len(parts)}"
+                    )
+                surface, cost, cls_name, detail, base = parts
+                cls = _NAME_TO_CLASS.get(cls_name)
+                if cls is None:
+                    raise ValueError(
+                        f"{path}:{lineno}: unknown class {cls_name!r}"
+                    )
+                self._add(surface, (int(cost), cls,
+                                    _CLASS_NAMES[cls], detail,
+                                    base or None))
+                n += 1
+        return n
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, surface: str) -> bool:
+        return surface in self._entries
+
+    def max_surface_len(self, first_char: str) -> int:
+        return self._max_by_first.get(first_char, 0)
+
+    def lookup(self, surface: str):
+        return self._entries.get(surface, ())
+
+    def prefixes(self, text: str, i: int):
+        """Yield (surface, entries) for every dictionary surface
+        starting at ``text[i]`` — the lattice construction probe."""
+        mx = min(self._max_by_first.get(text[i], 0), len(text) - i)
+        for ln in range(1, mx + 1):
+            w = text[i:i + ln]
+            es = self._entries.get(w)
+            if es:
+                yield w, es
+
+
+_DEFAULT_DICT: Optional[JapaneseDictionary] = None
+
+
+def default_dictionary() -> JapaneseDictionary:
+    """The process-wide dictionary: core LEXICON + the shipped
+    generated lexicon (loaded once, lazily)."""
+    global _DEFAULT_DICT
+    if _DEFAULT_DICT is None:
+        d = JapaneseDictionary(LEXICON)
+        import os
+
+        tsv = os.path.join(os.path.dirname(__file__), "data",
+                           "ja_lexicon.tsv")
+        if os.path.exists(tsv):
+            d.load_tsv(tsv)
+        _DEFAULT_DICT = d
+    return _DEFAULT_DICT
+
 
 # Unknown-span costs by script class (Kuromoji's unknown-word handler
 # assigns per-category costs from unk.def; same idea, coarser).
@@ -284,14 +416,19 @@ def _unknown_node(i: int, end: int, script: str) -> _Node:
     return _Node(i, end, "", cost, cls, pos, detail, None, False)
 
 
-def tokenize(text: str) -> List[Token]:
+def tokenize(text: str,
+             dictionary: Optional[JapaneseDictionary] = None
+             ) -> List[Token]:
     """Morphological analysis: Viterbi minimum-cost path over the
     dictionary lattice with bigram connection costs. Whitespace splits
     the lattice; punctuation tokens are dropped (the script-run
-    segmenter's convention)."""
+    segmenter's convention). ``dictionary`` defaults to the core +
+    generated lexicon; pass your own (e.g. with user entries) to
+    extend it."""
+    d = dictionary if dictionary is not None else default_dictionary()
     out: List[Token] = []
     for chunk in text.split():
-        out.extend(_tokenize_chunk(chunk))
+        out.extend(_tokenize_chunk(chunk, d))
     return [t for t in out if t.part_of_speech != "symbol"]
 
 
@@ -300,21 +437,22 @@ def segment(text: str) -> List[str]:
     return [t.surface for t in tokenize(text)]
 
 
-def _lattice_nodes(text: str) -> List[List[_Node]]:
+def _lattice_nodes(text: str,
+                   d: JapaneseDictionary) -> List[List[_Node]]:
     """starts[i] = lattice nodes beginning at position i: all
-    dictionary matches, plus the unknown same-script run AND its
-    single first character (so a dictionary word just past i+1 is
-    reachable without consuming the whole run)."""
+    dictionary matches (probed through the prefix index), plus the
+    unknown same-script run AND its single first character (so a
+    dictionary word just past i+1 is reachable without consuming the
+    whole run)."""
     n = len(text)
     runs = _script_runs(text)
     starts: List[List[_Node]] = [[] for _ in range(n)]
     for i in range(n):
-        for ln in range(1, min(_MAX_LEN, n - i) + 1):
-            w = text[i:i + ln]
-            for (cost, cls, pos, detail, base) in LEXICON.get(w, ()):
+        for w, entries in d.prefixes(text, i):
+            for (cost, cls, pos, detail, base) in entries:
                 starts[i].append(
-                    _Node(i, i + ln, w, cost, cls, pos, detail, base,
-                          True)
+                    _Node(i, i + len(w), w, cost, cls, pos, detail,
+                          base, True)
                 )
         run_end, script = runs[i]
         starts[i].append(_unknown_node(i, run_end, script))
@@ -323,11 +461,11 @@ def _lattice_nodes(text: str) -> List[List[_Node]]:
     return starts
 
 
-def _tokenize_chunk(text: str) -> List[Token]:
+def _tokenize_chunk(text: str, d: JapaneseDictionary) -> List[Token]:
     n = len(text)
     if n == 0:
         return []
-    starts = _lattice_nodes(text)
+    starts = _lattice_nodes(text, d)
     # Viterbi over nodes (cost depends on the previous node's class,
     # so position-only DP is not enough): `arena` is the flat list of
     # settled (node, best_cost, backpointer-index) entries and
