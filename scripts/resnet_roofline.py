@@ -1,0 +1,69 @@
+"""ResNet-50 BN/residual bandwidth-roofline model (VERDICT r5 #1):
+sums the train-mode memory traffic of every non-conv pass over the
+real zoo shapes and compares the HBM-roofline time against the
+measured loop-fusion share of the step trace.
+
+Pass model per BN layer over activation bytes S (bf16):
+  fwd : stats one-pass read (S) + apply read+write (2S)        = 3S
+  bwd : dy+x multi-output reductions (2S) + dx read dy,x,
+        write dx (3S)                                          = 5S
+Residual adds (per bottleneck): read a + read b + write (3S_out),
+backward re-read (dy fan-out is free — same dy feeds both).
+Maxpool bwd (select-and-scatter) and the loss tail are excluded
+(measured separately in the trace).
+
+Usage: python scripts/resnet_roofline.py [batch]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+HBM_GBPS = 819.0  # v5e
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.zoo import resnet50
+
+    conf = resnet50(dtype="bfloat16", learning_rate=0.01)
+    it = InputType.convolutional(224, 224, 3)
+    # walk the graph in topo order, tracking each vertex's output type
+    types = {}
+    bn_bytes = 0.0
+    res_bytes = 0.0
+    n_bn = 0
+    n_add = 0
+    for name in conf.topological_order():
+        v = conf.vertices[name]
+        ins = conf.vertex_inputs.get(name, ())
+        in_t = types[ins[0]] if ins and ins[0] in types else it
+        lc = getattr(v, "layer_conf", None)
+        out_t = lc.output_type(in_t) if lc is not None else in_t
+        types[name] = out_t
+        kind = type(lc).__name__ if lc is not None else type(v).__name__
+        if kind == "BatchNormalization":
+            s = (batch * out_t.channels * out_t.height * out_t.width
+                 * 2)  # bf16
+            bn_bytes += 8 * s
+            n_bn += 1
+        elif "ElementWise" in kind:
+            s = (batch * out_t.channels * out_t.height * out_t.width
+                 * 2)
+            # fwd read+read+write, bwd: dy read once, two writes fuse
+            # into consumers -> count 3S fwd + 2S bwd
+            res_bytes += 5 * s
+            n_add += 1
+    total = bn_bytes + res_bytes
+    t_ms = total / (HBM_GBPS * 1e9) * 1e3
+    print(f"batch {batch}: {n_bn} BN layers, {n_add} residual adds")
+    print(f"BN traffic       {bn_bytes / 1e9:7.2f} GB")
+    print(f"residual traffic {res_bytes / 1e9:7.2f} GB")
+    print(f"total            {total / 1e9:7.2f} GB "
+          f"-> {t_ms:.2f} ms at {HBM_GBPS:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""VGG-16/CIFAR profile + A/B harness (VERDICT r5 #5). Times the
+bench fit window at selectable batch size, optionally traces it, and
+prints ms/step + MFU.
+
+Usage: RN_BATCH=128 python scripts/vgg_ab.py [label] [--trace outdir]
+"""
+import os
+import sys
+import time
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    label = sys.argv[1] if len(sys.argv) > 1 else "run"
+    import jax
+
+    from bench import _to_hbm, _vgg16_conf
+    from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.util.flops import (
+        device_peak_flops,
+        train_step_cost,
+    )
+
+    batch = int(os.environ.get("RN_BATCH", "128"))
+    chunk = int(os.environ.get("RN_CHUNK", "4"))
+    epochs = int(os.environ.get("RN_EPOCHS", "6"))
+    g = ComputationGraph(_vgg16_conf()).init()
+    g.scan_chunk = chunk
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        it = CifarDataSetIterator(
+            batch, num_examples=batch * chunk, allow_synthetic=True,
+            seed=0,
+        )
+    batches = _to_hbm(list(it))
+    flops_ex = train_step_cost(g, batches[0])["flops_per_example"]
+    g.fit(batches, epochs=1)
+    _ = float(g.score_value)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        g.fit(batches, epochs=epochs)
+        _ = float(g.score_value)
+        dt = time.perf_counter() - t0
+        best = dt if best is None or dt < best else best
+    n_ex = epochs * chunk * batch
+    rate = n_ex / best
+    peak, _kind = device_peak_flops()
+    mfu = flops_ex * rate / peak
+    print(f"[{label}] batch={batch} {rate:.1f} ex/s  "
+          f"{best / (epochs * chunk) * 1000:.2f} ms/step  MFU {mfu:.4f}")
+    if "--trace" in sys.argv:
+        outdir = sys.argv[sys.argv.index("--trace") + 1]
+        jax.profiler.start_trace(outdir)
+        g.fit(batches, epochs=2)
+        _ = float(g.score_value)
+        jax.profiler.stop_trace()
+        print("trace written to", outdir)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,10 @@ PY
   # sampling under TPU PRNG), NLP XLA steps, transformer KV-cache
   # streaming, config round-trip, and the DP trainer on a 1-chip
   # degenerate mesh (multi-device cases self-skip via require_devices)
+  # r5 (VERDICT #9): plus clustering, graph embeddings, eval,
+  # datasets, backend-consistency, the w2v full-model suite, zoo
+  # smoke, NLP periphery and cluster-NLP — everything chip-compatible
+  # (f64 gradient checks stay CPU; multi-device cases self-skip)
   DL4J_TPU_TEST_PLATFORM=tpu python -m pytest \
     tests/test_pallas_ops.py tests/test_cnn.py tests/test_rnn.py \
     tests/test_mlp.py tests/test_transformer.py \
@@ -33,5 +37,10 @@ PY
     tests/test_serialization.py tests/test_pretrain.py \
     tests/test_nlp.py tests/test_transformer_streaming.py \
     tests/test_config.py tests/test_parallel.py \
+    tests/test_clustering.py tests/test_graph_embeddings.py \
+    tests/test_eval_meta.py tests/test_datasets.py \
+    tests/test_backend_consistency.py tests/test_w2v_full_model.py \
+    tests/test_zoo.py tests/test_nlp_periphery.py \
+    tests/test_cluster_nlp.py \
     -q --no-header
 } 2>&1 | tee "$OUT"
