@@ -26,7 +26,9 @@ def main():
     from deeplearning4j_tpu.zoo import resnet50
     from bench import _to_hbm
 
-    batch, chunk, epochs = 128, 2, 8
+    batch = int(os.environ.get("RN_BATCH", "128"))
+    chunk = int(os.environ.get("RN_CHUNK", "2"))
+    epochs = int(os.environ.get("RN_EPOCHS", "8"))
     g = ComputationGraph(
         resnet50(dtype="bfloat16", learning_rate=0.01)
     ).init()
