@@ -629,7 +629,13 @@ def bench_lstm_saturated(batch=256, seq=128, vocab=256, hidden=1024,
                 _ = float(net.score_value)
 
             rate = _best_rate(window, 3, epochs * chunk * batch * seq)
-            return rate, flops_char
+            # tunnel-independent: on-device leaf-busy per fused step
+            dev_us = _device_step_us(
+                lambda: (net.fit(batches, epochs=2),
+                         float(net.score_value)),
+                n_steps=2 * chunk,
+            )
+            return rate, flops_char, dev_us
         finally:
             if prev is None:
                 os.environ.pop("DL4J_TPU_PALLAS", None)
@@ -638,17 +644,30 @@ def bench_lstm_saturated(batch=256, seq=128, vocab=256, hidden=1024,
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        rate_pallas, flops_char = run("1")
-        rate_xla, _ = run("0")
-        return {
-            # value = the default path (auto -> Pallas cell on TPU)
+        rate_pallas, flops_char, dev_p = run("1")
+        rate_xla, _, dev_x = run("0")
+        out = {
+            # value = the default path (auto -> Pallas kernels on TPU:
+            # the whole-sequence VMEM-resident-weights LSTM)
             "value": rate_pallas,
             "flops_per_example": flops_char,
             "pallas_cell_chars_per_sec": round(rate_pallas, 1),
             "xla_scan_cell_chars_per_sec": round(rate_xla, 1),
             "pallas_speedup": round(rate_pallas / rate_xla, 3),
         }
-    rate, flops_char = run("auto")  # CPU: no kernel; single number
+        if dev_p and dev_x:
+            # the falsifiable comparison: wall windows through the dev
+            # tunnel carry +/-100ms sync noise per window; device-busy
+            # time does not (artifacts/lstm_roofline_r5.md)
+            out["device_chars_per_sec_pallas"] = round(
+                batch * seq / dev_p * 1e6, 1
+            )
+            out["device_chars_per_sec_xla"] = round(
+                batch * seq / dev_x * 1e6, 1
+            )
+            out["pallas_device_speedup"] = round(dev_x / dev_p, 3)
+        return out
+    rate, flops_char, _dev = run("auto")  # CPU: no kernel; one number
     return {"value": rate, "flops_per_example": flops_char,
             "note": "non-TPU backend: Pallas A/B skipped"}
 
