@@ -36,7 +36,18 @@ except ImportError:  # pragma: no cover
     ComputationGraph = None  # type: ignore[assignment]
 
 from deeplearning4j_tpu.exceptions import (  # noqa: F401
+    CheckpointCorruptedException,
     DL4JException,
+    DL4JFaultException,
     DL4JInvalidConfigException,
     DL4JInvalidInputException,
+    RetryExhaustedException,
+)
+
+from deeplearning4j_tpu.resilience import (  # noqa: F401
+    CheckpointListener,
+    CheckpointManager,
+    DivergenceGuard,
+    RetryPolicy,
+    retry_call,
 )

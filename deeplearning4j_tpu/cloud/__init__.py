@@ -25,3 +25,9 @@ from deeplearning4j_tpu.cloud.data import (  # noqa: F401
     CloudDataSetIterator,
     save_dataset_shards,
 )
+# retrying decorator lives in the resilience subsystem; re-exported
+# here because it is storage-facing API (must import after .storage —
+# it wraps the ObjectStore SPI)
+from deeplearning4j_tpu.resilience.store import (  # noqa: F401
+    RetryingObjectStore,
+)

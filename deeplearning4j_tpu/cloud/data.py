@@ -41,7 +41,19 @@ class CloudDataSetIterator(DataSetIterator):
     S3 iterator."""
 
     def __init__(self, store: ObjectStore, prefix: str = "dataset/",
-                 keys: Optional[List[str]] = None):
+                 keys: Optional[List[str]] = None, retry=None):
+        if retry is not None:
+            # shard fetches run under bounded backoff (resilience
+            # subsystem): a flaky read retries transparently instead of
+            # killing the fit loop mid-epoch
+            from deeplearning4j_tpu.resilience.retry import RetryPolicy
+            from deeplearning4j_tpu.resilience.store import (
+                RetryingObjectStore,
+            )
+
+            policy = RetryPolicy() if retry is True else retry
+            if not isinstance(store, RetryingObjectStore):
+                store = RetryingObjectStore(store, policy)
         self.store = store
         self._keys = list(keys) if keys is not None else store.keys(
             prefix

@@ -162,12 +162,26 @@ class GcsObjectStore(ObjectStore):
         self.bucket.blob(key).upload_from_string(data)
 
 
-def object_store_for(url: str) -> ObjectStore:
+def object_store_for(url: str, retry=None) -> ObjectStore:
     """URL-dispatching constructor: ``s3://bucket``, ``gs://bucket``,
     or a local path / ``file://`` directory. Bucket URLs must name
     ONLY the bucket — a key prefix would be silently ignored by the
     store, so it is rejected; pass prefixes to the key-taking APIs
-    (``keys(prefix)``, ``CloudDataSetIterator(prefix=...)``)."""
+    (``keys(prefix)``, ``CloudDataSetIterator(prefix=...)``).
+
+    ``retry``: a ``resilience.RetryPolicy`` (or ``True`` for the
+    defaults) wraps the store in a ``RetryingObjectStore`` so every
+    read/write runs under bounded exponential backoff."""
+
+    def _wrap(store: ObjectStore) -> ObjectStore:
+        if retry is None:
+            return store
+        from deeplearning4j_tpu.resilience.retry import RetryPolicy
+        from deeplearning4j_tpu.resilience.store import RetryingObjectStore
+
+        policy = RetryPolicy() if retry is True else retry
+        return RetryingObjectStore(store, policy)
+
     for scheme, cls in (("s3://", S3ObjectStore),
                         ("gs://", GcsObjectStore)):
         if url.startswith(scheme):
@@ -179,10 +193,10 @@ def object_store_for(url: str) -> ObjectStore:
                     f"{scheme}{bucket} and pass {suffix!r} as the "
                     "prefix argument"
                 )
-            return cls(bucket)
+            return _wrap(cls(bucket))
     if url.startswith("file://"):
         url = url[7:]
-    return LocalObjectStore(url)
+    return _wrap(LocalObjectStore(url))
 
 
 class StorageDownloader:

@@ -15,6 +15,7 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     MovingWindowDataSetIterator,
     MultipleEpochsIterator,
     ReconstructionDataSetIterator,
+    RetryingDataSetIterator,
     SamplingDataSetIterator,
     make_packbits_codec,
 )

@@ -549,3 +549,39 @@ class INDArrayDataSetIterator(DataSetIterator):
 
     def total_examples(self) -> int:
         return len(self._features)
+
+
+class RetryingDataSetIterator(DataSetIterator):
+    """Retry decorator for flaky-source iterators (cloud shard reads,
+    NFS hiccups): ``next()`` runs under a ``resilience.RetryPolicy``
+    with exponential backoff, raising ``RetryExhaustedException`` past
+    the budget. Wrap the SOURCE iterator (e.g. CloudDataSetIterator),
+    then stack ``AsyncDataSetIterator`` on top so retries happen on
+    the prefetch thread, off the step's critical path. The source must
+    not advance its cursor before a fault (true of
+    ``CloudDataSetIterator``, whose read precedes the increment), so a
+    retried fetch re-reads the same batch and data order is
+    preserved."""
+
+    def __init__(self, base: DataSetIterator, policy=None):
+        from deeplearning4j_tpu.resilience.retry import RetryPolicy
+
+        self.base = base
+        self.policy = policy or RetryPolicy()
+
+    def next(self) -> DataSet:
+        from deeplearning4j_tpu.resilience.retry import retry_call
+
+        return retry_call(self.base.next, policy=self.policy)
+
+    def has_next(self) -> bool:
+        return self.base.has_next()
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def batch(self) -> int:
+        return self.base.batch()
+
+    def total_examples(self) -> int:
+        return self.base.total_examples()

@@ -166,7 +166,12 @@ class InMemoryModelSaver:
 
 class LocalFileModelSaver:
     """Zip checkpoints in a directory (reference ``LocalFileModelSaver``
-    writes bestModel.bin / latestModel.bin)."""
+    writes bestModel.bin / latestModel.bin).
+
+    Saves are atomic: ``write_model`` stages to a temp file in the
+    same directory and ``os.replace``s it over bestModel.zip /
+    latestModel.zip, so a crash mid-save never clobbers the last good
+    checkpoint with a truncated zip."""
 
     def __init__(self, directory: str):
         self.directory = directory
@@ -216,6 +221,11 @@ class EarlyStoppingConfiguration:
     model_saver: Any = None
     evaluate_every_n_epochs: int = 1
     save_last_model: bool = False
+    # resilience.CheckpointManager: when set, every trained epoch is
+    # checkpointed (atomic + versioned + CRC-manifested), so an
+    # early-stopping run survives preemption and resumes via
+    # model.resume(manager) — best/latest saver semantics unchanged.
+    checkpoint_manager: Any = None
 
     def __post_init__(self):
         if self.model_saver is None:
@@ -274,6 +284,11 @@ class EarlyStoppingTrainer:
             stop_iter = self._train_epoch()
             if hasattr(self.train_iterator, "reset"):
                 self.train_iterator.reset()
+            if cfg.checkpoint_manager is not None:
+                # per-epoch preemption point: versioned checkpoint of
+                # the in-training model (distinct from best/latest,
+                # which track the evaluation winner)
+                cfg.checkpoint_manager.save(self.model)
             if stop_iter is not None:
                 reason = "IterationTerminationCondition"
                 details = type(stop_iter).__name__

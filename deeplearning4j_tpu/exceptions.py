@@ -18,3 +18,27 @@ class DL4JInvalidConfigException(DL4JException, ValueError):
 class DL4JInvalidInputException(DL4JException, ValueError):
     """Input incompatible with the network (reference
     ``DL4JInvalidInputException``)."""
+
+
+class DL4JFaultException(DL4JException):
+    """Base for runtime-fault conditions the resilience subsystem
+    raises or recovers from (preempted workers, flaky storage,
+    corrupted checkpoints, diverged training). Net-new vs the
+    reference, whose Spark layer got restartability for free from
+    parameter-averaging rounds."""
+
+
+class CheckpointCorruptedException(DL4JFaultException):
+    """A checkpoint failed verification (CRC mismatch, truncated zip,
+    missing members) and no earlier version could be restored."""
+
+
+class RetryExhaustedException(DL4JFaultException):
+    """A retried operation failed on every attempt of its budget.
+    Carries the attempt count and the last underlying cause (also
+    chained as ``__cause__``)."""
+
+    def __init__(self, message: str, attempts: int, last_cause: BaseException):
+        super().__init__(message)
+        self.attempts = attempts
+        self.last_cause = last_cause

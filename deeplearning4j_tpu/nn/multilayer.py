@@ -314,6 +314,11 @@ class MultiLayerNetwork:
         self._it0_dev = None
         self._it0_shadow = -1
         self._base_key = jax.random.PRNGKey(conf.seed)
+        # resilience.DivergenceGuard (set_divergence_guard): when set,
+        # the jitted step suppresses non-finite updates in-jit and the
+        # host applies skip/rollback policy; forces the per-step path
+        # (the fused scan cannot consult the guard mid-dispatch)
+        self.divergence_guard = None
 
     @property
     def score_value(self) -> float:
@@ -461,6 +466,7 @@ class MultiLayerNetwork:
         updater = self.updater_def
 
         step_dtype = _dtype_of(self.conf)
+        guarded = self.divergence_guard is not None
 
         def step(params, upd_state, state, x, labels, mask, fmask, lrs, t,
                  rng):
@@ -479,9 +485,28 @@ class MultiLayerNetwork:
             new_params, new_upd = updater.update(
                 grads, upd_state, params, lrs, t
             )
-            return new_params, new_upd, new_state, score
+            if not guarded:
+                return new_params, new_upd, new_state, score
+            from deeplearning4j_tpu.resilience.guard import (
+                divergence_ok, select_updates,
+            )
+
+            ok = divergence_ok(score, grads)
+            new_params, new_upd, new_state = select_updates(
+                ok, new_params, params, new_upd, upd_state,
+                new_state, state,
+            )
+            return new_params, new_upd, new_state, score, ok
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def set_divergence_guard(self, guard) -> None:
+        """(Un)install a resilience.DivergenceGuard on the SGD train
+        step (in-jit NaN/Inf suppression + host-side skip/rollback).
+        Rebuilds the jitted step: the guarded step returns an extra
+        ok flag."""
+        self.divergence_guard = guard
+        self._jit_step = None
 
     def _build_multi_step(self) -> Callable:
         """k optimizer steps fused into ONE XLA program via lax.scan.
@@ -626,6 +651,9 @@ class MultiLayerNetwork:
             and x.shape[2] % fwd == 0
             and y.ndim == 3
             and y.shape[2] == x.shape[2]
+            # guarded runs use the per-chunk step (the fused scan
+            # cannot consult the divergence guard mid-dispatch)
+            and self.divergence_guard is None
             and all(
                 layer.can_stream()
                 and getattr(layer, "init_stream_state", None) is not None
@@ -716,6 +744,7 @@ class MultiLayerNetwork:
             and self.conf.backprop_type != "TruncatedBPTT"
             and self.conf.optimization_algo
             == "STOCHASTIC_GRADIENT_DESCENT"
+            and self.divergence_guard is None
             and all(
                 getattr(l, "supports_batched_iterations", False)
                 for l in self.listeners
@@ -857,15 +886,42 @@ class MultiLayerNetwork:
     # public API (reference fit/output/score)
     # ------------------------------------------------------------------
 
-    def fit(self, data, labels=None, *, epochs: int = 1) -> None:
+    def resume(self, source, load_updater: bool = True) -> int:
+        """Resume training from a checkpoint: restore params, updater
+        state, layer state, and the iteration/epoch counters into THIS
+        model (config must match — use ``restore_model`` for a fresh
+        instance). ``source`` is a resilience.CheckpointManager (newest
+        restorable version, with corrupted-newest fallback) or a
+        checkpoint zip path. Returns the restored step.
+
+        Continuation is exact: per-step dropout keys fold
+        ``iteration_count`` into the seed-derived base key, and lr
+        schedules / updater ``t`` derive from the same counter — so
+        k steps + crash + resume for N−k steps retraces the N-step
+        trajectory bit-for-bit given the same data order
+        (``tests/test_resilience.py``)."""
+        from deeplearning4j_tpu.resilience.checkpoint import restore_into
+
+        _, step = restore_into(self, source, load_updater=load_updater)
+        return step
+
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            resume_from=None) -> None:
         """fit(DataSetIterator) / fit(x, y) (reference ``fit:1048``).
 
         ``data`` may be a DataSetIterator-style iterable of objects with
         ``.features``/``.labels`` (and optional ``.labels_mask``), a
         single such object, or a raw (x, y) pair.
+
+        ``resume_from``: a resilience.CheckpointManager or checkpoint
+        zip path — restores params/updater/step counter before fitting
+        (see ``resume``); the caller supplies the data stream from the
+        restored position.
         """
         from deeplearning4j_tpu.datasets.api import DataSet
 
+        if resume_from is not None:
+            self.resume(resume_from)
         if labels is not None:
             batches: Any = [DataSet(features=data, labels=labels)]
             self._fit_batches(batches, epochs)
@@ -1097,16 +1153,26 @@ class MultiLayerNetwork:
             lrs = self.updater_def.scheduled_lrs(self.iteration_count)
             t = jnp.asarray(self.iteration_count + 1, jnp.float32)
             rng = jax.random.fold_in(self._base_key, self.iteration_count)
-            (
-                self.params, self.updater_state, self.state, score,
-            ) = self._jit_step(
+            out = self._jit_step(
                 self.params, self.updater_state, self.state,
                 x, y, mask, fmask,
                 {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
                 t, rng,
             )
+            guard = self.divergence_guard
+            if guard is not None:
+                (
+                    self.params, self.updater_state, self.state, score, ok,
+                ) = out
+            else:
+                self.params, self.updater_state, self.state, score = out
             self.iteration_count += 1
             self._last_score = score  # device array; sync deferred
+            if guard is not None:
+                if bool(ok):  # device sync — the cost of supervision
+                    guard.good_step()
+                else:
+                    guard.bad_step(self)
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count)
             # Reset per optimizer iteration: each pass over the same
@@ -1165,15 +1231,25 @@ class MultiLayerNetwork:
         lrs = self.updater_def.scheduled_lrs(self.iteration_count)
         t = jnp.asarray(self.iteration_count + 1, jnp.float32)
         rng = jax.random.fold_in(self._base_key, self.iteration_count)
-        (
-            self.params, self.updater_state, self.state, score,
-        ) = self._jit_step(
+        out = self._jit_step(
             self.params, self.updater_state, self.state, xs, ys, ms, fs,
             {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
             t, rng,
         )
+        guard = self.divergence_guard
+        if guard is not None:
+            (
+                self.params, self.updater_state, self.state, score, ok,
+            ) = out
+        else:
+            self.params, self.updater_state, self.state, score = out
         self.iteration_count += 1
         self._last_score = score  # device array; sync deferred
+        if guard is not None:
+            if bool(ok):
+                guard.good_step()
+            else:
+                guard.bad_step(self)
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
         return score  # 0-d device array; float() to sync

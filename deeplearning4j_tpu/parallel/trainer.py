@@ -100,7 +100,8 @@ class DistributedTrainer:
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  tensor_parallel: bool = False,
                  partition_rules=default_partition_rules,
-                 batch_stats: str = "auto"):
+                 batch_stats: str = "auto",
+                 divergence_guard=None):
         """``batch_stats`` picks the data-parallel batch-statistics
         semantics:
 
@@ -137,6 +138,12 @@ class DistributedTrainer:
         self.tensor_parallel = tensor_parallel
         self.partition_rules = partition_rules
         self.batch_stats = batch_stats
+        # resilience.DivergenceGuard: when set, the jitted steps test
+        # loss + gradient global-norm for finiteness and suppress the
+        # update on a bad step (select in-jit); host-side policy then
+        # skips or rolls back to the last checkpoint. Reading the
+        # ok-flag synchronizes per step.
+        self.divergence_guard = divergence_guard
         self._is_graph = hasattr(model.conf, "vertices")
         if model.params is None:
             model.init()
@@ -268,9 +275,13 @@ class DistributedTrainer:
         fold in the device index (reference workers draw independent
         RNG streams)."""
         from deeplearning4j_tpu.parallel.compat import shard_map_compat
+        from deeplearning4j_tpu.resilience.guard import (
+            divergence_ok, select_updates,
+        )
 
         shard_map = shard_map_compat()
 
+        guarded = self.divergence_guard is not None
         m = self.model
         mesh = self.mesh
         updater = m.updater_def
@@ -325,19 +336,35 @@ class DistributedTrainer:
             new_params, new_upd = updater.update(
                 grads, upd_state, params, lrs, t
             )
-            return new_params, new_upd, new_state, score
+            if not guarded:
+                return new_params, new_upd, new_state, score
+            # divergence guard: grads/score are already replica-
+            # identical post-pmean, so every replica computes the same
+            # ok flag and selects the same trees
+            ok = divergence_ok(score, grads)
+            new_params, new_upd, new_state = select_updates(
+                ok, new_params, params, new_upd, upd_state,
+                new_state, state,
+            )
+            return new_params, new_upd, new_state, score, ok
 
         rep = P()
         dp = P("data")
+        n_out = 5 if guarded else 4
         sharded = shard_map(
             step, mesh=mesh,
             in_specs=(rep, rep, rep, dp, dp, dp, dp, rep, rep, rep),
-            out_specs=(rep, rep, rep, rep),
+            out_specs=tuple(rep for _ in range(n_out)),
             check_rep=False,
         )
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     def _build_gspmd_step(self):
+        from deeplearning4j_tpu.resilience.guard import (
+            divergence_ok, select_updates,
+        )
+
+        guarded = self.divergence_guard is not None
         m = self.model
         mesh = self.mesh
         rep = NamedSharding(mesh, P())
@@ -381,17 +408,27 @@ class DistributedTrainer:
             new_params, new_upd = updater.update(
                 grads, upd_state, params, lrs, t
             )
-            return new_params, new_upd, new_state, score
+            if not guarded:
+                return new_params, new_upd, new_state, score
+            ok = divergence_ok(score, grads)
+            new_params, new_upd, new_state = select_updates(
+                ok, new_params, params, new_upd, upd_state,
+                new_state, state,
+            )
+            return new_params, new_upd, new_state, score, ok
 
+        out_shardings = (
+            self._param_shardings, upd_shardings, state_shardings, rep,
+        )
+        if guarded:
+            out_shardings = out_shardings + (rep,)
         return jax.jit(
             step,
             in_shardings=(
                 self._param_shardings, upd_shardings, state_shardings,
                 batch, batch, batch, batch, None, None, None,
             ),
-            out_shardings=(
-                self._param_shardings, upd_shardings, state_shardings, rep,
-            ),
+            out_shardings=out_shardings,
             donate_argnums=(0, 1, 2),
         )
 
@@ -469,17 +506,63 @@ class DistributedTrainer:
         lrs = m.updater_def.scheduled_lrs(m.iteration_count)
         t = jnp.asarray(m.iteration_count + 1, jnp.float32)
         rng = jax.random.fold_in(m._base_key, m.iteration_count)
-        (
-            m.params, m.updater_state, m.state, score,
-        ) = step(
+        out = step(
             m.params, m.updater_state, m.state, x, y, mask, fmask,
             {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
             t, rng,
         )
+        guard = self.divergence_guard
+        if guard is not None:
+            m.params, m.updater_state, m.state, score, ok = out
+        else:
+            m.params, m.updater_state, m.state, score = out
         m.iteration_count += 1
         m.score_value = score  # lazy; reading syncs
+        if guard is not None:
+            if bool(ok):  # device sync — the cost of supervision
+                guard.good_step()
+            else:
+                # in-jit select already suppressed the update; the
+                # guard now applies skip/rollback policy host-side
+                guard.bad_step(m, on_restore=self._place_params)
         for listener in m.listeners:
             listener.iteration_done(m, m.iteration_count)
         if hasattr(m, "_reset_recurrent_state"):
             m._reset_recurrent_state()
         return score  # 0-d device array; float() to sync
+
+    def set_divergence_guard(self, guard) -> None:
+        """(Un)install a resilience.DivergenceGuard; the jitted steps
+        are rebuilt on next use because the guarded step has an extra
+        ok-flag output."""
+        self.divergence_guard = guard
+        self._jit_step_sm = None
+        self._jit_step_gspmd = None
+
+    def resume(self, source, load_updater: bool = True) -> int:
+        """Resume training from a checkpoint: restore params, updater
+        state, layer state, and the step counter into this trainer's
+        model, then re-place everything onto the mesh with the
+        trainer's shardings (the broadcast step, done once — same as
+        construction). ``source`` is a resilience.CheckpointManager
+        (newest restorable version, with corrupted-newest fallback) or
+        a checkpoint zip path. Returns the restored step so callers
+        can skip already-consumed batches:
+
+            trainer = DistributedTrainer(model, mesh)
+            step = trainer.resume(manager)
+            trainer.fit(iterator_from(step), epochs=...)
+
+        Continuation is exact: the per-step PRNG folds
+        ``iteration_count`` into the model's seed-derived base key and
+        lr schedules/updater ``t`` derive from the same counter, so a
+        restored run replays the identical trajectory the uninterrupted
+        run would have taken (tier-1-tested in
+        ``tests/test_resilience.py``)."""
+        from deeplearning4j_tpu.resilience.checkpoint import restore_into
+
+        _, step = restore_into(
+            self.model, source, load_updater=load_updater
+        )
+        self._place_params()
+        return step

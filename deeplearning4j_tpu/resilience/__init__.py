@@ -1,0 +1,51 @@
+"""Fault-tolerant training runtime (net-new vs the reference, whose
+Spark layer survived worker loss because parameter-averaging rounds
+are restartable by construction — per-step TPU training needs an
+explicit subsystem; cf. PAPERS.md "TensorFlow: A system for
+large-scale machine learning", which treats checkpoint/recovery as
+first-class for the same reason).
+
+Four cooperating pieces:
+
+- **atomic versioned checkpoints** (``checkpoint.py``):
+  ``CheckpointManager`` — temp-file + ``os.replace`` writes, CRC-32
+  manifests, retention window, corrupted-newest fallback on restore —
+  plus ``CheckpointListener`` (the ``IterationListener`` hook) and
+  ``restore_into`` (the resume primitive behind
+  ``MultiLayerNetwork.resume`` / ``DistributedTrainer.resume``);
+- **retry with exponential backoff + jitter** (``retry.py``):
+  ``RetryPolicy`` / ``retry_call`` / ``@retrying``, raising
+  ``RetryExhaustedException`` past the budget;
+- **retrying storage** (``store.py``): ``RetryingObjectStore`` over
+  any ObjectStore backend;
+- **deterministic fault injection** (``chaos.py``): ``ChaosPolicy``
+  seeded failure schedules, ``FaultyObjectStore``, ``FlakyIterator``;
+- **divergence guard** (``guard.py``): in-step NaN/Inf detection on
+  loss + gradient global-norm with skip-step or
+  rollback-to-last-checkpoint policies.
+"""
+
+from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
+    ChaosError,
+    ChaosPolicy,
+    FaultyObjectStore,
+    FlakyIterator,
+)
+from deeplearning4j_tpu.resilience.checkpoint import (  # noqa: F401
+    CheckpointInfo,
+    CheckpointListener,
+    CheckpointManager,
+    atomic_write_bytes,
+    restore_into,
+)
+from deeplearning4j_tpu.resilience.guard import (  # noqa: F401
+    DivergenceGuard,
+)
+from deeplearning4j_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy,
+    retry_call,
+    retrying,
+)
+from deeplearning4j_tpu.resilience.store import (  # noqa: F401
+    RetryingObjectStore,
+)

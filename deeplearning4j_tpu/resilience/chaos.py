@@ -1,0 +1,163 @@
+"""Deterministic fault injection.
+
+Every recovery path in this subsystem is exercised in tier-1 CI
+without real preemptions: a ``ChaosPolicy`` is a *seeded schedule* of
+which calls fail with what exception, and ``FaultyObjectStore`` /
+``FlakyIterator`` thread it through the storage SPI and the dataset
+iterator SPI. The same seed replays the same failure sequence —
+``scripts/run_chaos.sh`` pins it so a red chaos run reproduces
+locally bit-for-bit.
+
+Failures are injected BEFORE the wrapped call runs, so a retried
+operation observes at-most-once side effects per successful call —
+matching real transient faults (connection refused, 503) rather than
+torn writes, which the checkpoint layer's CRC manifests cover
+separately.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, IO, Iterator, List, Optional, Set, Union
+
+from deeplearning4j_tpu.cloud.storage import ObjectStore
+from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+
+
+class ChaosError(OSError):
+    """The default injected fault: an OSError subclass so the default
+    retry allowlist (``retry.DEFAULT_RETRY_ON``) catches it, and
+    greppable in logs as chaos-injected rather than real."""
+
+
+class ChaosPolicy:
+    """Seeded schedule of call failures, keyed by operation name.
+
+    Two scheduling modes, composable:
+
+    - **explicit**: ``fail_calls={"read": {0, 1}}`` fails the first two
+      ``read`` calls (0-based per-op call index) — the classic
+      "2 failures then succeed" retry test;
+    - **random**: ``failure_rate=0.2, seed=1337`` fails each call with
+      probability 0.2 from a private ``random.Random(seed)`` — same
+      seed, same schedule, regardless of wall clock.
+
+    ``exception`` may be an exception class or a factory
+    ``(op, index) -> Exception``. ``max_failures`` bounds total
+    injections so a high rate cannot starve a bounded-retry caller
+    forever.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        failure_rate: float = 0.0,
+        fail_calls: Optional[Dict[str, Set[int]]] = None,
+        exception: Union[type, Callable] = ChaosError,
+        max_failures: Optional[int] = None,
+    ):
+        if not 0.0 <= failure_rate <= 1.0:
+            raise ValueError("failure_rate must be in [0, 1]")
+        self.seed = seed
+        self.failure_rate = failure_rate
+        self.fail_calls = {
+            op: set(ix) for op, ix in (fail_calls or {}).items()
+        }
+        self.exception = exception
+        self.max_failures = max_failures
+        self._rng = random.Random(seed)
+        self.calls: Dict[str, int] = {}       # per-op call counts
+        self.injected: List[tuple] = []       # (op, index) of each fault
+
+    @classmethod
+    def fail_first(cls, n: int, ops: Iterator[str] = ("read",),
+                   exception: Union[type, Callable] = ChaosError,
+                   ) -> "ChaosPolicy":
+        """Fail the first ``n`` calls of each named op, then succeed."""
+        return cls(
+            fail_calls={op: set(range(n)) for op in ops},
+            exception=exception,
+        )
+
+    def _make_exception(self, op: str, index: int) -> BaseException:
+        if isinstance(self.exception, type):
+            return self.exception(f"chaos: injected fault in {op!r} "
+                                  f"(call #{index})")
+        return self.exception(op, index)
+
+    def check(self, op: str) -> None:
+        """Account one call of ``op``; raise its scheduled fault if
+        any. Call this at the TOP of every instrumented operation."""
+        index = self.calls.get(op, 0)
+        self.calls[op] = index + 1
+        if (self.max_failures is not None
+                and len(self.injected) >= self.max_failures):
+            return
+        scheduled = index in self.fail_calls.get(op, ())
+        if not scheduled and self.failure_rate > 0.0:
+            scheduled = self._rng.random() < self.failure_rate
+        if scheduled:
+            self.injected.append((op, index))
+            raise self._make_exception(op, index)
+
+
+class FaultyObjectStore(ObjectStore):
+    """ObjectStore decorator that consults a ChaosPolicy before every
+    delegated operation. Stack under ``RetryingObjectStore`` to prove
+    the retry budget end-to-end."""
+
+    def __init__(self, inner: ObjectStore, policy: ChaosPolicy):
+        self.inner = inner
+        self.policy = policy
+
+    def keys(self, prefix: str = "") -> List[str]:
+        self.policy.check("keys")
+        return self.inner.keys(prefix)
+
+    def open(self, key: str) -> IO[bytes]:
+        self.policy.check("open")
+        return self.inner.open(key)
+
+    def read(self, key: str) -> bytes:
+        self.policy.check("read")
+        return self.inner.read(key)
+
+    def write(self, key: str, data: bytes) -> None:
+        self.policy.check("write")
+        self.inner.write(key, data)
+
+    def download(self, key: str, to_path) -> None:
+        self.policy.check("download")
+        self.inner.download(key, to_path)
+
+    def upload(self, from_path, key: str) -> None:
+        self.policy.check("upload")
+        self.inner.upload(from_path, key)
+
+
+class FlakyIterator(DataSetIterator):
+    """DataSetIterator decorator whose ``next()`` consults a
+    ChaosPolicy before delegating — the deterministic stand-in for a
+    flaky shard fetch. Because the fault fires before the inner cursor
+    advances, a retry re-fetches the SAME batch: recovery preserves
+    the data order, which the kill/resume equivalence tests rely on."""
+
+    def __init__(self, inner: DataSetIterator, policy: ChaosPolicy):
+        self.inner = inner
+        self.policy = policy
+
+    def next(self) -> DataSet:
+        self.policy.check("next")
+        return self.inner.next()
+
+    def has_next(self) -> bool:
+        return self.inner.has_next()
+
+    def reset(self) -> None:
+        self.inner.reset()
+
+    def batch(self) -> int:
+        return self.inner.batch()
+
+    def total_examples(self) -> int:
+        return self.inner.total_examples()

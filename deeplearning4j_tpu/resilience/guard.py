@@ -1,0 +1,134 @@
+"""Divergence guard: NaN/Inf detection BEFORE the optimizer update.
+
+The reference's only defense against numerical divergence was
+``InvalidScoreIterationTerminationCondition`` — it notices a NaN score
+*after* the update already poisoned the parameters, and its only move
+is to kill the run. Here the check rides inside the jitted train step:
+loss and gradient global-norm are tested for finiteness, and when the
+step is bad the parameter/updater/state updates are *not applied*
+(``jnp.where`` select on the step output — free when the flag is
+true, no host round-trip on the good path beyond the flag itself).
+
+Host-side policy then decides what a bad step means:
+
+- ``"skip"``: drop the minibatch's update and keep going (counters on
+  the guard record how many were skipped);
+- ``"rollback"``: additionally restore the last verified checkpoint —
+  for slow-onset divergence where bad state predates the first
+  non-finite loss.
+
+``max_consecutive`` bounds either policy: a model that produces
+nothing but NaNs raises ``DL4JFaultException`` instead of spinning.
+
+The in-jit half (``divergence_ok``/``select_updates``) is imported by
+the step builders in ``parallel/trainer.py`` and ``nn/multilayer.py``;
+the host half is this ``DivergenceGuard`` object, shared across both
+engines.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.exceptions import DL4JFaultException
+
+SKIP = "skip"
+ROLLBACK = "rollback"
+
+
+def grad_global_norm_sq(grads) -> jax.Array:
+    """Squared global norm over the inexact leaves of a gradient tree
+    (jit-safe). Inf-on-overflow is fine — the guard only asks whether
+    the result is finite."""
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            leaf32 = leaf.astype(jnp.float32)
+            total = total + jnp.sum(leaf32 * leaf32)
+    return total
+
+
+def divergence_ok(score, grads) -> jax.Array:
+    """Scalar bool: the step's loss AND gradients are all finite."""
+    return jnp.logical_and(
+        jnp.isfinite(score),
+        jnp.isfinite(grad_global_norm_sq(grads)),
+    )
+
+
+def _select(ok, new, old):
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new, old
+    )
+
+
+def select_updates(ok, new_params, params, new_upd, upd_state,
+                   new_state, state):
+    """Apply the step's outputs only when ``ok``; otherwise keep the
+    pre-step trees. Layer-state entries whose pytree structure changed
+    during the step (a recurrent carry appearing) pass through as-is —
+    they are per-minibatch scratch, not trajectory state."""
+    sel_params = _select(ok, new_params, params)
+    sel_upd = _select(ok, new_upd, upd_state)
+    sel_state = {}
+    for ln, st in new_state.items():
+        old = state.get(ln, {})
+        if (jax.tree_util.tree_structure(st)
+                == jax.tree_util.tree_structure(old)):
+            sel_state[ln] = _select(ok, st, old)
+        else:
+            sel_state[ln] = st
+    return sel_params, sel_upd, sel_state
+
+
+class DivergenceGuard:
+    """Host-side divergence policy. Construct once, hand to
+    ``MultiLayerNetwork.set_divergence_guard`` or
+    ``DistributedTrainer(divergence_guard=...)``.
+
+    Note: consulting the guard reads the step's ok-flag back from the
+    device, which synchronizes every step — the cost of supervision.
+    """
+
+    def __init__(self, policy: str = SKIP, checkpoint_manager=None,
+                 max_consecutive: int = 10):
+        if policy not in (SKIP, ROLLBACK):
+            raise ValueError(
+                f"policy must be '{SKIP}' or '{ROLLBACK}', got {policy!r}"
+            )
+        if policy == ROLLBACK and checkpoint_manager is None:
+            raise ValueError(
+                "rollback policy needs a checkpoint_manager"
+            )
+        self.policy = policy
+        self.checkpoint_manager = checkpoint_manager
+        self.max_consecutive = max_consecutive
+        self.skipped_steps = 0
+        self.rollbacks = 0
+        self.consecutive_bad = 0
+
+    def good_step(self) -> None:
+        self.consecutive_bad = 0
+
+    def bad_step(self, model, on_restore=None) -> None:
+        """One non-finite step was detected (its update was already
+        suppressed in-jit). Applies the policy; ``on_restore`` runs
+        after a rollback (the trainer re-places params on its mesh)."""
+        self.consecutive_bad += 1
+        if self.consecutive_bad > self.max_consecutive:
+            raise DL4JFaultException(
+                f"divergence guard: {self.consecutive_bad} consecutive "
+                "non-finite steps — aborting instead of spinning"
+            )
+        if self.policy == SKIP:
+            self.skipped_steps += 1
+            return
+        from deeplearning4j_tpu.resilience.checkpoint import restore_into
+
+        restore_into(model, self.checkpoint_manager)
+        self.rollbacks += 1
+        if on_restore is not None:
+            on_restore()

@@ -1,0 +1,101 @@
+"""Bounded retry with exponential backoff + jitter.
+
+The reference stack never needed this in-process: Spark re-runs a lost
+task and the S3 SDK retries internally. Here the runtime talks to
+object stores and preemptible TPU hosts directly, so transient I/O
+faults surface as exceptions in the fit loop — this module turns them
+into bounded, deterministic-under-test retry loops.
+
+Everything nondeterministic is injectable: ``sleep`` (tests pass a
+recording stub so no wall-clock passes), and the jitter RNG (seeded via
+``RetryPolicy.seed`` so a chaos run replays the same delays). Attempts
+past the budget raise ``RetryExhaustedException`` carrying the attempt
+count and last cause.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple, Type
+
+from deeplearning4j_tpu.exceptions import RetryExhaustedException
+
+# Transient-by-default: network/storage hiccups. OSError covers
+# ConnectionError/TimeoutError/IOError; ValueError/KeyError and friends
+# are logic bugs and propagate immediately.
+DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (OSError, TimeoutError)
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff: attempt ``i`` (0-based) sleeps
+    ``min(base_delay * multiplier**i, max_delay)`` scaled by a random
+    factor in ``[1 - jitter, 1]`` (full-jitter-style decorrelation so a
+    fleet of preempted workers doesn't thundering-herd the store)."""
+
+    max_attempts: int = 5
+    base_delay: float = 0.1
+    multiplier: float = 2.0
+    max_delay: float = 30.0
+    jitter: float = 0.5
+    retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON
+    sleep: Callable[[float], None] = time.sleep
+    seed: Optional[int] = None
+    _rng: random.Random = field(init=False, repr=False, compare=False,
+                                default=None)
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self._rng = random.Random(self.seed)
+
+    def delay_for(self, attempt: int) -> float:
+        """Backoff delay after failed attempt ``attempt`` (0-based)."""
+        d = min(self.base_delay * self.multiplier ** attempt,
+                self.max_delay)
+        if self.jitter > 0:
+            d *= 1.0 - self.jitter * self._rng.random()
+        return d
+
+
+def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
+               **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying allowlisted exceptions
+    under ``policy`` (default ``RetryPolicy()``). Non-allowlisted
+    exceptions propagate on the first occurrence; an exhausted budget
+    raises ``RetryExhaustedException`` chained to the last cause."""
+    policy = policy or RetryPolicy()
+    last: Optional[BaseException] = None
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:  # noqa: PERF203 — the point
+            last = e
+            if attempt + 1 >= policy.max_attempts:
+                break
+            policy.sleep(policy.delay_for(attempt))
+    raise RetryExhaustedException(
+        f"{getattr(fn, '__name__', fn)!s} failed after "
+        f"{policy.max_attempts} attempts: {last!r}",
+        attempts=policy.max_attempts,
+        last_cause=last,
+    ) from last
+
+
+def retrying(policy: Optional[RetryPolicy] = None):
+    """Decorator form of ``retry_call``:
+
+        @retrying(RetryPolicy(max_attempts=3))
+        def fetch(key): ...
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy, **kwargs)
+
+        return wrapper
+
+    return deco

@@ -1,0 +1,45 @@
+"""Retrying decorator over the ObjectStore SPI.
+
+``RetryingObjectStore`` wraps any backend (local, S3, GCS, or a
+``FaultyObjectStore`` under test) so every read/write/list/transfer
+runs under a ``RetryPolicy`` — the reference delegated this to the S3
+SDK's internal retries; making it a first-class decorator means GCS,
+local-NFS and injected-fault backends all share one bounded policy,
+and the fit loop sees either a result or ``RetryExhaustedException``.
+
+``open()`` retries the open itself but cannot retry a stream that dies
+mid-read; whole-object ``read()`` is the resilient primitive (and what
+``CloudDataSetIterator`` uses).
+"""
+
+from __future__ import annotations
+
+from typing import IO, List, Optional
+
+from deeplearning4j_tpu.cloud.storage import ObjectStore
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
+
+
+class RetryingObjectStore(ObjectStore):
+    def __init__(self, inner: ObjectStore,
+                 policy: Optional[RetryPolicy] = None):
+        self.inner = inner
+        self.policy = policy or RetryPolicy()
+
+    def keys(self, prefix: str = "") -> List[str]:
+        return retry_call(self.inner.keys, prefix, policy=self.policy)
+
+    def open(self, key: str) -> IO[bytes]:
+        return retry_call(self.inner.open, key, policy=self.policy)
+
+    def read(self, key: str) -> bytes:
+        return retry_call(self.inner.read, key, policy=self.policy)
+
+    def write(self, key: str, data: bytes) -> None:
+        retry_call(self.inner.write, key, data, policy=self.policy)
+
+    def download(self, key: str, to_path) -> None:
+        retry_call(self.inner.download, key, to_path, policy=self.policy)
+
+    def upload(self, from_path, key: str) -> None:
+        retry_call(self.inner.upload, from_path, key, policy=self.policy)

@@ -9,6 +9,12 @@ checkpoints self-describing and shard-assignable under pjit, while
 ``params_flat`` remains available for flat-view parity. Layer state
 (batch-norm running stats, absent in the reference's format because
 its BN state lives inside params) is a fourth member.
+
+Writes are atomic (temp file + ``os.replace``). Versioned training
+checkpoints (``resilience/checkpoint.py``) pair each zip with a
+sibling JSON manifest — ``{"format": 1, "step", "epoch", "file",
+"crc32", "size"}`` — so restores verify the zip's CRC-32 before
+trusting it and can fall back to an earlier version.
 """
 
 from __future__ import annotations
@@ -16,6 +22,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import tempfile
 import zipfile
 from typing import Optional
 
@@ -76,7 +83,13 @@ def _read_npz(zf: zipfile.ZipFile, name: str):
 
 
 def write_model(model, path, save_updater: bool = True) -> None:
-    """Reference ``ModelSerializer.writeModel``."""
+    """Reference ``ModelSerializer.writeModel``, made crash-safe: the
+    zip is staged to a temp file in the destination directory and
+    ``os.replace``d into place, so a crash mid-save can never leave a
+    truncated zip where the last good checkpoint was (rename is atomic
+    within a filesystem; writing the temp next to the target keeps
+    both on one). File-like destinations stream directly (no rename
+    to do)."""
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -92,16 +105,41 @@ def write_model(model, path, save_updater: bool = True) -> None:
         "iteration_count": model.iteration_count,
         "epoch_count": model.epoch_count,
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr(CONFIG_NAME, json.dumps(conf_doc, indent=2))
-        _write_npz(zf, COEFFICIENTS_NAME, _flatten_params(model.params))
-        layer_state = {
-            ln: st for ln, st in model.state.items() if st
-        }
-        if layer_state:
-            _write_npz(zf, LAYER_STATE_NAME, _flatten_params(layer_state))
-        if save_updater and model.updater_state is not None:
-            _write_npz(zf, UPDATER_NAME, _flatten_updater(model.updater_state))
+
+    def _write_to(dest) -> None:
+        with zipfile.ZipFile(dest, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(CONFIG_NAME, json.dumps(conf_doc, indent=2))
+            _write_npz(zf, COEFFICIENTS_NAME, _flatten_params(model.params))
+            layer_state = {
+                ln: st for ln, st in model.state.items() if st
+            }
+            if layer_state:
+                _write_npz(
+                    zf, LAYER_STATE_NAME, _flatten_params(layer_state)
+                )
+            if save_updater and model.updater_state is not None:
+                _write_npz(
+                    zf, UPDATER_NAME, _flatten_updater(model.updater_state)
+                )
+
+    if hasattr(path, "write"):
+        _write_to(path)
+        return
+    path = os.fspath(path)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".",
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            _write_to(f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def restore_multi_layer_network(path, load_updater: bool = True):

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Run the fault-injection (chaos) test subset with a fixed seed.
+#
+# Every chaos-marked test derives its failure schedules from
+# DL4J_TPU_CHAOS_SEED (default 1337), so a red run here reproduces
+# bit-for-bit: re-run with the same seed to replay the exact same
+# injected faults. Override the seed to explore other schedules:
+#
+#   DL4J_TPU_CHAOS_SEED=7 scripts/run_chaos.sh
+#
+# Extra pytest args pass through (e.g. -k retry, -x).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export DL4J_TPU_CHAOS_SEED="${DL4J_TPU_CHAOS_SEED:-1337}"
+echo "chaos seed: ${DL4J_TPU_CHAOS_SEED}"
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest tests/ -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -35,6 +35,19 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running; excluded from the tier-1 command"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (seed via "
+        "DL4J_TPU_CHAOS_SEED; run standalone with scripts/run_chaos.sh "
+        "— fast and CPU-only, so they ALSO run under tier-1)"
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(12345)

@@ -1,0 +1,546 @@
+"""Fault-tolerant training runtime tests (net-new vs the reference,
+whose restartability came free with Spark's parameter-averaging
+rounds): atomic versioned checkpoints with corrupted-newest fallback,
+kill/resume trajectory equivalence on both engines, bounded retry with
+deterministic fault injection, and the in-step divergence guard.
+
+Fault-injection tests are marked ``chaos`` (run standalone via
+``scripts/run_chaos.sh``) but stay fast and CPU-only so the whole file
+also runs under tier-1.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import conftest
+
+from deeplearning4j_tpu.cloud.storage import LocalObjectStore
+from deeplearning4j_tpu.datasets.api import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.datasets.iterators import RetryingDataSetIterator
+from deeplearning4j_tpu.exceptions import (
+    CheckpointCorruptedException,
+    DL4JFaultException,
+    RetryExhaustedException,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.resilience import (
+    ChaosPolicy,
+    CheckpointListener,
+    CheckpointManager,
+    DivergenceGuard,
+    FaultyObjectStore,
+    FlakyIterator,
+    RetryPolicy,
+    RetryingObjectStore,
+    retry_call,
+    retrying,
+)
+
+CHAOS_SEED = int(os.environ.get("DL4J_TPU_CHAOS_SEED", "1337"))
+
+
+def simple_net(seed=7, updater="ADAM", lr=0.05):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(updater)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def batches(rng, n_batches=8, batch=8):
+    out = []
+    for _ in range(n_batches):
+        x = rng.randn(batch, 4).astype(np.float32)
+        y = np.eye(3)[rng.randint(0, 3, batch)].astype(np.float32)
+        out.append(DataSet(features=x, labels=y))
+    return out
+
+
+def assert_updater_state_match(a, b):
+    for ln in a.updater_state:
+        for pn in a.updater_state[ln]:
+            for i, (u, v) in enumerate(
+                zip(a.updater_state[ln][pn], b.updater_state[ln][pn])
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(u), np.asarray(v),
+                    err_msg=f"{ln}/{pn}[{i}]",
+                )
+
+
+# -- retry with exponential backoff + jitter ----------------------------
+
+
+@pytest.mark.chaos
+def test_retry_succeeds_after_transient_failures():
+    slept = []
+    policy = RetryPolicy(max_attempts=5, base_delay=0.1, seed=CHAOS_SEED,
+                         sleep=slept.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient")
+        return "payload"
+
+    assert retry_call(flaky, policy=policy) == "payload"
+    assert calls["n"] == 3
+    assert len(slept) == 2
+    # exponential envelope with jitter in [1-jitter, 1]
+    assert 0.05 <= slept[0] <= 0.1 and 0.1 <= slept[1] <= 0.2
+
+
+@pytest.mark.chaos
+def test_retry_exhausted_carries_attempts_and_cause():
+    policy = RetryPolicy(max_attempts=3, sleep=lambda s: None)
+
+    def always():
+        raise TimeoutError("down")
+
+    with pytest.raises(RetryExhaustedException) as ei:
+        retry_call(always, policy=policy)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last_cause, TimeoutError)
+    assert isinstance(ei.value.__cause__, TimeoutError)
+
+
+def test_retry_non_allowlisted_propagates_immediately():
+    policy = RetryPolicy(max_attempts=5, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("logic bug, not transient")
+
+    with pytest.raises(ValueError):
+        retry_call(broken, policy=policy)
+    assert calls["n"] == 1
+
+
+def test_retrying_decorator():
+    calls = {"n": 0}
+
+    @retrying(RetryPolicy(max_attempts=2, sleep=lambda s: None))
+    def op():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("once")
+        return 7
+
+    assert op() == 7
+
+
+def test_deterministic_jitter_replays():
+    d1 = [RetryPolicy(seed=CHAOS_SEED).delay_for(i) for i in range(4)]
+    d2 = [RetryPolicy(seed=CHAOS_SEED).delay_for(i) for i in range(4)]
+    assert d1 == d2
+
+
+# -- fault injection + retrying storage ---------------------------------
+
+
+@pytest.mark.chaos
+def test_retrying_store_survives_two_failures_then_succeed(tmp_path):
+    inner = LocalObjectStore(tmp_path)
+    inner.write("k", b"v")
+    chaos = ChaosPolicy(fail_calls={"read": {0, 1}})
+    store = RetryingObjectStore(
+        FaultyObjectStore(inner, chaos),
+        RetryPolicy(max_attempts=3, sleep=lambda s: None),
+    )
+    assert store.read("k") == b"v"
+    assert chaos.injected == [("read", 0), ("read", 1)]
+
+
+@pytest.mark.chaos
+def test_retrying_store_raises_past_budget(tmp_path):
+    inner = LocalObjectStore(tmp_path)
+    inner.write("k", b"v")
+    chaos = ChaosPolicy(fail_calls={"read": {0, 1, 2}})
+    store = RetryingObjectStore(
+        FaultyObjectStore(inner, chaos),
+        RetryPolicy(max_attempts=3, sleep=lambda s: None),
+    )
+    with pytest.raises(RetryExhaustedException) as ei:
+        store.read("k")
+    assert ei.value.attempts == 3
+
+
+@pytest.mark.chaos
+def test_chaos_seeded_rate_is_deterministic(tmp_path):
+    def run():
+        chaos = ChaosPolicy(seed=CHAOS_SEED, failure_rate=0.4)
+        inner = LocalObjectStore(tmp_path)
+        inner.write("k", b"v")
+        faulty = FaultyObjectStore(inner, chaos)
+        outcomes = []
+        for _ in range(20):
+            try:
+                faulty.read("k")
+                outcomes.append(True)
+            except OSError:
+                outcomes.append(False)
+        return outcomes, list(chaos.injected)
+
+    o1, i1 = run()
+    o2, i2 = run()
+    assert o1 == o2 and i1 == i2 and not all(o1)
+
+
+@pytest.mark.chaos
+def test_flaky_iterator_retries_same_batch(rng):
+    data = batches(rng, n_batches=3)
+    chaos = ChaosPolicy(fail_calls={"next": {0, 1}})
+    it = RetryingDataSetIterator(
+        FlakyIterator(ListDataSetIterator(data), chaos),
+        RetryPolicy(max_attempts=3, sleep=lambda s: None),
+    )
+    seen = [ds for ds in it]
+    # two injected faults, zero lost/duplicated batches, order kept
+    assert len(seen) == 3
+    for got, want in zip(seen, data):
+        np.testing.assert_array_equal(got.features, want.features)
+
+
+@pytest.mark.chaos
+def test_cloud_iterator_with_retry_over_faulty_store(tmp_path):
+    from deeplearning4j_tpu.cloud.data import (
+        CloudDataSetIterator, save_dataset_shards,
+    )
+
+    rng = np.random.RandomState(3)
+    data = batches(rng, n_batches=3)
+    inner = LocalObjectStore(tmp_path)
+    save_dataset_shards(data, inner)
+    chaos = ChaosPolicy(fail_calls={"read": {0, 2}})
+    it = CloudDataSetIterator(
+        FaultyObjectStore(inner, chaos),
+        retry=RetryPolicy(max_attempts=4, sleep=lambda s: None),
+    )
+    seen = list(it)
+    assert len(seen) == 3
+    for got, want in zip(seen, data):
+        np.testing.assert_array_equal(got.features, want.features)
+
+
+# -- atomic writes ------------------------------------------------------
+
+
+def test_write_model_is_atomic_under_crash(rng, tmp_path, monkeypatch):
+    from deeplearning4j_tpu.util import restore_model, write_model
+
+    net = simple_net()
+    for ds in batches(rng, n_batches=2):
+        net.fit_minibatch(ds)
+    path = tmp_path / "model.zip"
+    write_model(net, path)
+    good = path.read_bytes()
+
+    # crash at the final rename: the destination must be untouched and
+    # the staging temp cleaned up
+    def boom(src, dst):
+        raise OSError("simulated crash mid-save")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        write_model(net, path)
+    monkeypatch.undo()
+    assert path.read_bytes() == good
+    assert [p.name for p in tmp_path.iterdir()] == ["model.zip"]
+    restore_model(path)  # still a valid checkpoint
+
+
+def test_local_file_model_saver_atomic(rng, tmp_path, monkeypatch):
+    from deeplearning4j_tpu.earlystopping import LocalFileModelSaver
+
+    net = simple_net()
+    saver = LocalFileModelSaver(str(tmp_path))
+    saver.save_best_model(net, 1.0)
+    good = (tmp_path / "bestModel.zip").read_bytes()
+    monkeypatch.setattr(
+        os, "replace",
+        lambda s, d: (_ for _ in ()).throw(OSError("crash")),
+    )
+    with pytest.raises(OSError):
+        saver.save_best_model(net, 0.5)
+    monkeypatch.undo()
+    assert (tmp_path / "bestModel.zip").read_bytes() == good
+    saver.get_best_model()
+
+
+# -- versioned checkpoints + fallback -----------------------------------
+
+
+def test_checkpoint_versioning_and_retention(rng, tmp_path):
+    net = simple_net()
+    mgr = CheckpointManager(tmp_path, keep_last=2)
+    data = batches(rng, n_batches=5)
+    for ds in data:
+        net.fit_minibatch(ds)
+        mgr.save(net)
+    steps = [i.step for i in mgr.available()]
+    assert steps == [4, 5]  # retention window pruned 1..3
+    assert mgr.last_step() == 5
+    for info in mgr.available():
+        assert mgr.verify(info)
+    # manifest format is stable, documented fields
+    m = mgr.available()[-1].to_manifest()
+    assert set(m) == {"format", "step", "epoch", "file", "crc32", "size"}
+
+
+@pytest.mark.chaos
+def test_corrupted_newest_falls_back_to_previous(rng, tmp_path):
+    net = simple_net()
+    mgr = CheckpointManager(tmp_path, keep_last=3)
+    data = batches(rng, n_batches=4)
+    for ds in data[:2]:
+        net.fit_minibatch(ds)
+    mgr.save(net)
+    snap2 = net.params_flat()
+    for ds in data[2:]:
+        net.fit_minibatch(ds)
+    newest = mgr.save(net)
+
+    # truncate the newest zip — the shape a preemption mid-upload leaves
+    zpath = tmp_path / newest.file
+    zpath.write_bytes(zpath.read_bytes()[:200])
+    restored, info = mgr.restore_latest()
+    assert info.step == 2
+    np.testing.assert_array_equal(restored.params_flat(), snap2)
+
+    # corrupt the survivor too: nothing restorable -> typed failure
+    older = tmp_path / mgr.available()[0].file
+    older.write_bytes(b"not a zip")
+    with pytest.raises(CheckpointCorruptedException):
+        mgr.restore_latest()
+
+
+# -- kill/resume trajectory equivalence ---------------------------------
+
+
+@pytest.mark.chaos
+def test_kill_resume_equivalence_multilayer(rng):
+    data = batches(rng, n_batches=8)
+
+    # uninterrupted: N steps
+    full = simple_net()
+    for ds in data:
+        full.fit_minibatch(ds)
+
+    # interrupted: k steps -> checkpoint -> (crash) -> resume N-k
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d)
+        victim = simple_net()
+        for ds in data[:3]:
+            victim.fit_minibatch(ds)
+        mgr.save(victim)
+        del victim  # the crash
+
+        survivor = simple_net()
+        step = survivor.resume(mgr)
+        assert step == 3
+        for ds in data[3:]:
+            survivor.fit_minibatch(ds)
+
+    assert survivor.iteration_count == full.iteration_count
+    conftest.assert_params_match(full, survivor)
+    assert_updater_state_match(full, survivor)
+
+
+@pytest.mark.chaos
+def test_kill_resume_equivalence_distributed_trainer(rng, tmp_path):
+    conftest.require_devices(8)
+    from deeplearning4j_tpu.parallel import DistributedTrainer, build_mesh
+
+    data = batches(rng, n_batches=6, batch=16)
+
+    full = simple_net()
+    tr_full = DistributedTrainer(full, mesh=build_mesh())
+    for ds in data:
+        tr_full.fit_minibatch(ds)
+
+    mgr = CheckpointManager(tmp_path)
+    victim = simple_net()
+    tr_victim = DistributedTrainer(victim, mesh=build_mesh())
+    for ds in data[:2]:
+        tr_victim.fit_minibatch(ds)
+    mgr.save(victim)
+    del victim, tr_victim  # the preemption
+
+    survivor = simple_net()
+    tr = DistributedTrainer(survivor, mesh=build_mesh())
+    step = tr.resume(mgr)
+    assert step == 2
+    for ds in data[2:]:
+        tr.fit_minibatch(ds)
+
+    assert survivor.iteration_count == full.iteration_count
+    conftest.assert_params_match(full, survivor)
+    assert_updater_state_match(full, survivor)
+
+
+def test_fit_resume_from_kwarg(rng, tmp_path):
+    data = batches(rng, n_batches=4)
+    mgr = CheckpointManager(tmp_path)
+    net = simple_net()
+    for ds in data[:2]:
+        net.fit_minibatch(ds)
+    mgr.save(net)
+
+    fresh = simple_net()
+    fresh.fit(ListDataSetIterator(data[2:]), resume_from=mgr)
+    assert fresh.iteration_count == 4
+
+
+def test_resume_rejects_config_mismatch(rng, tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    net = simple_net(seed=7)
+    net.fit_minibatch(batches(rng, 1)[0])
+    mgr.save(net)
+    other = simple_net(seed=8)  # different config JSON
+    with pytest.raises(ValueError):
+        other.resume(mgr)
+
+
+def test_checkpoint_listener_saves_every_n(rng, tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last=10)
+    net = simple_net()
+    net.listeners.append(CheckpointListener(mgr, frequency=2))
+    for ds in batches(rng, n_batches=5):
+        net.fit_minibatch(ds)
+    assert [i.step for i in mgr.available()] == [2, 4]
+
+
+def test_early_stopping_checkpoints_per_epoch(rng, tmp_path):
+    from deeplearning4j_tpu.earlystopping import (
+        DataSetLossCalculator,
+        EarlyStoppingConfiguration,
+        EarlyStoppingTrainer,
+        MaxEpochsTerminationCondition,
+    )
+
+    data = batches(rng, n_batches=3)
+    mgr = CheckpointManager(tmp_path, keep_last=10)
+    net = simple_net()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator(data)),
+        epoch_terminations=[MaxEpochsTerminationCondition(3)],
+        checkpoint_manager=mgr,
+    )
+    EarlyStoppingTrainer(cfg, net, ListDataSetIterator(data)).fit()
+    # one versioned checkpoint per trained epoch (steps 3, 6, 9) —
+    # the run is preemption-safe and resumable at epoch granularity
+    assert [i.step for i in mgr.available()] == [3, 6, 9]
+    resumed = simple_net()
+    assert resumed.resume(mgr) == 9
+
+
+# -- divergence guard ---------------------------------------------------
+
+
+def _poisoned(ds):
+    bad = ds.features.copy()
+    bad[0, 0] = np.nan
+    return DataSet(features=bad, labels=ds.labels)
+
+
+@pytest.mark.chaos
+def test_divergence_guard_skips_nonfinite_step(rng):
+    data = batches(rng, n_batches=3)
+    guarded = simple_net()
+    guard = DivergenceGuard(policy="skip")
+    guarded.set_divergence_guard(guard)
+    reference = simple_net()
+
+    # good, poisoned, good — the poisoned step must be a no-op on
+    # params/updater, so the guarded net tracks a reference trained
+    # without it (modulo the skipped step's iteration-count slot)
+    guarded.fit_minibatch(data[0])
+    guarded.fit_minibatch(_poisoned(data[1]))
+    reference.fit_minibatch(data[0])
+
+    assert guard.skipped_steps == 1
+    conftest.assert_params_match(reference, guarded)
+    assert np.isnan(guarded.score_value)  # score still reported
+
+    guarded.fit_minibatch(data[2])  # training continues
+    assert guard.consecutive_bad == 0
+
+
+@pytest.mark.chaos
+def test_divergence_guard_rollback_to_checkpoint(rng, tmp_path):
+    conftest.require_devices(8)
+    from deeplearning4j_tpu.parallel import DistributedTrainer, build_mesh
+
+    data = batches(rng, n_batches=3, batch=16)
+    mgr = CheckpointManager(tmp_path)
+    net = simple_net()
+    guard = DivergenceGuard(policy="rollback", checkpoint_manager=mgr)
+    trainer = DistributedTrainer(
+        net, mesh=build_mesh(), divergence_guard=guard
+    )
+    trainer.fit_minibatch(data[0])
+    mgr.save(net)
+    snap = net.params_flat()
+    trainer.fit_minibatch(data[1])        # advance past the checkpoint
+    trainer.fit_minibatch(_poisoned(data[2]))  # NaN -> rollback
+    assert guard.rollbacks == 1
+    assert net.iteration_count == 1       # counter rewound with state
+    np.testing.assert_array_equal(net.params_flat(), snap)
+    trainer.fit_minibatch(data[1])        # and training continues
+    assert net.iteration_count == 2
+
+
+@pytest.mark.chaos
+def test_divergence_guard_gspmd_step(rng):
+    """batch_stats='sync' forces the GSPMD step flavor — the guard
+    must suppress bad updates there too (the shard_map flavor is
+    covered above)."""
+    conftest.require_devices(8)
+    from deeplearning4j_tpu.parallel import DistributedTrainer, build_mesh
+
+    data = batches(rng, n_batches=2, batch=16)
+    net = simple_net()
+    trainer = DistributedTrainer(
+        net, mesh=build_mesh(), batch_stats="sync",
+        divergence_guard=DivergenceGuard(policy="skip"),
+    )
+    before = net.params_flat()
+    trainer.fit_minibatch(_poisoned(data[0]))
+    np.testing.assert_array_equal(net.params_flat(), before)
+    assert trainer.divergence_guard.skipped_steps == 1
+    trainer.fit_minibatch(data[1])
+    assert trainer.divergence_guard.consecutive_bad == 0
+
+
+@pytest.mark.chaos
+def test_divergence_guard_aborts_after_max_consecutive(rng):
+    data = batches(rng, n_batches=1)
+    net = simple_net()
+    net.set_divergence_guard(DivergenceGuard(policy="skip",
+                                             max_consecutive=2))
+    bad = _poisoned(data[0])
+    net.fit_minibatch(bad)
+    net.fit_minibatch(bad)
+    with pytest.raises(DL4JFaultException):
+        net.fit_minibatch(bad)
+
+
+def test_divergence_guard_validation():
+    with pytest.raises(ValueError):
+        DivergenceGuard(policy="explode")
+    with pytest.raises(ValueError):
+        DivergenceGuard(policy="rollback")  # needs a manager
