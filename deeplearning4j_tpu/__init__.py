@@ -37,6 +37,8 @@ except ImportError:  # pragma: no cover
 
 from deeplearning4j_tpu.exceptions import (  # noqa: F401
     CheckpointCorruptedException,
+    CircuitOpenException,
+    DeadlineExceededException,
     DL4JException,
     DL4JFaultException,
     DL4JInvalidConfigException,
@@ -47,7 +49,31 @@ from deeplearning4j_tpu.exceptions import (  # noqa: F401
 from deeplearning4j_tpu.resilience import (  # noqa: F401
     CheckpointListener,
     CheckpointManager,
+    CircuitBreaker,
+    Deadline,
     DivergenceGuard,
     RetryPolicy,
     retry_call,
 )
+
+# Lazy-import table: serving pulls in the HTTP tier, which training
+# jobs never need — resolve on first attribute access instead of at
+# package import.
+_LAZY_IMPORTS = {
+    "ModelServer": "deeplearning4j_tpu.serving.server",
+    "ServingMetrics": "deeplearning4j_tpu.serving.metrics",
+    "error_envelope": "deeplearning4j_tpu.serving.envelope",
+}
+
+
+def __getattr__(name):
+    target = _LAZY_IMPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value  # cache: resolve once
+    return value

@@ -42,3 +42,27 @@ class RetryExhaustedException(DL4JFaultException):
         super().__init__(message)
         self.attempts = attempts
         self.last_cause = last_cause
+
+
+class CircuitOpenException(DL4JFaultException):
+    """A call was rejected because its ``CircuitBreaker`` is open —
+    the dependency behind it failed repeatedly and fail-fast beats
+    burning a worker on another doomed attempt. ``retry_after`` is
+    the seconds until the breaker admits a half-open probe."""
+
+    def __init__(self, message: str, retry_after: float = 0.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class DeadlineExceededException(DL4JFaultException):
+    """A request outlived its deadline (queue wait + execution).
+    Carries ``elapsed`` and ``budget`` in seconds. Deliberately NOT a
+    ``TimeoutError`` subclass: the default retry allowlist retries
+    ``TimeoutError``, and retrying an already-expired budget only
+    doubles the damage."""
+
+    def __init__(self, message: str, elapsed: float, budget: float):
+        super().__init__(message)
+        self.elapsed = elapsed
+        self.budget = budget

@@ -16,8 +16,15 @@ Four cooperating pieces:
 - **retry with exponential backoff + jitter** (``retry.py``):
   ``RetryPolicy`` / ``retry_call`` / ``@retrying``, raising
   ``RetryExhaustedException`` past the budget;
+- **circuit breaking** (``breaker.py``): ``CircuitBreaker`` — closed
+  -> open after N consecutive failures -> half-open probe -> closed —
+  so persistent faults fail fast instead of burning retry budgets
+  (the serving tier wires it around predict and reload);
+- **deadlines** (``deadline.py``): ``Deadline`` — one wall-budget
+  across queue wait + execution, expiring as
+  ``DeadlineExceededException`` with elapsed/budget;
 - **retrying storage** (``store.py``): ``RetryingObjectStore`` over
-  any ObjectStore backend;
+  any ObjectStore backend, optionally breaker-guarded;
 - **deterministic fault injection** (``chaos.py``): ``ChaosPolicy``
   seeded failure schedules, ``FaultyObjectStore``, ``FlakyIterator``;
 - **divergence guard** (``guard.py``): in-step NaN/Inf detection on
@@ -25,11 +32,17 @@ Four cooperating pieces:
   rollback-to-last-checkpoint policies.
 """
 
+from deeplearning4j_tpu.resilience.breaker import (  # noqa: F401
+    CircuitBreaker,
+)
 from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
     ChaosError,
     ChaosPolicy,
     FaultyObjectStore,
     FlakyIterator,
+)
+from deeplearning4j_tpu.resilience.deadline import (  # noqa: F401
+    Deadline,
 )
 from deeplearning4j_tpu.resilience.checkpoint import (  # noqa: F401
     CheckpointInfo,

@@ -1,0 +1,62 @@
+"""Per-request deadlines.
+
+A ``Deadline`` is a wall-budget stamped at admission and threaded
+through every stage of a request (queue wait, transform, predict) so
+the *total* latency is bounded — per-stage timeouts compose badly:
+three stages each "within budget" can still triple the user's wait.
+The clock is injectable for deterministic tests, and expiry surfaces
+as ``DeadlineExceededException`` carrying elapsed/budget so callers
+(the serving tier's 504 envelope) can report both.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.exceptions import DeadlineExceededException
+
+
+class Deadline:
+    """Monotonic-clock budget. ``Deadline.after(0.5)`` expires 500 ms
+    from now; ``Deadline.none()`` never expires (infinite budget) so
+    call sites need no ``if deadline is not None`` branches."""
+
+    def __init__(self, budget: Optional[float],
+                 clock: Callable[[], float] = time.monotonic):
+        if budget is not None and budget <= 0:
+            raise ValueError("deadline budget must be > 0 (or None)")
+        self.budget = budget
+        self.clock = clock
+        self._start = clock()
+
+    @classmethod
+    def after(cls, budget: Optional[float],
+              clock: Callable[[], float] = time.monotonic) -> "Deadline":
+        return cls(budget, clock=clock)
+
+    @classmethod
+    def none(cls, clock: Callable[[], float] = time.monotonic) -> "Deadline":
+        return cls(None, clock=clock)
+
+    def elapsed(self) -> float:
+        return self.clock() - self._start
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left (may be negative once expired); None when
+        unbounded — the value ``threading.Event.wait`` wants."""
+        if self.budget is None:
+            return None
+        return self.budget - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.budget is not None and self.elapsed() >= self.budget
+
+    def check(self, what: str = "operation") -> None:
+        """Raise ``DeadlineExceededException`` if expired."""
+        if self.expired():
+            raise DeadlineExceededException(
+                f"{what} exceeded its deadline: "
+                f"{self.elapsed():.3f}s elapsed of {self.budget:.3f}s",
+                elapsed=self.elapsed(), budget=self.budget,
+            )

@@ -7,6 +7,13 @@ SDK's internal retries; making it a first-class decorator means GCS,
 local-NFS and injected-fault backends all share one bounded policy,
 and the fit loop sees either a result or ``RetryExhaustedException``.
 
+An optional ``CircuitBreaker`` composes on top: retry absorbs the
+transient blips, and when even the retry budget keeps exhausting
+(endpoint down, not flaky), the breaker trips so subsequent callers —
+e.g. a serving tier's hot-reload path — fail fast with
+``CircuitOpenException`` instead of stacking multi-attempt backoff
+waits per call.
+
 ``open()`` retries the open itself but cannot retry a stream that dies
 mid-read; whole-object ``read()`` is the resilient primitive (and what
 ``CloudDataSetIterator`` uses).
@@ -17,29 +24,39 @@ from __future__ import annotations
 from typing import IO, List, Optional
 
 from deeplearning4j_tpu.cloud.storage import ObjectStore
+from deeplearning4j_tpu.resilience.breaker import CircuitBreaker
 from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 
 
 class RetryingObjectStore(ObjectStore):
     def __init__(self, inner: ObjectStore,
-                 policy: Optional[RetryPolicy] = None):
+                 policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.inner = inner
         self.policy = policy or RetryPolicy()
+        self.breaker = breaker
+
+    def _call(self, fn, *args):
+        if self.breaker is not None:
+            return self.breaker.call(
+                retry_call, fn, *args, policy=self.policy
+            )
+        return retry_call(fn, *args, policy=self.policy)
 
     def keys(self, prefix: str = "") -> List[str]:
-        return retry_call(self.inner.keys, prefix, policy=self.policy)
+        return self._call(self.inner.keys, prefix)
 
     def open(self, key: str) -> IO[bytes]:
-        return retry_call(self.inner.open, key, policy=self.policy)
+        return self._call(self.inner.open, key)
 
     def read(self, key: str) -> bytes:
-        return retry_call(self.inner.read, key, policy=self.policy)
+        return self._call(self.inner.read, key)
 
     def write(self, key: str, data: bytes) -> None:
-        retry_call(self.inner.write, key, data, policy=self.policy)
+        self._call(self.inner.write, key, data)
 
     def download(self, key: str, to_path) -> None:
-        retry_call(self.inner.download, key, to_path, policy=self.policy)
+        self._call(self.inner.download, key, to_path)
 
     def upload(self, from_path, key: str) -> None:
-        retry_call(self.inner.upload, from_path, key, policy=self.policy)
+        self._call(self.inner.upload, from_path, key)

@@ -1,0 +1,33 @@
+"""Hardened model-serving tier (grown from the
+``DL4jServeRouteBuilder`` analog in ``streaming/serve.py``, which now
+re-exports from here).
+
+- ``server.py`` — ``ModelServer``: bounded worker pool + bounded
+  queue (shed with ``503`` + ``Retry-After`` at saturation),
+  per-request ``Deadline`` budgets (``504`` with elapsed/budget),
+  ``CircuitBreaker``-guarded predicts (``503 circuit_open`` when a
+  poisoned model trips it), canary-validated atomic hot reload
+  (``POST /admin/reload`` or checkpoint-watching), ``/readyz``
+  readiness split from ``/healthz`` liveness, graceful drain, and a
+  ``/metrics`` JSON endpoint;
+- ``envelope.py`` — the shared JSON error envelope
+  (``error_envelope``), opaque deterministic error ids, and strict
+  Content-Length body reading (``read_request_body``: 411/400/413);
+- ``metrics.py`` — counters + fixed-size latency reservoir
+  quantiles.
+"""
+
+from deeplearning4j_tpu.serving.envelope import (  # noqa: F401
+    HttpBodyError,
+    error_envelope,
+    error_id_for,
+    read_request_body,
+)
+from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
+    Reservoir,
+    ServingMetrics,
+)
+from deeplearning4j_tpu.serving.server import (  # noqa: F401
+    MAX_BODY,
+    ModelServer,
+)

@@ -1,0 +1,117 @@
+"""Serving observability: counters + a fixed-size latency reservoir.
+
+The robustness behaviors (shedding, deadline kills, breaker trips,
+reloads) are only trustworthy if they are *observable*: the
+``/metrics`` endpoint serves this snapshot as JSON so a saturation
+test — or an operator — can see exactly how many requests were shed
+vs admitted vs timed out, and what the latency quantiles were.
+
+The reservoir is a fixed-size ring of the most recent latencies:
+bounded memory however long the server runs, quantiles computed on
+demand from a sorted copy (nearest-rank). Recency bias is the point —
+serving dashboards want "how slow is it NOW", not a since-boot
+average.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class Reservoir:
+    """Ring buffer of the last ``size`` observations with
+    nearest-rank quantiles."""
+
+    def __init__(self, size: int = 1024):
+        if size < 1:
+            raise ValueError("size must be >= 1")
+        self.size = size
+        self._ring: List[float] = []
+        self._next = 0
+        self.count = 0  # total ever recorded
+
+    def record(self, value: float) -> None:
+        if len(self._ring) < self.size:
+            self._ring.append(value)
+        else:
+            self._ring[self._next] = value
+        self._next = (self._next + 1) % self.size
+        self.count += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self._ring:
+            return None
+        s = sorted(self._ring)
+        idx = min(len(s) - 1, max(0, int(q * len(s))))
+        return s[idx]
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+            "max": max(self._ring) if self._ring else None,
+        }
+
+
+class ServingMetrics:
+    """Thread-safe counter set + latency reservoir for one server."""
+
+    COUNTERS = (
+        "requests_total",        # every HTTP request seen
+        "predictions_total",     # successful predicts
+        "shed_total",            # 503: queue full / draining
+        "breaker_rejected_total",  # 503: circuit open
+        "deadline_timeout_total",  # 504
+        "client_error_total",    # 4xx
+        "server_error_total",    # 5xx from model/transform faults
+        "abandoned_total",       # worker finished after caller's 504
+        "reload_total",          # successful hot swaps
+        "reload_failure_total",  # failed reload attempts (old kept)
+    )
+
+    def __init__(self, reservoir_size: int = 1024):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {k: 0 for k in self.COUNTERS}
+        self._latency = Reservoir(reservoir_size)
+        self.inflight = 0  # admitted, response not yet written
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters[name]
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latency.record(seconds * 1000.0)
+
+    def enter(self) -> None:
+        with self._lock:
+            self.inflight += 1
+
+    def try_enter(self, limit: int) -> bool:
+        """Atomic admission check: admit only while fewer than
+        ``limit`` requests are in the system (workers + wait queue).
+        This counter — not the queue's own size — is the admission
+        bound, so k executing + q queued is exactly the capacity."""
+        with self._lock:
+            if self.inflight >= limit:
+                return False
+            self.inflight += 1
+            return True
+
+    def exit(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["inflight"] = self.inflight
+            out["latency_ms"] = self._latency.snapshot()
+            return out

@@ -1,0 +1,696 @@
+"""Production-hardened model serving tier.
+
+The reference's serving story (``routes/DL4jServeRouteBuilder.java:1``
+— a Camel route: load checkpoint -> transform -> predict) assumed the
+route never saturates, never hangs, and never changes models. This
+module grows that route into a serving tier built for the failure
+modes production traffic actually has:
+
+- **admission control**: predicts run on a bounded worker pool behind
+  a bounded queue. When both are full the request is *shed* —
+  ``503`` + ``Retry-After`` in microseconds — instead of piling
+  threads until the process dies (load shedding beats load collapse);
+- **per-request deadlines**: one ``Deadline`` budget spans queue wait
+  + transform + predict; expiry returns ``504`` with elapsed/budget
+  so clients can tell a slow model from a dead one;
+- **circuit breaking**: a ``CircuitBreaker`` guards the predict path.
+  A poisoned model (every predict raising) trips it after N
+  consecutive failures and subsequent requests fail fast with ``503
+  circuit_open`` until a half-open probe proves recovery;
+- **hot reload**: ``POST /admin/reload`` (or a
+  ``CheckpointManager``-watching mode) restores the new version on
+  the admin thread — never a predict worker — validates it with a
+  canary predict, then swaps it atomically; in-flight requests finish
+  on the version they started with, and a failed reload keeps serving
+  the old model;
+- **readiness vs liveness**: ``/healthz`` answers "is the process
+  up" (always ok while serving); ``/readyz`` answers "should a
+  balancer route here" and flips during reload, breaker-open,
+  queue-high-water, and drain;
+- **graceful drain**: ``stop(drain_timeout=)`` stops admitting,
+  finishes in-flight work, then closes;
+- **observability**: ``/metrics`` serves shed/timeout/breaker/reload
+  counters and latency quantiles (``metrics.py``).
+
+Error responses all use the shared JSON envelope (``envelope.py``):
+``400`` malformed payload, ``411`` missing Content-Length, ``413``
+over the body cap, ``422`` shape-invalid features (expected vs got),
+``500`` model/transform fault with an opaque deterministic
+``error_id`` (never a stack trace), ``503`` shed / circuit open /
+draining, ``504`` deadline exceeded.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.resilience.breaker import OPEN, CircuitBreaker
+from deeplearning4j_tpu.resilience.deadline import Deadline
+from deeplearning4j_tpu.serving.envelope import (
+    HttpBodyError,
+    error_envelope,
+    error_id_for,
+    read_request_body,
+)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+logger = logging.getLogger(__name__)
+
+MAX_BODY = 64 * 1024 * 1024
+
+
+def _feature_dim(model) -> Optional[int]:
+    """Input width from the model's config (first layer's n_in), when
+    it declares one — drives 422 validation and the default canary."""
+    try:
+        n_in = getattr(model.conf.layers[0], "n_in", None)
+    except (AttributeError, IndexError, TypeError):
+        return None
+    if isinstance(n_in, int) and n_in > 0:
+        return n_in
+    return None
+
+
+class _ModelVersion:
+    """One immutable (model, version) pair. Workers snapshot the
+    reference at predict start, so an atomic swap never changes the
+    model under an in-flight request."""
+
+    __slots__ = ("model", "version", "source")
+
+    def __init__(self, model, version: int, source: str):
+        self.model = model
+        self.version = version
+        self.source = source
+
+
+class _NoReloadSource(ValueError):
+    pass
+
+
+class _WorkItem:
+    """One admitted predict: features + deadline in, response out.
+    The handler thread owns the socket; the worker only fills
+    ``response`` and sets ``done``. ``lock`` arbitrates the
+    queue-expiry race (handler cancels vs worker starts)."""
+
+    __slots__ = ("features", "deadline", "done", "response", "lock",
+                 "started", "cancelled", "timed_out")
+
+    def __init__(self, features, deadline: Deadline):
+        self.features = features
+        self.deadline = deadline
+        self.done = threading.Event()
+        self.response = None  # (code, body_dict, headers_dict)
+        self.lock = threading.Lock()
+        self.started = False
+        self.cancelled = False   # handler gave up before worker start
+        self.timed_out = False   # handler wrote a 504 already
+
+    def finish(self, code: int, body: dict, headers=None) -> bool:
+        """Record the worker's result; returns False when the handler
+        already answered 504 (result abandoned)."""
+        with self.lock:
+            abandoned = self.timed_out
+            self.response = (code, body, headers or {})
+        self.done.set()
+        return not abandoned
+
+
+class ModelServer:
+    """Serve a model over HTTP (grown from the
+    ``DL4jServeRouteBuilder`` analog into a hardened tier — see
+    module docstring).
+
+    Endpoints::
+
+        GET  /healthz       liveness: process up
+        GET  /readyz        readiness: routable (flips under stress)
+        GET  /metrics       counters + latency quantiles (JSON)
+        POST /predict       {"features": [[...]]} -> {"output": ...}
+        POST /admin/reload  {} | {"path": ...} | {"key": ...}
+
+    ``model_or_path`` may be a model instance, a checkpoint zip path,
+    or None with ``checkpoint_manager=`` (restores the latest
+    version). ``deadline`` (seconds) bounds queue wait + transform +
+    predict per request; None disables. ``store`` (an ObjectStore,
+    typically ``RetryingObjectStore(breaker=...)``) enables reload by
+    object key.
+    """
+
+    def __init__(self, model_or_path=None, host: str = "127.0.0.1",
+                 port: int = 0, transform=None,
+                 output_classes: bool = False, *,
+                 workers: int = 4, queue_depth: int = 32,
+                 deadline: Optional[float] = None,
+                 retry_after: float = 1.0,
+                 breaker: Optional[CircuitBreaker] = None,
+                 checkpoint_manager=None, store=None, canary=None,
+                 queue_high_water: Optional[int] = None,
+                 reservoir_size: int = 1024):
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
+        if queue_depth < 0:
+            raise ValueError("queue_depth must be >= 0")
+        self.transform = transform
+        self.output_classes = output_classes
+        self.workers = workers
+        self.queue_depth = queue_depth
+        self.deadline = deadline
+        self.retry_after = retry_after
+        self.breaker = breaker or CircuitBreaker(name="predict")
+        self.checkpoint_manager = checkpoint_manager
+        self.store = store
+        self.canary = canary
+        self.queue_high_water = (
+            queue_high_water if queue_high_water is not None
+            else max(queue_depth, 1)
+        )
+        self.metrics = ServingMetrics(reservoir_size)
+
+        self._source_path: Optional[str] = None
+        self._watched_step: Optional[int] = None
+        model, source = self._initial_model(model_or_path)
+        self._active = _ModelVersion(model, 1, source)
+
+        self._model_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        self._reloading = False
+        self._draining = False
+        self._stop_workers = False
+        self._queue: "queue.Queue[_WorkItem]" = queue.Queue(
+            maxsize=queue_depth + workers
+        )
+        self._worker_threads: List[threading.Thread] = []
+        self._watch_thread: Optional[threading.Thread] = None
+        self._watch_stop = threading.Event()
+
+        self._httpd = ThreadingHTTPServer(
+            (host, port), _make_handler(self)
+        )
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # back-compat: the pre-hardening server exposed ``.model``
+    @property
+    def model(self):
+        return self._active.model
+
+    @property
+    def model_version(self) -> int:
+        return self._active.version
+
+    def _initial_model(self, model_or_path):
+        if isinstance(model_or_path, str):
+            from deeplearning4j_tpu.util.model_serializer import (
+                restore_model,
+            )
+
+            self._source_path = model_or_path
+            return restore_model(model_or_path), model_or_path
+        if model_or_path is not None:
+            return model_or_path, type(model_or_path).__name__
+        if self.checkpoint_manager is not None:
+            model, info = self.checkpoint_manager.restore_latest(
+                load_updater=False
+            )
+            self._watched_step = info.step
+            return model, f"checkpoint-step-{info.step}"
+        raise ValueError(
+            "provide a model, a checkpoint path, or checkpoint_manager="
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "ModelServer":
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=self._worker_loop, daemon=True,
+                name=f"dl4j-serve-worker-{i}",
+            )
+            t.start()
+            self._worker_threads.append(t)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dl4j-tpu-serve",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain_timeout: float = 5.0) -> bool:
+        """Graceful drain: stop admitting (new work is shed with
+        ``503 draining``), wait up to ``drain_timeout`` seconds for
+        in-flight requests to finish, then close the listener and the
+        pool. Returns True when the drain fully emptied."""
+        self._draining = True
+        deadline = time.monotonic() + max(drain_timeout, 0.0)
+        drained = False
+        while time.monotonic() < deadline:
+            if self.metrics.inflight == 0 and self._queue.empty():
+                drained = True
+                break
+            time.sleep(0.01)
+        self.stop_watch()
+        self._stop_workers = True
+        for t in self._worker_threads:
+            t.join(timeout=2)
+        if self._thread is not None:  # shutdown() hangs if never served
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+        return drained or (
+            self.metrics.inflight == 0 and self._queue.empty()
+        )
+
+    # -- worker pool ----------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while not self._stop_workers:
+            try:
+                item = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                self._process(item)
+            except Exception:  # never kill a pool thread
+                logger.exception("serve worker crashed on a request")
+                item.finish(500, error_envelope(
+                    "internal", 500, "internal server error",
+                ))
+
+    def _process(self, item: _WorkItem) -> None:
+        with item.lock:
+            if item.cancelled:
+                return
+            item.started = True
+        if item.deadline.expired():
+            # expired while queued: report without touching the model
+            self.metrics.incr("deadline_timeout_total")
+            item.finish(504, error_envelope(
+                "deadline_exceeded", 504,
+                "deadline expired while queued",
+                elapsed=round(item.deadline.elapsed(), 4),
+                budget=item.deadline.budget,
+            ))
+            return
+        if not self.breaker.try_acquire():
+            self.metrics.incr("breaker_rejected_total")
+            item.finish(503, error_envelope(
+                "circuit_open", 503,
+                "model circuit is open; failing fast",
+                retry_after=round(self.breaker.retry_after(), 3),
+            ), {"Retry-After": self._retry_after_header()})
+            return
+        mv = self._active  # snapshot: reloads swap for later requests
+        try:
+            feats = item.features
+            if self.transform is not None:
+                feats = self.transform(feats)
+            out = mv.model.output(feats)
+            out = np.asarray(
+                out[0] if isinstance(out, (list, tuple)) else out
+            )
+        except Exception as e:
+            self.breaker.record_failure()
+            eid = error_id_for(e)
+            logger.error("predict failed (error_id=%s)", eid,
+                         exc_info=True)
+            self.metrics.incr("server_error_total")
+            item.finish(500, error_envelope(
+                "model_error", 500,
+                "prediction failed; see server log",
+                error_id=eid,
+            ))
+            return
+        self.breaker.record_success()
+        body = {"output": out.tolist(), "model_version": mv.version}
+        if self.output_classes and out.ndim == 2:
+            body["classes"] = out.argmax(axis=1).tolist()
+        self.metrics.incr("predictions_total")
+        if not item.finish(200, body):
+            self.metrics.incr("abandoned_total")
+
+    def _retry_after_header(self) -> str:
+        return str(max(1, int(round(self.retry_after))))
+
+    # -- admission (called from handler threads) ------------------------
+
+    def submit(self, features) -> "tuple[int, dict, dict]":
+        """Admit one predict through the bounded pool and wait for its
+        result under the request deadline. Returns
+        ``(status, body, headers)``."""
+        if self._draining:
+            self.metrics.incr("shed_total")
+            return 503, error_envelope(
+                "draining", 503, "server is draining; not admitting",
+                retry_after=self.retry_after,
+            ), {"Retry-After": self._retry_after_header()}
+        if self.breaker.state == OPEN:
+            # fail fast at admission: no queue slot for a doomed call
+            self.metrics.incr("breaker_rejected_total")
+            return 503, error_envelope(
+                "circuit_open", 503,
+                "model circuit is open; failing fast",
+                retry_after=round(self.breaker.retry_after(), 3),
+            ), {"Retry-After": self._retry_after_header()}
+        # admission bound: at most workers + queue_depth requests in
+        # the system (executing + queued); the excess is shed NOW
+        if not self.metrics.try_enter(self.workers + self.queue_depth):
+            self.metrics.incr("shed_total")
+            return 503, error_envelope(
+                "shed", 503,
+                "worker pool and queue are full",
+                retry_after=self.retry_after,
+            ), {"Retry-After": self._retry_after_header()}
+        item = _WorkItem(features, Deadline.after(self.deadline))
+        try:
+            try:
+                self._queue.put_nowait(item)
+            except queue.Full:  # unreachable: sized to the bound
+                self.metrics.incr("shed_total")
+                return 503, error_envelope(
+                    "shed", 503,
+                    "worker pool and queue are full",
+                    retry_after=self.retry_after,
+                ), {"Retry-After": self._retry_after_header()}
+            remaining = item.deadline.remaining()
+            finished = item.done.wait(
+                None if remaining is None else max(remaining, 0.0)
+            )
+            if not finished:
+                with item.lock:
+                    item.timed_out = True
+                    if not item.started:
+                        item.cancelled = True
+                self.metrics.incr("deadline_timeout_total")
+                return 504, error_envelope(
+                    "deadline_exceeded", 504,
+                    "request exceeded its deadline",
+                    elapsed=round(item.deadline.elapsed(), 4),
+                    budget=item.deadline.budget,
+                ), {}
+            return item.response
+        finally:
+            self.metrics.exit()
+
+    # -- hot reload -----------------------------------------------------
+
+    def reload(self, spec: Optional[dict] = None) -> "tuple[int, dict]":
+        """Restore a new model version (off the worker pool), canary-
+        validate it, and swap atomically. A failure at any stage keeps
+        the current version serving. Returns ``(status, body)``."""
+        if not self._reload_lock.acquire(blocking=False):
+            return 409, error_envelope(
+                "reload_in_progress", 409,
+                "another reload is already running",
+            )
+        try:
+            self._reloading = True  # /readyz flips for the duration
+            try:
+                model, source = self._load_for_reload(spec or {})
+                self._canary_check(model)
+            except _NoReloadSource as e:
+                return 400, error_envelope("no_reload_source", 400,
+                                           str(e))
+            except Exception as e:
+                eid = error_id_for(e)
+                logger.error("reload failed (error_id=%s)", eid,
+                             exc_info=True)
+                self.metrics.incr("reload_failure_total")
+                return 503, error_envelope(
+                    "reload_failed", 503,
+                    "model reload failed; previous version still "
+                    "serving", error_id=eid,
+                )
+            with self._model_lock:
+                version = self._active.version + 1
+                self._active = _ModelVersion(model, version, source)
+            self.metrics.incr("reload_total")
+            return 200, {"status": "reloaded", "version": version,
+                         "model": type(model).__name__,
+                         "source": source}
+        finally:
+            self._reloading = False
+            self._reload_lock.release()
+
+    def _load_for_reload(self, spec: dict):
+        from deeplearning4j_tpu.util.model_serializer import (
+            restore_model,
+            restore_model_from_bytes,
+        )
+
+        if "path" in spec:
+            return (
+                restore_model(spec["path"], load_updater=False),
+                str(spec["path"]),
+            )
+        if "key" in spec:
+            if self.store is None:
+                raise _NoReloadSource(
+                    "reload by key requires the server's store="
+                )
+            data = self.store.read(spec["key"])
+            return (
+                restore_model_from_bytes(data, load_updater=False),
+                str(spec["key"]),
+            )
+        if self.checkpoint_manager is not None:
+            model, info = self.checkpoint_manager.restore_latest(
+                load_updater=False
+            )
+            return model, f"checkpoint-step-{info.step}"
+        if self._source_path is not None:
+            return (
+                restore_model(self._source_path, load_updater=False),
+                self._source_path,
+            )
+        raise _NoReloadSource(
+            "no reload source: pass {\"path\": ...} / {\"key\": ...} "
+            "or construct the server with checkpoint_manager="
+        )
+
+    def _canary_check(self, model) -> None:
+        """One predict on the candidate BEFORE it takes traffic — a
+        restorable-but-broken checkpoint must fail the reload, not the
+        next thousand user requests."""
+        feats = self.canary
+        if feats is None:
+            n_in = _feature_dim(model)
+            if n_in is None:
+                return  # shape unknown and no canary provided: skip
+            feats = np.zeros((1, n_in), np.float32)
+        feats = np.asarray(feats, np.float32)
+        if self.transform is not None:
+            feats = self.transform(feats)
+        out = model.output(feats)
+        out = np.asarray(out[0] if isinstance(out, (list, tuple))
+                         else out)
+        if not np.all(np.isfinite(out)):
+            raise ValueError("canary predict produced non-finite output")
+
+    # -- checkpoint watching --------------------------------------------
+
+    def check_for_update(self) -> bool:
+        """One poll of the checkpoint manager: reload iff a newer step
+        than the last loaded one exists. Returns True on a swap."""
+        if self.checkpoint_manager is None:
+            return False
+        step = self.checkpoint_manager.last_step()
+        if step is None or step == self._watched_step:
+            return False
+        code, _ = self.reload({})
+        if code == 200:
+            self._watched_step = step
+            return True
+        return False
+
+    def watch(self, interval: float = 1.0) -> "ModelServer":
+        """Poll the checkpoint manager every ``interval`` seconds on a
+        daemon thread and hot-swap when a new version lands."""
+        if self.checkpoint_manager is None:
+            raise ValueError("watch() requires checkpoint_manager=")
+        if self._watch_thread is not None:
+            return self
+        self._watch_stop.clear()
+
+        def _loop():
+            while not self._watch_stop.wait(interval):
+                try:
+                    self.check_for_update()
+                except Exception:
+                    logger.exception("checkpoint watch poll failed")
+
+        self._watch_thread = threading.Thread(
+            target=_loop, daemon=True, name="dl4j-serve-watch",
+        )
+        self._watch_thread.start()
+        return self
+
+    def stop_watch(self) -> None:
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2)
+            self._watch_thread = None
+
+    # -- health / metrics -----------------------------------------------
+
+    def health(self) -> dict:
+        return {
+            "status": "ok",
+            "model": type(self._active.model).__name__,
+            "version": self._active.version,
+        }
+
+    def readiness(self) -> "tuple[int, dict]":
+        reasons = []
+        if self._draining:
+            reasons.append("draining")
+        if self._reloading:
+            reasons.append("reloading")
+        if self.breaker.state == OPEN:
+            reasons.append("breaker_open")
+        if self._queue.qsize() >= self.queue_high_water:
+            reasons.append("queue_high_water")
+        if reasons:
+            return 503, {"status": "unready", "reasons": reasons}
+        return 200, {"status": "ready",
+                     "version": self._active.version}
+
+    def metrics_snapshot(self) -> dict:
+        out = self.metrics.snapshot()
+        out["queue_depth"] = self._queue.qsize()
+        out["queue_capacity"] = self.queue_depth
+        out["workers"] = self.workers
+        out["breaker"] = self.breaker.snapshot()
+        out["model_version"] = self._active.version
+        out["draining"] = self._draining
+        return out
+
+    # -- request validation ---------------------------------------------
+
+    def parse_features(self, data: bytes):
+        """Body bytes -> float32 feature array, or raise
+        ``HttpBodyError`` with the right 4xx envelope: 400 for
+        malformed payloads, 422 for well-formed-but-shape-invalid
+        features (expected vs got in the body)."""
+        try:
+            payload = json.loads(data)
+        except (ValueError, UnicodeDecodeError) as e:
+            raise HttpBodyError(400, error_envelope(
+                "malformed_json", 400, f"body is not valid JSON: {e}",
+            )) from None
+        if not isinstance(payload, dict) or "features" not in payload:
+            raise HttpBodyError(400, error_envelope(
+                "bad_request", 400,
+                'body must be a JSON object with a "features" key',
+            ))
+        try:
+            feats = np.asarray(payload["features"], np.float32)
+        except (ValueError, TypeError):
+            raise HttpBodyError(422, error_envelope(
+                "invalid_features", 422,
+                "features are not a numeric array",
+                expected="numeric array [n, d]",
+                got=type(payload["features"]).__name__,
+            )) from None
+        if feats.ndim not in (1, 2) or feats.size == 0:
+            raise HttpBodyError(422, error_envelope(
+                "invalid_features", 422,
+                "features must be a non-empty 1-d or 2-d array",
+                expected="[n, d]", got=list(feats.shape),
+            ))
+        n_in = _feature_dim(self._active.model)
+        if n_in is not None and feats.shape[-1] != n_in:
+            raise HttpBodyError(422, error_envelope(
+                "invalid_features", 422,
+                "feature width does not match the model input",
+                expected=[int(feats.shape[0]) if feats.ndim == 2
+                          else 1, n_in],
+                got=list(feats.shape),
+            ))
+        return feats
+
+
+def _make_handler(server: ModelServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _json(self, obj, code: int = 200, headers=None):
+            body = json.dumps(obj).encode()
+            try:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+            except OSError:
+                pass  # client went away; nothing to tell it
+
+        def do_GET(self):
+            server.metrics.incr("requests_total")
+            if self.path == "/healthz":
+                self._json(server.health())
+                return
+            if self.path == "/readyz":
+                code, body = server.readiness()
+                self._json(body, code)
+                return
+            if self.path == "/metrics":
+                self._json(server.metrics_snapshot())
+                return
+            self._json(error_envelope("not_found", 404, "not found"),
+                       404)
+
+        def do_POST(self):
+            server.metrics.incr("requests_total")
+            if self.path == "/predict":
+                started = time.monotonic()
+                try:
+                    data = read_request_body(self, MAX_BODY)
+                    feats = server.parse_features(data)
+                except HttpBodyError as e:
+                    server.metrics.incr("client_error_total")
+                    self._json(e.envelope, e.code)
+                    return
+                code, body, headers = server.submit(feats)
+                server.metrics.record_latency(
+                    time.monotonic() - started
+                )
+                self._json(body, code, headers)
+                return
+            if self.path == "/admin/reload":
+                try:
+                    data = read_request_body(self, MAX_BODY)
+                except HttpBodyError as e:
+                    server.metrics.incr("client_error_total")
+                    self._json(e.envelope, e.code)
+                    return
+                try:
+                    spec = json.loads(data) if data.strip() else {}
+                    if not isinstance(spec, dict):
+                        raise ValueError("spec must be a JSON object")
+                except ValueError as e:
+                    server.metrics.incr("client_error_total")
+                    self._json(error_envelope(
+                        "malformed_json", 400,
+                        f"reload spec is not valid JSON: {e}",
+                    ), 400)
+                    return
+                code, body = server.reload(spec)
+                self._json(body, code)
+                return
+            self._json(error_envelope("not_found", 404, "not found"),
+                       404)
+
+    return Handler

@@ -1,116 +1,24 @@
 """Model-serving route (reference
 ``routes/DL4jServeRouteBuilder.java:1`` — a Camel route that loads a
 ``ModelSerializer`` checkpoint, transforms the incoming record and
-predicts; here a stdlib HTTP server with the same load->transform->
-predict shape).
+predicts).
 
-Endpoints:
-- ``GET  /healthz``    -> {"status": "ok", "model": "<class>"}
-- ``POST /predict``    -> body {"features": [[...]]}; returns
-  {"output": [[...]]} (+ {"classes": [...]} argmaxes when
-  ``output_classes``)
-Binds loopback by default (same policy as the training UI server).
+This module grew into the hardened serving tier in
+``deeplearning4j_tpu/serving/`` — admission control, per-request
+deadlines, circuit breaking, canary-validated hot reload, graceful
+drain, ``/readyz`` vs ``/healthz``, ``/metrics`` — and now re-exports
+it so existing ``streaming.ModelServer`` imports keep working with
+the same constructor surface (model-or-path, host, port, transform,
+output_classes) plus the new keyword-only hardening knobs. The old
+toy handler's bugs are fixed in the shared implementation: bodies are
+read to the full Content-Length (short reads are ``400``, missing
+Content-Length is ``411``), malformed payloads are ``400``,
+shape-invalid features are ``422`` with expected-vs-got, and
+model/transform faults are ``500`` with an opaque error id — never a
+masked ``400`` or a stack trace.
 """
 
-from __future__ import annotations
-
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-
-import numpy as np
-
-MAX_BODY = 64 * 1024 * 1024
-
-
-def _make_handler(server: "ModelServer"):
-    class Handler(BaseHTTPRequestHandler):
-        def log_message(self, *a):
-            pass
-
-        def _json(self, obj, code: int = 200):
-            body = json.dumps(obj).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def do_GET(self):
-            if self.path == "/healthz":
-                self._json({
-                    "status": "ok",
-                    "model": type(server.model).__name__,
-                })
-                return
-            self._json({"error": "not found"}, 404)
-
-        def do_POST(self):
-            if self.path != "/predict":
-                self._json({"error": "not found"}, 404)
-                return
-            try:
-                length = int(self.headers.get("Content-Length", 0))
-            except (TypeError, ValueError):
-                self._json({"error": "bad Content-Length"}, 400)
-                return
-            if length < 0 or length > MAX_BODY:
-                self._json({"error": "payload too large"}, 413)
-                return
-            try:
-                payload = json.loads(self.rfile.read(length))
-                feats = np.asarray(payload["features"], np.float32)
-                if server.transform is not None:
-                    feats = server.transform(feats)
-                out = server.model.output(feats)
-                out = np.asarray(
-                    out[0] if isinstance(out, (list, tuple)) else out
-                )
-            except Exception as e:
-                self._json({"error": f"bad request: {e}"}, 400)
-                return
-            resp = {"output": out.tolist()}
-            if server.output_classes and out.ndim == 2:
-                resp["classes"] = out.argmax(axis=1).tolist()
-            self._json(resp)
-
-    return Handler
-
-
-class ModelServer:
-    """Serve a saved model over HTTP (reference
-    ``DL4jServeRouteBuilder`` — ``modelUri`` + ``transform`` +
-    predict)."""
-
-    def __init__(self, model_or_path, host: str = "127.0.0.1",
-                 port: int = 0, transform=None,
-                 output_classes: bool = False):
-        if isinstance(model_or_path, str):
-            from deeplearning4j_tpu.util.model_serializer import (
-                restore_model,
-            )
-
-            self.model = restore_model(model_or_path)
-        else:
-            self.model = model_or_path
-        self.transform = transform
-        self.output_classes = output_classes
-        self._httpd = ThreadingHTTPServer(
-            (host, port), _make_handler(self)
-        )
-        self.port = self._httpd.server_address[1]
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> "ModelServer":
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name="dl4j-tpu-serve",
-        )
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        if self._thread:
-            self._thread.join(timeout=5)
+from deeplearning4j_tpu.serving.server import (  # noqa: F401
+    MAX_BODY,
+    ModelServer,
+)

@@ -20,6 +20,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from deeplearning4j_tpu.serving.envelope import (
+    HttpBodyError,
+    error_envelope,
+    read_request_body,
+)
 from deeplearning4j_tpu.ui.model import (
     StatsStorage,
     decode_record,
@@ -382,50 +387,52 @@ def _make_handler(server: "UIServer"):
             if url.path == "/train/activations":
                 self._json(server.activations())
                 return
-            self._json({"error": "not found"}, 404)
+            self._json(error_envelope("not_found", 404, "not found"),
+                       404)
 
         def do_POST(self):
             path = urlparse(self.path).path
             if path == "/tsne/post":
+                # shared body discipline with the serving tier:
+                # 411 no Content-Length, 400 short read, 413 over cap
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                except (TypeError, ValueError):
-                    self._json({"error": "bad Content-Length"}, 400)
-                    return
-                if length < 0 or length > MAX_POST_BYTES:
-                    self._json({"error": "payload too large"}, 413)
+                    data = read_request_body(self, MAX_POST_BYTES)
+                except HttpBodyError as e:
+                    self._json(e.envelope, e.code)
                     return
                 try:
-                    payload = json.loads(self.rfile.read(length))
+                    payload = json.loads(data)
                     n = server.set_tsne_vectors(
                         payload["vectors"], payload.get("labels")
                     )
                 except Exception as e:
-                    self._json({"error": f"bad payload: {e}"}, 400)
+                    self._json(error_envelope(
+                        "bad_payload", 400, f"bad payload: {e}",
+                    ), 400)
                     return
                 self._json({"status": "ok", "points": n})
                 return
             # RemoteReceiverModule analog: accept posted stats records
             if path != "/remoteReceive":
-                self._json({"error": "not found"}, 404)
+                self._json(error_envelope("not_found", 404,
+                                          "not found"), 404)
                 return
             if not server.remote_enabled:
-                self._json({"error": "remote receiver disabled"}, 403)
+                self._json(error_envelope(
+                    "remote_disabled", 403, "remote receiver disabled",
+                ), 403)
                 return
             try:
-                length = int(self.headers.get("Content-Length", 0))
-            except (TypeError, ValueError):
-                self._json({"error": "bad Content-Length"}, 400)
+                data = read_request_body(self, MAX_POST_BYTES)
+            except HttpBodyError as e:
+                self._json(e.envelope, e.code)
                 return
-            if length < 0 or length > MAX_POST_BYTES:
-                # negative would make rfile.read unbounded
-                self._json({"error": "payload too large"}, 413)
-                return
-            data = self.rfile.read(length)
             try:
                 rec = decode_record(data)
             except Exception as e:
-                self._json({"error": f"bad record: {e}"}, 400)
+                self._json(error_envelope(
+                    "bad_record", 400, f"bad record: {e}",
+                ), 400)
                 return
             storage = server.primary_storage()
             if isinstance(rec, StatsInitializationReport):

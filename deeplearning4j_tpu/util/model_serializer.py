@@ -156,6 +156,14 @@ def restore_model(path, load_updater: bool = True):
     return _restore(path, load_updater, expect=None)
 
 
+def restore_model_from_bytes(data: bytes, load_updater: bool = True):
+    """Restore a model from an in-memory checkpoint zip — the path
+    object-store reads take (``store.read(key)`` ->
+    ``restore_model_from_bytes``), so serving-tier hot reloads never
+    stage a temp file."""
+    return _restore(io.BytesIO(data), load_updater, expect=None)
+
+
 def _restore(path, load_updater: bool, expect: Optional[str]):
     from deeplearning4j_tpu.nn.conf.graph_conf import (
         ComputationGraphConfiguration,

@@ -14,6 +14,10 @@ cd "$(dirname "$0")/.."
 
 export DL4J_TPU_CHAOS_SEED="${DL4J_TPU_CHAOS_SEED:-1337}"
 echo "chaos seed: ${DL4J_TPU_CHAOS_SEED}"
+# Registered chaos suites:
+#   tests/test_resilience.py — training runtime (retry/checkpoint/guard)
+#   tests/test_serving.py    — serving tier (breaker + fault storms)
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pytest tests/ -q -m chaos \
+    python -m pytest tests/test_resilience.py tests/test_serving.py \
+    -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -144,6 +144,66 @@ def test_model_server_transform_hook(rng):
         server.stop()
 
 
+def test_model_server_error_codes_not_conflated(rng):
+    """The old route masked every failure as 400; the hardened server
+    must distinguish client payload errors (400), shape-invalid
+    features (422 with expected-vs-got), and model/transform faults
+    (500, opaque error id — no exception text)."""
+    conf = (
+        NeuralNetConfiguration.Builder().seed(2)
+        .list()
+        .layer(OutputLayer(n_in=2, n_out=2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    server = ModelServer(net).start()
+    base = f"http://127.0.0.1:{server.port}/predict"
+
+    def post(data):
+        try:
+            with urllib.request.urlopen(
+                urllib.request.Request(base, data=data), timeout=10
+            ) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, body = post(b"{not json")
+        assert code == 400
+        assert body["error"]["status"] == "malformed_json"
+        code, body = post(json.dumps(
+            {"features": [[1.0, 2.0, 3.0]]}).encode())
+        assert code == 422
+        assert body["error"]["expected"] == [1, 2]
+        assert body["error"]["got"] == [1, 3]
+    finally:
+        server.stop()
+
+    # transform exceptions are server faults, not bad requests
+    server = ModelServer(
+        net, transform=lambda f: (_ for _ in ()).throw(
+            RuntimeError("secret internals"))
+    ).start()
+    try:
+        try:
+            with urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}/predict",
+                    data=json.dumps({"features": [[1.0, 2.0]]}).encode(),
+                ), timeout=10,
+            ) as r:
+                code, body = r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            code, body = e.code, json.loads(e.read())
+        assert code == 500
+        assert body["error"]["status"] == "model_error"
+        assert body["error"]["error_id"].startswith("e")
+        assert "secret internals" not in json.dumps(body)
+    finally:
+        server.stop()
+
+
 def test_streaming_iterator_rejects_mixed_labels(rng):
     consumer = NDArrayConsumer(port=0).listen()
     pub = NDArrayPublisher("127.0.0.1", consumer.port)

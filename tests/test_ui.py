@@ -355,3 +355,61 @@ class TestGraphAndActivations:
         for b64 in act["grids"].values():
             img = Image.open(_io.BytesIO(base64.b64decode(b64)))
             assert img.size[0] > 1 and img.size[1] > 1
+
+
+class TestPostBodyDiscipline:
+    """UI POST routes share the serving tier's body cap + error
+    envelope (411 missing Content-Length, 413 over cap, enveloped
+    400s) instead of hand-rolled per-route checks."""
+
+    @pytest.fixture
+    def server(self):
+        s = UIServer(port=0)
+        yield s
+        s.stop()
+
+    def _raw(self, port, head: bytes) -> bytes:
+        import socket
+
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=10) as sk:
+            sk.sendall(head)
+            data = b""
+            while True:
+                chunk = sk.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            return data
+
+    def test_post_without_content_length_is_411(self, server):
+        resp = self._raw(
+            server.port,
+            b"POST /tsne/post HTTP/1.1\r\nHost: t\r\n\r\n",
+        )
+        assert b" 411 " in resp.split(b"\r\n", 1)[0]
+        body = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+        assert body["error"]["status"] == "length_required"
+
+    def test_oversize_post_is_413_enveloped(self, server):
+        server.enable_remote_listener()
+        resp = self._raw(
+            server.port,
+            b"POST /remoteReceive HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Length: 999999999\r\n\r\n",
+        )
+        assert b" 413 " in resp.split(b"\r\n", 1)[0]
+        body = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+        assert body["error"]["status"] == "payload_too_large"
+        assert body["error"]["limit"] == 16 * 1024 * 1024
+
+    def test_bad_payload_is_enveloped_400(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/tsne/post",
+            data=json.dumps({"vectors": [1, 2, 3]}).encode(),
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        body = json.loads(ei.value.read())
+        assert body["error"]["status"] == "bad_payload"
