@@ -66,6 +66,7 @@ here because the dev tunnel's host<->device link is ~10-20 MB/s
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -1007,6 +1008,47 @@ def bench_dp_scaling(batch=64, steps=4) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 8. Serving micro-batch throughput (scripts/bench_serving.py)
+# ---------------------------------------------------------------------------
+
+
+def bench_serving(budget_s=None) -> dict:
+    """Batched vs solo serving throughput at concurrency 32 on this
+    backend, via the standalone smoke script (subprocess: the load
+    generator spins up 30+ client threads and two servers — keep that
+    out of the bench process). Reports the script's JSON verbatim;
+    the acceptance gates are ``speedup`` >= 4 and
+    ``post_warmup_compiles_total`` == 0."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_serving.py",
+    )
+    timeout = 600
+    if budget_s is not None:
+        timeout = max(30, min(timeout, int(budget_s)))
+    out = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        timeout=timeout,
+        env={**os.environ,
+             "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE},
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_serving failed: {out.stderr[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+# ---------------------------------------------------------------------------
+
+
+class _BenchInterrupted(Exception):
+    """SIGTERM/SIGALRM landed: stop the current section and emit the
+    partial JSON instead of dying silently under ``timeout -k``."""
+
+
+def _raise_interrupted(signum, frame):
+    raise _BenchInterrupted(f"signal {signum}")
 
 
 def main() -> None:
@@ -1014,11 +1056,53 @@ def main() -> None:
 
     peak, device_kind = device_peak_flops()
     configs = {}
+    # BENCH_BUDGET_S: wall budget for the whole run. Each section is
+    # time-boxed to the remaining budget (SIGALRM) and sections that
+    # don't fit are SKIPPED — the run always prints one valid JSON
+    # line with `sections_skipped` instead of dying on the driver's
+    # `timeout -k` (BENCH_r05 rc=124 was exactly that death).
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "0") or 0)
+    t_start = time.monotonic()
+    sections_skipped = []
+    state = {"terminated": False}
+    try:  # signals only bind on the main thread
+        signal.signal(signal.SIGTERM, _raise_interrupted)
+        signal.signal(signal.SIGALRM, _raise_interrupted)
+        on_main = True
+    except ValueError:
+        on_main = False
+
+    def remaining():
+        if budget_s <= 0:
+            return None
+        return budget_s - (time.monotonic() - t_start)
 
     def run_config(key, fn, unit):
+        if state["terminated"]:
+            sections_skipped.append(key)
+            return
+        rem = remaining()
+        if rem is not None and rem <= 5:
+            sections_skipped.append(key)  # budget spent: skip, report
+            return
         # a failure in one config must never lose the others' numbers
         try:
-            value = fn()
+            if rem is not None and on_main:
+                signal.alarm(max(int(rem), 1))
+            try:
+                value = fn()
+            finally:
+                if on_main:
+                    signal.alarm(0)
+        except _BenchInterrupted:
+            # SIGTERM kills the whole run; an expired SIGALRM only
+            # this section — either way the JSON still prints
+            sections_skipped.append(key)
+            configs[key] = {"error": "timed out (BENCH_BUDGET_S)"}
+            if remaining() is not None and remaining() > 5:
+                return  # alarm, not terminate: later sections may fit
+            state["terminated"] = True
+            return
         except Exception as e:
             configs[key] = {"error": str(e)[:500]}
             return
@@ -1028,6 +1112,10 @@ def main() -> None:
                 "value": eff, "unit": unit, "vs_baseline": eff,
                 "detail": value,
             }
+            return
+        if "value" not in value:
+            # sectioned detail payloads (serving A/B) pass through
+            configs[key] = {"unit": unit, **value}
             return
         rate = value.pop("value")
         entry = {
@@ -1044,20 +1132,32 @@ def main() -> None:
         entry.update(value)  # data source, input-pipeline metrics, ...
         configs[key] = entry
 
-    run_config("lenet_mnist", bench_lenet, "examples/sec/chip")
-    run_config("vgg16_cifar10", bench_vgg16, "examples/sec/chip")
-    run_config("lstm_char_rnn", bench_lstm_char_rnn, "chars/sec/chip")
-    run_config("lstm_saturated", bench_lstm_saturated, "chars/sec/chip")
-    run_config("word2vec_sg", bench_word2vec, "words/sec")
-    run_config("resnet50_imagenet", bench_resnet50, "examples/sec/chip")
-    run_config("transformer_lm", bench_transformer, "tokens/sec/chip")
-    run_config(
-        "dp_scaling", bench_dp_scaling,
-        "dp sharding-overhead efficiency, fixed global batch "
-        "(8 virtual cpu devices; 1.0 = zero overhead)",
-    )
+    sections = [
+        ("lenet_mnist", bench_lenet, "examples/sec/chip"),
+        ("vgg16_cifar10", bench_vgg16, "examples/sec/chip"),
+        ("lstm_char_rnn", bench_lstm_char_rnn, "chars/sec/chip"),
+        ("lstm_saturated", bench_lstm_saturated, "chars/sec/chip"),
+        ("word2vec_sg", bench_word2vec, "words/sec"),
+        ("resnet50_imagenet", bench_resnet50, "examples/sec/chip"),
+        ("transformer_lm", bench_transformer, "tokens/sec/chip"),
+        ("dp_scaling", bench_dp_scaling,
+         "dp sharding-overhead efficiency, fixed global batch "
+         "(8 virtual cpu devices; 1.0 = zero overhead)"),
+        ("serving_microbatch",
+         lambda: bench_serving(remaining()),
+         "batched-vs-solo serving req/s at concurrency 32 "
+         "(scripts/bench_serving.py; speedup >= 4 is the gate)"),
+    ]
+    try:
+        for key, fn, unit in sections:
+            run_config(key, fn, unit)
+    except _BenchInterrupted:  # SIGTERM between sections
+        done = set(configs) | set(sections_skipped)
+        sections_skipped.extend(
+            k for k, _, _ in sections if k not in done
+        )
 
-    primary = configs["lenet_mnist"]
+    primary = configs.get("lenet_mnist", {})
     print(json.dumps({
         "metric": "lenet_mnist_fit_examples_per_sec",
         "value": primary.get("value"),
@@ -1065,6 +1165,9 @@ def main() -> None:
         "vs_baseline": primary.get("vs_baseline"),
         "device": device_kind,
         "peak_bf16_tflops": peak / 1e12 if peak else None,
+        "budget_s": budget_s or None,
+        "elapsed_s": round(time.monotonic() - t_start, 1),
+        "sections_skipped": sections_skipped,
         "configs": configs,
     }))
 

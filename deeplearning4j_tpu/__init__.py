@@ -63,6 +63,9 @@ _LAZY_IMPORTS = {
     "ModelServer": "deeplearning4j_tpu.serving.server",
     "ServingMetrics": "deeplearning4j_tpu.serving.metrics",
     "error_envelope": "deeplearning4j_tpu.serving.envelope",
+    "BucketLadder": "deeplearning4j_tpu.serving.batcher",
+    "MicroBatcher": "deeplearning4j_tpu.serving.batcher",
+    "CompileCache": "deeplearning4j_tpu.serving.compile_cache",
 }
 
 

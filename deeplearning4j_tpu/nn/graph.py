@@ -837,6 +837,47 @@ class ComputationGraph:
             ]
         return self._jit_output(self.params, self.state, arr, fm)
 
+    def output_padded(self, *inputs, n_valid, features_masks=None):
+        """Inference on row-padded batches: every graph input is
+        padded to the same bucketed row count; returns each output
+        vertex's activations sliced back to the first ``n_valid``
+        rows. Same contract as ``MultiLayerNetwork.output_padded`` —
+        shares ``output``'s jitted program (one executable per bucket
+        shape), relies on row-independence of inference-mode vertices
+        (enforced bitwise by ``tests/test_batching.py``), and
+        composes ``features_masks`` that cover only the valid rows
+        with all-ones padding rows."""
+        n = int(n_valid)
+        if not inputs:
+            raise ValueError("output_padded needs at least one input")
+        b = int(np.shape(inputs[0])[0])
+        if not 0 < n <= b:
+            raise ValueError(
+                f"n_valid must be in [1, {b}] for a {b}-row batch; "
+                f"got {n}"
+            )
+        fms = features_masks
+        if fms is not None:
+            padded_fms = []
+            for m in _as_list(fms):
+                if m is not None:
+                    m = np.asarray(m)
+                    if m.shape[0] == n and n < b:
+                        m = np.concatenate(
+                            [m, np.ones((b - n,) + m.shape[1:],
+                                        m.dtype)],
+                            axis=0,
+                        )
+                    elif m.shape[0] != b:
+                        raise ValueError(
+                            f"features_mask covers {m.shape[0]} rows;"
+                            f" expected {n} (valid) or {b} (padded)"
+                        )
+                padded_fms.append(m)
+            fms = padded_fms
+        outs = self.output(*inputs, features_masks=fms)
+        return [o[:n] for o in outs]
+
     def feed_forward(self, *inputs, train: bool = False) -> Dict[str, Any]:
         """Activations of EVERY vertex by name (reference
         ``ComputationGraph.feedForward`` returns the activation map)."""

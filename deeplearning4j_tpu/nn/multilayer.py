@@ -1396,6 +1396,44 @@ class MultiLayerNetwork:
             train,
         )
 
+    def output_padded(self, x, n_valid, features_mask=None):
+        """Inference on a row-padded batch: the serving micro-batcher
+        coalesces requests, pads the stack to a shape bucket, and
+        needs the first ``n_valid`` rows back bitwise identical to a
+        solo ``output`` on those rows. This entry pins that contract:
+
+        - it runs the SAME jitted forward as ``output`` (one compiled
+          executable per bucket shape, shared with direct callers);
+        - padding rows cannot perturb the valid rows because every
+          inference-mode layer is row-independent — BatchNorm applies
+          running stats, dropout is off, masks are per-row — which
+          ``tests/test_batching.py`` enforces bitwise per bucket;
+        - masks compose: a ``features_mask`` covering only the valid
+          rows is extended with all-ones rows for the padding (an
+          all-zero mask row would poison masked reductions with 0/0).
+        """
+        n = int(n_valid)
+        b = int(np.shape(x)[0])
+        if not 0 < n <= b:
+            raise ValueError(
+                f"n_valid must be in [1, {b}] for a {b}-row batch; "
+                f"got {n}"
+            )
+        fm = features_mask
+        if fm is not None:
+            fm = np.asarray(fm)
+            if fm.shape[0] == n and n < b:
+                fm = np.concatenate(
+                    [fm, np.ones((b - n,) + fm.shape[1:], fm.dtype)],
+                    axis=0,
+                )
+            elif fm.shape[0] != b:
+                raise ValueError(
+                    f"features_mask covers {fm.shape[0]} rows; "
+                    f"expected {n} (valid) or {b} (padded)"
+                )
+        return self.output(x, features_mask=fm)[:n]
+
     def feed_forward(self, x, train: bool = False) -> List[jax.Array]:
         """All per-layer activations (reference ``feedForward``)."""
         if self.params is None:

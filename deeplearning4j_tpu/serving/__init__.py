@@ -10,20 +10,41 @@ re-exports from here).
   (``POST /admin/reload`` or checkpoint-watching), ``/readyz``
   readiness split from ``/healthz`` liveness, graceful drain, and a
   ``/metrics`` JSON endpoint;
+- ``batcher.py`` — cross-request micro-batching: ``BucketLadder``
+  (power-of-two compiled-shape buckets) and ``MicroBatcher``
+  (adaptive coalescing: up to ``max_batch_size`` rows or
+  ``batch_timeout_ms``, dispatch-now when nothing else is in
+  flight);
+- ``compile_cache.py`` — eager bucket warmup at start/reload, the
+  ``xla_compiles_total`` counter, and the post-warmup recompile
+  guard;
 - ``envelope.py`` — the shared JSON error envelope
   (``error_envelope``), opaque deterministic error ids, and strict
   Content-Length body reading (``read_request_body``: 411/400/413);
 - ``metrics.py`` — counters + fixed-size latency reservoir
-  quantiles.
+  quantiles, queue-delay reservoir, batch-occupancy histogram.
 """
 
+from deeplearning4j_tpu.serving.batcher import (  # noqa: F401
+    BucketLadder,
+    MicroBatcher,
+    fill_chunks,
+    pad_rows,
+)
+from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
+    CompileCache,
+    ModelShapes,
+    jit_cache_size,
+)
 from deeplearning4j_tpu.serving.envelope import (  # noqa: F401
     HttpBodyError,
+    deadline_envelope,
     error_envelope,
     error_id_for,
     read_request_body,
 )
 from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
+    Histogram,
     Reservoir,
     ServingMetrics,
 )

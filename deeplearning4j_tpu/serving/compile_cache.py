@@ -1,0 +1,112 @@
+"""Compile-cache accounting for the serving hot path.
+
+XLA compiles one executable per input shape. On the request path that
+is a disaster: the first request with a previously-unseen row count
+pays seconds of compilation inside its deadline budget. The bucketed
+micro-batcher (``batcher.py``) makes the shape set small and *known
+in advance*, which makes compilation a **startup** cost instead of a
+request-path cost:
+
+- ``start()`` / ``reload()`` warm every ladder bucket eagerly, so a
+  model version has compiled (and canary-validated) every shape
+  traffic will use *before* it takes traffic — hot reload never pays
+  a compile on the request path;
+- every forward that runs a previously-unseen input shape increments
+  ``xla_compiles_total`` (visible in ``/metrics``), so "zero compiles
+  under steady load" is a falsifiable dashboard assertion;
+- the **recompile guard**: once a version is marked warmed, any new
+  shape is logged and counted in ``post_warmup_compiles_total`` —
+  steady bucketed traffic must keep that counter flat, and a bump
+  points at the exact shape that escaped the ladder.
+
+Shape tracking is model-agnostic (a shape-set per model version), so
+it also covers stub models with no jit underneath; for jax engines
+the jitted forward's real cache size is additionally observable via
+``jit_cache_size`` and asserted flat in tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+NEW = "new"                  # first time this version ran this shape
+WARM = "warm"                # shape already compiled (the steady state)
+POST_WARMUP = "post_warmup"  # new shape AFTER warmup: ladder escape
+
+
+class ModelShapes:
+    """The shape set of one model version. Created by
+    ``CompileCache.register()`` at load time, marked warmed once every
+    ladder bucket has run."""
+
+    __slots__ = ("seen", "warmed", "_lock")
+
+    def __init__(self):
+        self.seen: Set[Tuple[int, ...]] = set()
+        self.warmed = False
+        self._lock = threading.Lock()
+
+    def note(self, shape: Tuple[int, ...]) -> str:
+        with self._lock:
+            if shape in self.seen:
+                return WARM
+            self.seen.add(shape)
+            return POST_WARMUP if self.warmed else NEW
+
+    def mark_warmed(self) -> None:
+        with self._lock:
+            self.warmed = True
+
+
+class CompileCache:
+    """Per-server compile accounting: hands out a ``ModelShapes``
+    record per model version and turns shape observations into the
+    ``xla_compiles_total`` / ``post_warmup_compiles_total`` counters
+    plus the recompile-guard log line."""
+
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+
+    def register(self) -> ModelShapes:
+        return ModelShapes()
+
+    def note(self, shapes: Optional[ModelShapes],
+             shape: Tuple[int, ...]) -> str:
+        if shapes is None:
+            return WARM
+        verdict = shapes.note(tuple(int(d) for d in shape))
+        if verdict == WARM:
+            return verdict
+        if self.metrics is not None:
+            self.metrics.incr("xla_compiles_total")
+        if verdict == POST_WARMUP:
+            if self.metrics is not None:
+                self.metrics.incr("post_warmup_compiles_total")
+            logger.warning(
+                "post-warmup compile: input shape %s was not covered "
+                "by the warmed bucket ladder — this request paid the "
+                "compilation on the serving path", tuple(shape),
+            )
+        return verdict
+
+
+def jit_cache_size(model) -> Optional[int]:
+    """Number of compiled entries behind a model's jitted inference
+    forward, when the engine exposes one (None for stub models or
+    before the first ``output``). Lets tests assert the REAL XLA
+    cache — not just the shape-set proxy — stays flat under steady
+    bucketed load."""
+    fn = getattr(model, "_jit_output", None)
+    if fn is None:
+        return None
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None

@@ -45,6 +45,20 @@ def error_envelope(status: str, code: int, message: Optional[str] = None,
     return {"error": err}
 
 
+def deadline_envelope(deadline,
+                      message: str = "request exceeded its deadline",
+                      ) -> dict:
+    """The one 504 shape every expiry site shares — queued-expired,
+    handler-wait expiry, and drop-before-stacking in the micro-batch
+    drain loop — so clients see identical ``elapsed``/``budget``
+    detail regardless of where in the pipeline the budget ran out."""
+    return error_envelope(
+        "deadline_exceeded", 504, message,
+        elapsed=round(deadline.elapsed(), 4),
+        budget=deadline.budget,
+    )
+
+
 def error_id_for(exc: BaseException) -> str:
     """Opaque, deterministic id for a server-side exception:
     stable across runs for the same fault (chaos replays bit-for-bit)

@@ -11,12 +11,23 @@ bounded memory however long the server runs, quantiles computed on
 demand from a sorted copy (nearest-rank). Recency bias is the point —
 serving dashboards want "how slow is it NOW", not a since-boot
 average.
+
+The micro-batching layer (``batcher.py``) adds two more instruments:
+a **batch-occupancy histogram** (valid rows per dispatch, bucketed on
+the shape ladder — the direct readout of how well coalescing is
+working) plus mean fill ratio, a **queue-delay reservoir** (admission
+to batch-drain pickup — the latency cost requests pay for
+coalescing), and the compile counters ``xla_compiles_total`` /
+``post_warmup_compiles_total`` (``compile_cache.py``) that make
+"zero compiles under steady bucketed load" falsifiable from
+``/metrics`` alone.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 
 class Reservoir:
@@ -56,6 +67,37 @@ class Reservoir:
         }
 
 
+class Histogram:
+    """Fixed-boundary counting histogram: ``record(v)`` counts v into
+    the first boundary >= v (an overflow bin catches the rest).
+    Bounded memory, O(log b) record — the batch-occupancy instrument
+    (boundaries = the shape-bucket ladder)."""
+
+    def __init__(self, boundaries: Sequence[float]):
+        if not boundaries:
+            raise ValueError("histogram needs at least one boundary")
+        self.boundaries = sorted(float(b) for b in boundaries)
+        self._counts = [0] * (len(self.boundaries) + 1)
+        self.count = 0
+        self.total = 0.0
+
+    def record(self, value: float) -> None:
+        self._counts[bisect.bisect_left(self.boundaries, value)] += 1
+        self.count += 1
+        self.total += value
+
+    def snapshot(self) -> dict:
+        buckets = {}
+        for b, c in zip(self.boundaries, self._counts):
+            buckets[f"le_{b:g}"] = c
+        buckets["overflow"] = self._counts[-1]
+        return {
+            "count": self.count,
+            "mean": (self.total / self.count) if self.count else None,
+            "buckets": buckets,
+        }
+
+
 class ServingMetrics:
     """Thread-safe counter set + latency reservoir for one server."""
 
@@ -70,12 +112,25 @@ class ServingMetrics:
         "abandoned_total",       # worker finished after caller's 504
         "reload_total",          # successful hot swaps
         "reload_failure_total",  # failed reload attempts (old kept)
+        # -- micro-batching + compile accounting --------------------
+        "batches_total",           # batched dispatches executed
+        "batched_predictions_total",  # requests answered via a batch
+        "solo_fallback_total",     # requests too wide for the ladder
+        "batch_expired_total",     # dropped (504) before stacking
+        "xla_compiles_total",      # forwards on a never-seen shape
+        "post_warmup_compiles_total",  # ladder escapes (guard)
+        "warmup_predicts_total",   # eager bucket warmup forwards
     )
 
-    def __init__(self, reservoir_size: int = 1024):
+    def __init__(self, reservoir_size: int = 1024,
+                 occupancy_buckets: Optional[Sequence[int]] = None):
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {k: 0 for k in self.COUNTERS}
         self._latency = Reservoir(reservoir_size)
+        self._queue_delay = Reservoir(reservoir_size)
+        self._occupancy = (
+            Histogram(occupancy_buckets) if occupancy_buckets else None
+        )
         self.inflight = 0  # admitted, response not yet written
 
     def incr(self, name: str, n: int = 1) -> None:
@@ -89,6 +144,19 @@ class ServingMetrics:
     def record_latency(self, seconds: float) -> None:
         with self._lock:
             self._latency.record(seconds * 1000.0)
+
+    def record_queue_delay(self, seconds: float) -> None:
+        with self._lock:
+            self._queue_delay.record(seconds * 1000.0)
+
+    def record_batch(self, n_valid: int, bucket: int) -> None:
+        """One batched dispatch: ``n_valid`` real rows padded to
+        ``bucket``. Occupancy is recorded in rows (the histogram's
+        boundaries are the ladder), fill ratio rides in the mean."""
+        with self._lock:
+            self._counters["batches_total"] += 1
+            if self._occupancy is not None:
+                self._occupancy.record(n_valid)
 
     def enter(self) -> None:
         with self._lock:
@@ -114,4 +182,7 @@ class ServingMetrics:
             out = dict(self._counters)
             out["inflight"] = self.inflight
             out["latency_ms"] = self._latency.snapshot()
+            out["queue_delay_ms"] = self._queue_delay.snapshot()
+            if self._occupancy is not None:
+                out["batch_occupancy_rows"] = self._occupancy.snapshot()
             return out

@@ -29,8 +29,19 @@ modes production traffic actually has:
   queue-high-water, and drain;
 - **graceful drain**: ``stop(drain_timeout=)`` stops admitting,
   finishes in-flight work, then closes;
+- **micro-batching**: the workers are batch-drain loops. Queued
+  requests coalesce — up to ``max_batch_size`` rows or
+  ``batch_timeout_ms``, whichever first — into ONE padded forward on
+  a bucketed shape (``batcher.py``), and each request's response is
+  sliced back out and completed individually. Deadline-expired items
+  are dropped (``504``) before stacking; a request wider than the
+  largest bucket falls back to the solo path. Every ladder bucket is
+  compiled eagerly at ``start()``/``reload()`` (``compile_cache.py``)
+  so steady traffic never compiles on the request path, and a
+  recompile guard logs + counts any shape that escapes the ladder;
 - **observability**: ``/metrics`` serves shed/timeout/breaker/reload
-  counters and latency quantiles (``metrics.py``).
+  counters, latency + queue-delay quantiles, batch-occupancy
+  histogram, and compile counters (``metrics.py``).
 
 Error responses all use the shared JSON envelope (``envelope.py``):
 ``400`` malformed payload, ``411`` missing Content-Length, ``413``
@@ -54,8 +65,16 @@ import numpy as np
 
 from deeplearning4j_tpu.resilience.breaker import OPEN, CircuitBreaker
 from deeplearning4j_tpu.resilience.deadline import Deadline
+from deeplearning4j_tpu.serving.batcher import (
+    BucketLadder,
+    MicroBatcher,
+    fill_chunks,
+    pad_rows,
+)
+from deeplearning4j_tpu.serving.compile_cache import CompileCache
 from deeplearning4j_tpu.serving.envelope import (
     HttpBodyError,
+    deadline_envelope,
     error_envelope,
     error_id_for,
     read_request_body,
@@ -82,18 +101,30 @@ def _feature_dim(model) -> Optional[int]:
 class _ModelVersion:
     """One immutable (model, version) pair. Workers snapshot the
     reference at predict start, so an atomic swap never changes the
-    model under an in-flight request."""
+    model under an in-flight request. ``shapes`` is this version's
+    compile-cache record (the set of input shapes it has executed,
+    warmed over the bucket ladder before the version takes traffic)."""
 
-    __slots__ = ("model", "version", "source")
+    __slots__ = ("model", "version", "source", "shapes")
 
-    def __init__(self, model, version: int, source: str):
+    def __init__(self, model, version: int, source: str, shapes=None):
         self.model = model
         self.version = version
         self.source = source
+        self.shapes = shapes
 
 
 class _NoReloadSource(ValueError):
     pass
+
+
+class _ServingHTTPServer(ThreadingHTTPServer):
+    """stdlib default listen backlog is 5: a burst of 30+ concurrent
+    connects gets TCP resets before admission control ever sees the
+    requests. Shedding is the server's job (503 + Retry-After), not
+    the kernel's."""
+
+    request_queue_size = 128
 
 
 class _WorkItem:
@@ -103,7 +134,8 @@ class _WorkItem:
     queue-expiry race (handler cancels vs worker starts)."""
 
     __slots__ = ("features", "deadline", "done", "response", "lock",
-                 "started", "cancelled", "timed_out")
+                 "started", "cancelled", "timed_out", "rows",
+                 "squeeze", "enqueued_at")
 
     def __init__(self, features, deadline: Deadline):
         self.features = features
@@ -114,6 +146,10 @@ class _WorkItem:
         self.started = False
         self.cancelled = False   # handler gave up before worker start
         self.timed_out = False   # handler wrote a 504 already
+        shape = np.shape(features)
+        self.rows = int(shape[0]) if len(shape) >= 2 else 1
+        self.squeeze = len(shape) == 1  # 1-d request: 1-d response
+        self.enqueued_at = time.monotonic()
 
     def finish(self, code: int, body: dict, headers=None) -> bool:
         """Record the worker's result; returns False when the handler
@@ -144,6 +180,18 @@ class ModelServer:
     predict per request; None disables. ``store`` (an ObjectStore,
     typically ``RetryingObjectStore(breaker=...)``) enables reload by
     object key.
+
+    Micro-batching (on by default): queued requests coalesce into one
+    padded forward per shape bucket — up to ``max_batch_size`` rows
+    or ``batch_timeout_ms`` per batch, buckets from ``bucket_ladder``
+    (powers of two up to ``max_batch_size`` when None). The drain
+    pool is ``batch_workers`` threads (default 1: one accelerator is
+    one dispatch stream, and a single continuous-batching drain
+    collects the widest batches — splitting arrivals over k drain
+    threads just shrinks every batch k-fold); ``workers`` keeps its
+    capacity meaning in the k+q admission bound. Pass
+    ``micro_batch=False`` for the PR-2 one-predict-per-request solo
+    loop.
     """
 
     def __init__(self, model_or_path=None, host: str = "127.0.0.1",
@@ -155,7 +203,12 @@ class ModelServer:
                  breaker: Optional[CircuitBreaker] = None,
                  checkpoint_manager=None, store=None, canary=None,
                  queue_high_water: Optional[int] = None,
-                 reservoir_size: int = 1024):
+                 reservoir_size: int = 1024,
+                 micro_batch: bool = True,
+                 max_batch_size: int = 32,
+                 batch_timeout_ms: float = 2.0,
+                 bucket_ladder=None,
+                 batch_workers: int = 1):
         if workers < 1:
             raise ValueError("workers must be >= 1")
         if queue_depth < 0:
@@ -174,12 +227,30 @@ class ModelServer:
             queue_high_water if queue_high_water is not None
             else max(queue_depth, 1)
         )
-        self.metrics = ServingMetrics(reservoir_size)
+        if micro_batch:
+            if batch_workers < 1:
+                raise ValueError("batch_workers must be >= 1")
+            ladder = (
+                bucket_ladder
+                if isinstance(bucket_ladder, BucketLadder)
+                else BucketLadder(bucket_ladder, max_batch_size)
+            )
+            self.batcher = MicroBatcher(ladder, batch_timeout_ms)
+            self.batch_workers = batch_workers
+            occupancy = ladder.buckets
+        else:
+            self.batcher = None
+            self.batch_workers = workers
+            occupancy = None
+        self.metrics = ServingMetrics(reservoir_size, occupancy)
+        self.compile_cache = CompileCache(self.metrics)
 
         self._source_path: Optional[str] = None
         self._watched_step: Optional[int] = None
         model, source = self._initial_model(model_or_path)
-        self._active = _ModelVersion(model, 1, source)
+        self._active = _ModelVersion(
+            model, 1, source, self.compile_cache.register()
+        )
 
         self._model_lock = threading.Lock()
         self._reload_lock = threading.Lock()
@@ -193,7 +264,7 @@ class ModelServer:
         self._watch_thread: Optional[threading.Thread] = None
         self._watch_stop = threading.Event()
 
-        self._httpd = ThreadingHTTPServer(
+        self._httpd = _ServingHTTPServer(
             (host, port), _make_handler(self)
         )
         self.port = self._httpd.server_address[1]
@@ -231,7 +302,20 @@ class ModelServer:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "ModelServer":
-        for i in range(self.workers):
+        # eager warmup BEFORE the pool takes traffic: every ladder
+        # bucket compiles now, so the first requests never pay an XLA
+        # compile inside their deadline budget. Best-effort here — a
+        # faulty model/transform must keep surfacing as per-request
+        # 500 envelopes, not kill start() (at reload() the same
+        # failure DOES fail the reload and keeps the old version)
+        try:
+            self._warm_model(self._active.model, self._active.shapes)
+        except Exception:
+            logger.exception(
+                "bucket warmup failed; serving unwarmed (requests "
+                "will surface the fault per-request)"
+            )
+        for i in range(self.batch_workers):
             t = threading.Thread(
                 target=self._worker_loop, daemon=True,
                 name=f"dl4j-serve-worker-{i}",
@@ -273,13 +357,24 @@ class ModelServer:
     # -- worker pool ----------------------------------------------------
 
     def _worker_loop(self) -> None:
+        carry: Optional[_WorkItem] = None
         while not self._stop_workers:
+            if carry is not None:
+                item, carry = carry, None
+            else:
+                try:
+                    item = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
             try:
-                item = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            try:
-                self._process(item)
+                if self.batcher is None:
+                    self._process(item)
+                else:
+                    items, carry = self.batcher.collect(
+                        self._queue, item,
+                        lambda: self.metrics.inflight,
+                    )
+                    self._process_batch(items)
             except Exception:  # never kill a pool thread
                 logger.exception("serve worker crashed on a request")
                 item.finish(500, error_envelope(
@@ -294,11 +389,8 @@ class ModelServer:
         if item.deadline.expired():
             # expired while queued: report without touching the model
             self.metrics.incr("deadline_timeout_total")
-            item.finish(504, error_envelope(
-                "deadline_exceeded", 504,
-                "deadline expired while queued",
-                elapsed=round(item.deadline.elapsed(), 4),
-                budget=item.deadline.budget,
+            item.finish(504, deadline_envelope(
+                item.deadline, "deadline expired while queued",
             ))
             return
         if not self.breaker.try_acquire():
@@ -314,6 +406,7 @@ class ModelServer:
             feats = item.features
             if self.transform is not None:
                 feats = self.transform(feats)
+            self.compile_cache.note(mv.shapes, np.shape(feats))
             out = mv.model.output(feats)
             out = np.asarray(
                 out[0] if isinstance(out, (list, tuple)) else out
@@ -337,6 +430,182 @@ class ModelServer:
         self.metrics.incr("predictions_total")
         if not item.finish(200, body):
             self.metrics.incr("abandoned_total")
+
+    # -- micro-batch drain path -----------------------------------------
+
+    def _process_batch(self, items: "List[_WorkItem]") -> None:
+        """One coalesced batch: drop the dead, route the oversized to
+        the solo path, transform per request, then pack what remains
+        into bucket-padded chunks and run ONE forward per chunk."""
+        now = time.monotonic()
+        mv = self._active  # one snapshot for the whole batch
+        ready: List[tuple] = []
+        for item in items:
+            with item.lock:
+                if item.cancelled:
+                    continue
+                item.started = True
+            self.metrics.record_queue_delay(now - item.enqueued_at)
+            if item.deadline.expired():
+                # dropped BEFORE stacking: never pads a dead request
+                # into a live batch
+                self.metrics.incr("deadline_timeout_total")
+                self.metrics.incr("batch_expired_total")
+                item.finish(504, deadline_envelope(
+                    item.deadline,
+                    "deadline expired while coalescing",
+                ))
+                continue
+            if item.rows > self.batcher.ladder.max:
+                # wider than the largest bucket: solo path, own compile
+                self.metrics.incr("solo_fallback_total")
+                self._process(item)
+                continue
+            try:
+                feats = item.features
+                if self.transform is not None:
+                    feats = self.transform(feats)
+                feats = np.asarray(feats)
+                if feats.ndim == 1:
+                    feats = feats[None, :]
+            except Exception as e:
+                # a bad transform poisons only ITS request (solo
+                # semantics), never its batchmates
+                self.breaker.record_failure()
+                eid = error_id_for(e)
+                logger.error("transform failed (error_id=%s)", eid,
+                             exc_info=True)
+                self.metrics.incr("server_error_total")
+                item.finish(500, error_envelope(
+                    "model_error", 500,
+                    "prediction failed; see server log",
+                    error_id=eid,
+                ))
+                continue
+            ready.append((item, feats))
+        if not ready:
+            return
+        # group by trailing shape + dtype: only same-width requests can
+        # share a stacked forward (width varies only when the model
+        # declares no n_in for parse_features to enforce)
+        groups: dict = {}
+        for item, feats in ready:
+            key = (feats.shape[1:], feats.dtype.str)
+            groups.setdefault(key, []).append((item, feats))
+        for pairs in groups.values():
+            for chunk in fill_chunks(pairs, self.batcher.ladder.max):
+                self._predict_chunk(mv, chunk)
+
+    def _predict_chunk(self, mv: _ModelVersion, chunk) -> None:
+        """ONE padded forward for a chunk of (item, features) pairs,
+        sliced back out and completed per request."""
+        if not self.breaker.try_acquire():
+            self.metrics.incr("breaker_rejected_total", len(chunk))
+            body = error_envelope(
+                "circuit_open", 503,
+                "model circuit is open; failing fast",
+                retry_after=round(self.breaker.retry_after(), 3),
+            )
+            headers = {"Retry-After": self._retry_after_header()}
+            for item, _ in chunk:
+                item.finish(503, body, headers)
+            return
+        n_valid = sum(int(f.shape[0]) for _, f in chunk)
+        bucket = self.batcher.ladder.bucket_for(n_valid)
+        try:
+            stacked = (
+                chunk[0][1] if len(chunk) == 1
+                else np.concatenate([f for _, f in chunk], axis=0)
+            )
+            padded = pad_rows(stacked, bucket)
+            self.compile_cache.note(mv.shapes, padded.shape)
+            out = self._padded_forward(mv.model, padded, n_valid)
+        except Exception as e:
+            self.breaker.record_failure()
+            eid = error_id_for(e)
+            logger.error("batched predict failed (error_id=%s)", eid,
+                         exc_info=True)
+            self.metrics.incr("server_error_total", len(chunk))
+            body = error_envelope(
+                "model_error", 500,
+                "prediction failed; see server log",
+                error_id=eid,
+            )
+            for item, _ in chunk:
+                item.finish(500, body)
+            return
+        self.breaker.record_success()
+        self.metrics.record_batch(n_valid, bucket)
+        self.metrics.incr("batched_predictions_total", len(chunk))
+        self.metrics.incr("predictions_total", len(chunk))
+        off = 0
+        abandoned = 0
+        for item, feats in chunk:
+            rows = int(feats.shape[0])
+            o = out[off:off + rows]
+            off += rows
+            if item.squeeze:
+                o = o[0]
+            body = {"output": o.tolist(), "model_version": mv.version}
+            if self.output_classes and o.ndim == 2:
+                body["classes"] = o.argmax(axis=1).tolist()
+            if not item.finish(200, body):
+                abandoned += 1
+        if abandoned:
+            self.metrics.incr("abandoned_total", abandoned)
+
+    def _padded_forward(self, model, padded, n_valid: int):
+        """Run the model on a bucket-padded batch and return the valid
+        rows. Engines expose ``output_padded`` (same jitted program as
+        ``output``, masks composed over padding rows); plain models
+        fall back to ``output`` + slice — valid because inference
+        forwards are row-independent (the contract
+        ``tests/test_batching.py`` enforces bitwise)."""
+        fn = getattr(model, "output_padded", None)
+        if fn is not None:
+            out = fn(padded, n_valid=n_valid)
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            return np.asarray(out)
+        out = model.output(padded)
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        return np.asarray(out)[:n_valid]
+
+    def _warm_model(self, model, shapes) -> int:
+        """Eagerly run every ladder bucket through the padded forward
+        so all steady-state executables exist BEFORE the model takes
+        traffic. Returns the number of warmup forwards (0 when
+        batching is off or the input width is unknowable)."""
+        if self.batcher is None:
+            return 0
+        feats = self._canary_features(model)
+        if feats is None:
+            logger.info(
+                "bucket warmup skipped: model declares no input width "
+                "and no canary= was provided"
+            )
+            return 0
+        if self.transform is not None:
+            feats = self.transform(feats)
+        feats = np.asarray(feats, np.float32)
+        if feats.ndim == 1:
+            feats = feats[None, :]
+        n = 0
+        for b in self.batcher.ladder.buckets:
+            padded = pad_rows(feats[:b], b)
+            self.compile_cache.note(shapes, padded.shape)
+            self._padded_forward(model, padded, padded.shape[0])
+            self.metrics.incr("warmup_predicts_total")
+            n += 1
+        shapes.mark_warmed()
+        return n
+
+    def _canary_features(self, model):
+        if self.canary is not None:
+            return np.asarray(self.canary, np.float32)
+        n_in = _feature_dim(model)
+        if n_in is None:
+            return None
+        return np.zeros((1, n_in), np.float32)
 
     def _retry_after_header(self) -> str:
         return str(max(1, int(round(self.retry_after))))
@@ -391,12 +660,7 @@ class ModelServer:
                     if not item.started:
                         item.cancelled = True
                 self.metrics.incr("deadline_timeout_total")
-                return 504, error_envelope(
-                    "deadline_exceeded", 504,
-                    "request exceeded its deadline",
-                    elapsed=round(item.deadline.elapsed(), 4),
-                    budget=item.deadline.budget,
-                ), {}
+                return 504, deadline_envelope(item.deadline), {}
             return item.response
         finally:
             self.metrics.exit()
@@ -417,6 +681,11 @@ class ModelServer:
             try:
                 model, source = self._load_for_reload(spec or {})
                 self._canary_check(model)
+                # warm every bucket on the ADMIN thread before the
+                # swap: the new version has compiled all its shapes
+                # before it sees its first request
+                shapes = self.compile_cache.register()
+                self._warm_model(model, shapes)
             except _NoReloadSource as e:
                 return 400, error_envelope("no_reload_source", 400,
                                            str(e))
@@ -432,7 +701,8 @@ class ModelServer:
                 )
             with self._model_lock:
                 version = self._active.version + 1
-                self._active = _ModelVersion(model, version, source)
+                self._active = _ModelVersion(model, version, source,
+                                             shapes)
             self.metrics.incr("reload_total")
             return 200, {"status": "reloaded", "version": version,
                          "model": type(model).__name__,
@@ -480,19 +750,32 @@ class ModelServer:
     def _canary_check(self, model) -> None:
         """One predict on the candidate BEFORE it takes traffic — a
         restorable-but-broken checkpoint must fail the reload, not the
-        next thousand user requests."""
-        feats = self.canary
+        next thousand user requests. With micro-batching on, the
+        canary runs through the SAME bucketed padded path traffic
+        uses (padded to the smallest bucket that fits), so a canary
+        pass proves the shapes production requests will execute, not
+        just a bespoke 1-row program."""
+        feats = self._canary_features(model)
         if feats is None:
-            n_in = _feature_dim(model)
-            if n_in is None:
-                return  # shape unknown and no canary provided: skip
-            feats = np.zeros((1, n_in), np.float32)
-        feats = np.asarray(feats, np.float32)
+            return  # shape unknown and no canary provided: skip
         if self.transform is not None:
             feats = self.transform(feats)
-        out = model.output(feats)
-        out = np.asarray(out[0] if isinstance(out, (list, tuple))
-                         else out)
+        feats = np.asarray(feats, np.float32)
+        if self.batcher is not None:
+            if feats.ndim == 1:
+                feats = feats[None, :]
+            rows = int(feats.shape[0])
+            bucket = self.batcher.ladder.bucket_for(rows)
+            if bucket is not None:
+                out = self._padded_forward(
+                    model, pad_rows(feats, bucket), rows
+                )
+            else:
+                out = self._padded_forward(model, feats, rows)
+        else:
+            out = model.output(feats)
+            out = np.asarray(out[0] if isinstance(out, (list, tuple))
+                             else out)
         if not np.all(np.isfinite(out)):
             raise ValueError("canary predict produced non-finite output")
 
@@ -572,6 +855,17 @@ class ModelServer:
         out["breaker"] = self.breaker.snapshot()
         out["model_version"] = self._active.version
         out["draining"] = self._draining
+        if self.batcher is not None:
+            out["batching"] = {
+                "enabled": True,
+                "max_batch_size": self.batcher.ladder.max,
+                "batch_timeout_ms": self.batcher.batch_timeout_ms,
+                "buckets": list(self.batcher.ladder.buckets),
+                "batch_workers": self.batch_workers,
+                "warmed": bool(self._active.shapes.warmed),
+            }
+        else:
+            out["batching"] = {"enabled": False}
         return out
 
     # -- request validation ---------------------------------------------

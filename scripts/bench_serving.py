@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Serving micro-batch smoke benchmark (CPU, seeded, few seconds).
+
+Drives ``ModelServer.submit()`` directly — the serving hot path
+(admission -> queue -> drain -> predict -> response) minus socket
+I/O, so the number isolates what micro-batching changes rather than
+stdlib HTTP overhead — with a seeded synthetic closed-loop load at
+fixed concurrency, once in solo mode (``micro_batch=False``, the
+PR-2 one-predict-per-request loop) and once micro-batched. Prints
+ONE JSON line::
+
+    {"concurrency": 32,
+     "solo":    {"req_per_s": ..., "p50_ms": ..., "p99_ms": ...,
+                 "p50_ms_c1": ...},
+     "batched": {"req_per_s": ..., "p50_ms": ..., "p99_ms": ...,
+                 "p50_ms_c1": ..., "mean_batch_rows": ...,
+                 "batches_total": ..., "xla_compiles_total": ...,
+                 "post_warmup_compiles_total": ...},
+     "speedup": ...}
+
+The acceptance gates this makes falsifiable on CPU:
+
+- ``speedup`` >= 4: one wide XLA dispatch per coalesced batch beats
+  per-request dispatch at concurrency 32;
+- ``post_warmup_compiles_total`` == 0: steady bucketed load compiles
+  nothing after the eager warmup;
+- ``p50_ms_c1`` (batched) is no worse than solo at concurrency 1:
+  the adaptive batcher dispatches immediately when nothing else is
+  in flight.
+
+Runnable standalone (``python scripts/bench_serving.py``) or
+imported by ``bench.py``'s serving section.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _make_net(seed=0, n_in=64, hidden=1024, n_out=8):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.1)
+        .list()
+        .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="tanh"))
+        .layer(DenseLayer(n_in=hidden, n_out=hidden,
+                          activation="tanh"))
+        .layer(OutputLayer(n_out=n_out))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _drive(server, feats_pool, concurrency, per_thread):
+    """Closed-loop load: each of ``concurrency`` threads submits
+    ``per_thread`` requests back to back. Returns (req/s, p50 ms,
+    p99 ms) over the whole run."""
+    lat_per_thread = [[] for _ in range(concurrency)]
+    errors = []
+
+    def worker(tid):
+        lats = lat_per_thread[tid]
+        n = len(feats_pool)
+        for i in range(per_thread):
+            f = feats_pool[(tid * per_thread + i) % n]
+            t0 = time.perf_counter()
+            code, _, _ = server.submit(f)
+            lats.append(time.perf_counter() - t0)
+            if code != 200:
+                errors.append(code)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError(
+            f"{len(errors)} non-200 responses (first: {errors[0]})"
+        )
+    lats = sorted(v for lst in lat_per_thread for v in lst)
+    total = concurrency * per_thread
+
+    def pct(q):
+        return lats[min(len(lats) - 1, int(q * len(lats)))] * 1000.0
+
+    return total / wall, pct(0.50), pct(0.99)
+
+
+def run(concurrency=32, per_thread=40, seed=0,
+        max_batch_size=64, batch_timeout_ms=8.0, windows=3) -> dict:
+    from deeplearning4j_tpu.serving import ModelServer
+
+    net = _make_net(seed=seed)
+    rng = np.random.RandomState(seed)
+    feats_pool = [rng.rand(1, 64).astype(np.float32)
+                  for _ in range(256)]
+    out = {"concurrency": concurrency,
+           "requests_per_window": concurrency * per_thread,
+           "windows": windows}
+
+    kw = dict(workers=4, queue_depth=max(concurrency * 2, 64))
+    solo = ModelServer(net, micro_batch=False, **kw).start()
+    batched = ModelServer(
+        net, max_batch_size=max_batch_size,
+        batch_timeout_ms=batch_timeout_ms, **kw,
+    ).start()
+    best = {solo: None, batched: None}
+    try:
+        for s in (solo, batched):
+            _drive(s, feats_pool, concurrency, 5)  # warm the loop
+        # INTERLEAVED same-length windows, best per mode: host noise
+        # (scheduler, frequency) drifts over seconds and only ever
+        # SLOWS a run (the bench.py estimator), so alternating the
+        # modes samples the same conditions for both and the max of
+        # N honest end-to-end windows estimates each mode's
+        # unimpeded rate
+        for _ in range(windows):
+            for s in (solo, batched):
+                r, p50, p99 = _drive(s, feats_pool, concurrency,
+                                     per_thread)
+                if best[s] is None or r > best[s][0]:
+                    best[s] = (r, p50, p99)
+        # concurrency-1 latency: the adaptive batcher must not tax
+        # the unloaded path
+        c1 = {s: _drive(s, feats_pool, 1, 100)[1]
+              for s in (solo, batched)}
+        snap = batched.metrics_snapshot()
+    finally:
+        solo.stop(drain_timeout=2)
+        batched.stop(drain_timeout=2)
+    for name, s in (("solo", solo), ("batched", batched)):
+        r, p50, p99 = best[s]
+        out[name] = {"req_per_s": round(r, 1),
+                     "p50_ms": round(p50, 3),
+                     "p99_ms": round(p99, 3),
+                     "p50_ms_c1": round(c1[s], 3)}
+    occ = snap.get("batch_occupancy_rows") or {}
+    out["batched"].update({
+        "batches_total": snap["batches_total"],
+        "mean_batch_rows": round(occ.get("mean") or 0.0, 2),
+        "xla_compiles_total": snap["xla_compiles_total"],
+        "post_warmup_compiles_total":
+            snap["post_warmup_compiles_total"],
+    })
+    out["speedup"] = round(
+        out["batched"]["req_per_s"] / out["solo"]["req_per_s"], 2
+    )
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--per-thread", type=int, default=40,
+                    help="requests per thread per measured window")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-batch-size", type=int, default=64)
+    ap.add_argument("--batch-timeout-ms", type=float, default=8.0)
+    ap.add_argument("--windows", type=int, default=3,
+                    help="same-length windows per mode (max wins)")
+    args = ap.parse_args()
+    print(json.dumps(run(
+        concurrency=args.concurrency, per_thread=args.per_thread,
+        seed=args.seed, max_batch_size=args.max_batch_size,
+        batch_timeout_ms=args.batch_timeout_ms,
+        windows=args.windows,
+    )))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,11 @@ echo "chaos seed: ${DL4J_TPU_CHAOS_SEED}"
 # Registered chaos suites:
 #   tests/test_resilience.py — training runtime (retry/checkpoint/guard)
 #   tests/test_serving.py    — serving tier (breaker + fault storms)
+#   tests/test_batching.py   — micro-batch drain loop (seeded storms
+#                              through the batched path: sequential
+#                              determinism + concurrent chunk faults)
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest tests/test_resilience.py tests/test_serving.py \
+    tests/test_batching.py \
     -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"
