@@ -1039,6 +1039,153 @@ def bench_serving(budget_s=None) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_observability(iters=300, windows=5) -> dict:
+    """Overhead of the observability substrate on the two hot paths.
+
+    Predict: the serving hot path's per-request instrumentation
+    (admission counter, latency reservoir, span start/end) around a
+    small-net ``output``, measured three ways — uninstrumented
+    baseline, instrumented with ENABLED registry+tracer, instrumented
+    with everything in no-op mode (disabled registry / disabled
+    tracer). Train: ``fit_minibatch`` with and without a
+    ``TelemetryListener`` (which also flips the engine's in-jit
+    grad-norm output — that compiled-in cost is part of what's being
+    measured). The acceptance gate is the no-op overheads <= 5%
+    (within noise); enabled-mode numbers are reported alongside.
+    """
+    import jax
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+    from deeplearning4j_tpu.observability.runtime import (
+        TelemetryListener,
+    )
+    from deeplearning4j_tpu.observability.trace import Tracer
+    from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+    def build_net():
+        conf = (
+            NeuralNetConfiguration.Builder().seed(7).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=64, n_out=64, activation="tanh"))
+            .layer(OutputLayer(n_out=10))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(8, 64).astype(np.float32)
+    y = np.eye(10)[rng.randint(0, 10, 8)].astype(np.float32)
+
+    # -- predict path ---------------------------------------------------
+    net = build_net()
+    jax.block_until_ready(net.output(x))  # compile outside the window
+
+    def predict_window(metrics, tracer):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            if metrics is not None:
+                metrics.try_enter(1 << 30)
+                span = tracer.start_span("serving.request")
+                s0 = time.monotonic()
+            out = net.output(x)
+            if metrics is not None:
+                metrics.record_latency(time.monotonic() - s0)
+                metrics.incr("predictions_total")
+                span.end()
+                metrics.exit()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e6  # us/predict
+
+    # interleave the three modes per window (baseline, enabled,
+    # no-op) so slow drift (thermal, background load) hits all three
+    # equally instead of whichever mode ran last; best-of per mode
+    predict_modes = {
+        "baseline": (None, None),
+        "enabled": (ServingMetrics(), Tracer(seed=7)),
+        "noop": (
+            ServingMetrics(registry=MetricsRegistry(enabled=False)),
+            Tracer(enabled=False),
+        ),
+    }
+    mode_keys = list(predict_modes)
+    predict_us = {k: float("inf") for k in predict_modes}
+    for w in range(windows):
+        for key in mode_keys[w % 3:] + mode_keys[:w % 3]:  # rotate
+            metrics, tracer = predict_modes[key]
+            predict_us[key] = min(
+                predict_us[key], predict_window(metrics, tracer)
+            )
+
+    # -- train path -----------------------------------------------------
+    ds = DataSet(features=x, labels=y)
+
+    def make_train_net(listener):
+        net_t = build_net()
+        if listener is not None:
+            net_t.listeners.append(listener)
+        # two warmups: the FIRST iteration_done flips the engine's
+        # telemetry step mode, so the telemetry-variant jit compiles
+        # on the SECOND call — both stay outside the timed windows
+        net_t.fit_minibatch(ds)
+        net_t.fit_minibatch(ds)
+        return net_t
+
+    def train_window(net_t):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            score = net_t.fit_minibatch(ds)
+        float(score)  # sync
+        return (time.perf_counter() - t0) / iters * 1e6  # us/step
+
+    train_nets = {
+        "baseline": make_train_net(None),
+        "enabled": make_train_net(TelemetryListener(
+            registry=MetricsRegistry(), frequency=iters,
+            publish_memory=False,
+        )),
+        "noop": make_train_net(TelemetryListener(
+            registry=MetricsRegistry(enabled=False),
+            frequency=iters, publish_memory=False,
+        )),
+    }
+    train_keys = list(train_nets)
+    train_us = {k: float("inf") for k in train_nets}
+    for w in range(windows):
+        for key in train_keys[w % 3:] + train_keys[:w % 3]:  # rotate
+            train_us[key] = min(
+                train_us[key], train_window(train_nets[key])
+            )
+
+    def overhead(instrumented, baseline):
+        return round(instrumented / baseline - 1.0, 4)
+
+    return {
+        "predict": {
+            "baseline_us": round(predict_us["baseline"], 2),
+            "enabled_us": round(predict_us["enabled"], 2),
+            "noop_us": round(predict_us["noop"], 2),
+            "enabled_overhead": overhead(
+                predict_us["enabled"], predict_us["baseline"]),
+            "noop_overhead": overhead(
+                predict_us["noop"], predict_us["baseline"]),
+        },
+        "train": {
+            "baseline_us": round(train_us["baseline"], 2),
+            "enabled_us": round(train_us["enabled"], 2),
+            "noop_us": round(train_us["noop"], 2),
+            "enabled_overhead": overhead(
+                train_us["enabled"], train_us["baseline"]),
+            "noop_overhead": overhead(
+                train_us["noop"], train_us["baseline"]),
+        },
+        "gate": "noop_overhead <= 0.05 on both paths (within noise)",
+    }
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -1147,6 +1294,9 @@ def main() -> None:
          lambda: bench_serving(remaining()),
          "batched-vs-solo serving req/s at concurrency 32 "
          "(scripts/bench_serving.py; speedup >= 4 is the gate)"),
+        ("observability_overhead", bench_observability,
+         "instrumented vs uninstrumented predict/train hot paths "
+         "(no-op registry/tracer must be <= 5% overhead)"),
     ]
     try:
         for key, fn, unit in sections:

@@ -58,7 +58,7 @@ from deeplearning4j_tpu.resilience import (  # noqa: F401
 
 # Lazy-import table: serving pulls in the HTTP tier, which training
 # jobs never need — resolve on first attribute access instead of at
-# package import.
+# package import. The observability substrate rides the same table.
 _LAZY_IMPORTS = {
     "ModelServer": "deeplearning4j_tpu.serving.server",
     "ServingMetrics": "deeplearning4j_tpu.serving.metrics",
@@ -66,6 +66,13 @@ _LAZY_IMPORTS = {
     "BucketLadder": "deeplearning4j_tpu.serving.batcher",
     "MicroBatcher": "deeplearning4j_tpu.serving.batcher",
     "CompileCache": "deeplearning4j_tpu.serving.compile_cache",
+    "MetricsRegistry": "deeplearning4j_tpu.observability",
+    "Tracer": "deeplearning4j_tpu.observability",
+    "JsonlSink": "deeplearning4j_tpu.observability",
+    "TelemetryListener": "deeplearning4j_tpu.observability",
+    "prometheus_text": "deeplearning4j_tpu.observability",
+    "set_global_tracer": "deeplearning4j_tpu.observability",
+    "get_tracer": "deeplearning4j_tpu.observability",
 }
 
 

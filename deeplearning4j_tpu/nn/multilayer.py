@@ -319,6 +319,12 @@ class MultiLayerNetwork:
         # host applies skip/rollback policy; forces the per-step path
         # (the fused scan cannot consult the guard mid-dispatch)
         self.divergence_guard = None
+        # observability.TelemetryListener (enable_step_telemetry):
+        # when set, the jitted step also returns the gradient global
+        # L2 norm — one fused scalar, read lazily by the listener
+        self._telemetry_grad_norm = False
+        self._last_grad_norm = None  # 0-d device array; float() syncs
+        self._last_batch_rows = None  # host int; examples/sec signal
 
     @property
     def score_value(self) -> float:
@@ -467,6 +473,7 @@ class MultiLayerNetwork:
 
         step_dtype = _dtype_of(self.conf)
         guarded = self.divergence_guard is not None
+        telemetry = self._telemetry_grad_norm
 
         def step(params, upd_state, state, x, labels, mask, fmask, lrs, t,
                  rng):
@@ -485,8 +492,15 @@ class MultiLayerNetwork:
             new_params, new_upd = updater.update(
                 grads, upd_state, params, lrs, t
             )
+            extras = ()
+            if telemetry:
+                from deeplearning4j_tpu.resilience.guard import (
+                    grad_global_norm_sq,
+                )
+
+                extras = (jnp.sqrt(grad_global_norm_sq(grads)),)
             if not guarded:
-                return new_params, new_upd, new_state, score
+                return (new_params, new_upd, new_state, score) + extras
             from deeplearning4j_tpu.resilience.guard import (
                 divergence_ok, select_updates,
             )
@@ -496,7 +510,7 @@ class MultiLayerNetwork:
                 ok, new_params, params, new_upd, upd_state,
                 new_state, state,
             )
-            return new_params, new_upd, new_state, score, ok
+            return (new_params, new_upd, new_state, score) + extras + (ok,)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -507,6 +521,29 @@ class MultiLayerNetwork:
         ok flag."""
         self.divergence_guard = guard
         self._jit_step = None
+
+    def enable_step_telemetry(self, enabled: bool = True) -> None:
+        """(Un)install step telemetry: the jitted per-step program
+        additionally returns the gradient global L2 norm (one fused
+        scalar — no second backward pass, no extra sync until
+        something reads ``_last_grad_norm``). Rebuilds the step on
+        change; observability.TelemetryListener flips this on."""
+        if enabled != self._telemetry_grad_norm:
+            self._telemetry_grad_norm = enabled
+            self._jit_step = None
+
+    def _apply_step_out(self, out):
+        """Unpack one jitted-step output tuple (base 4 fields, plus
+        the optional telemetry grad-norm, plus the optional guard ok
+        flag) into model state; returns ``(score, ok)``."""
+        self.params, self.updater_state, self.state = out[:3]
+        score = out[3]
+        i = 4
+        if self._telemetry_grad_norm:
+            self._last_grad_norm = out[i]
+            i += 1
+        ok = out[i] if self.divergence_guard is not None else None
+        return score, ok
 
     def _build_multi_step(self) -> Callable:
         """k optimizer steps fused into ONE XLA program via lax.scan.
@@ -1148,8 +1185,13 @@ class MultiLayerNetwork:
             fmask = jnp.asarray(fmask, dtype)
         if self._wants_last_features():
             self._last_features = ds.features  # activation listeners
+        self._last_batch_rows = int(x.shape[0])  # examples/sec signal
         score = None
         for _ in range(self.conf.iterations):
+            if self._jit_step is None:
+                # a listener may flip telemetry/guard mid-fit (the
+                # setters clear the step); rebuild before dispatch
+                self._jit_step = self._build_step()
             lrs = self.updater_def.scheduled_lrs(self.iteration_count)
             t = jnp.asarray(self.iteration_count + 1, jnp.float32)
             rng = jax.random.fold_in(self._base_key, self.iteration_count)
@@ -1160,12 +1202,7 @@ class MultiLayerNetwork:
                 t, rng,
             )
             guard = self.divergence_guard
-            if guard is not None:
-                (
-                    self.params, self.updater_state, self.state, score, ok,
-                ) = out
-            else:
-                self.params, self.updater_state, self.state, score = out
+            score, ok = self._apply_step_out(out)
             self.iteration_count += 1
             self._last_score = score  # device array; sync deferred
             if guard is not None:
@@ -1228,6 +1265,7 @@ class MultiLayerNetwork:
             fs = jnp.asarray(fs, dtype)
         if self._jit_step is None:
             self._jit_step = self._build_step()
+        self._last_batch_rows = int(xs.shape[0])  # examples/sec signal
         lrs = self.updater_def.scheduled_lrs(self.iteration_count)
         t = jnp.asarray(self.iteration_count + 1, jnp.float32)
         rng = jax.random.fold_in(self._base_key, self.iteration_count)
@@ -1237,12 +1275,7 @@ class MultiLayerNetwork:
             t, rng,
         )
         guard = self.divergence_guard
-        if guard is not None:
-            (
-                self.params, self.updater_state, self.state, score, ok,
-            ) = out
-        else:
-            self.params, self.updater_state, self.state, score = out
+        score, ok = self._apply_step_out(out)
         self.iteration_count += 1
         self._last_score = score  # device array; sync deferred
         if guard is not None:

@@ -1,0 +1,49 @@
+"""Unified observability subsystem: metrics registry, structured
+tracing, exporters, and JAX runtime telemetry.
+
+One substrate for every signal the runtime emits (the serving tier,
+the training listeners, the resilience primitives, and the XLA
+compile accounting all publish here):
+
+- ``metrics.py`` — thread-safe ``MetricsRegistry`` with labeled
+  ``Counter``/``Gauge``/``Histogram``/``Summary`` families, a no-op
+  mode for overhead-free disablement, and the canonical
+  ``Reservoir``/``Histogram`` primitives (re-exported by
+  ``serving/metrics.py`` for back-compat);
+- ``trace.py`` — ``Tracer``/``Span`` with deterministic seeded ids,
+  explicit cross-thread context handoff, a bounded ``JsonlSink``,
+  and a process-global tracer for low-level primitives;
+- ``export.py`` — Prometheus text exposition
+  (``/metrics?format=prometheus`` on the serving and UI servers) and
+  JSON snapshots;
+- ``runtime.py`` — JAX device memory gauges and the
+  ``TelemetryListener`` publishing step time / loss / grad
+  global-norm / examples-per-sec from both engines' fit loops.
+"""
+
+from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
+    Histogram,
+    MetricsRegistry,
+    NULL_REGISTRY,
+    Reservoir,
+    array_histograms,
+    default_registry,
+    mean_magnitudes,
+)
+from deeplearning4j_tpu.observability.trace import (  # noqa: F401
+    JsonlSink,
+    Span,
+    SpanContext,
+    Tracer,
+    get_tracer,
+    set_global_tracer,
+)
+from deeplearning4j_tpu.observability.export import (  # noqa: F401
+    prometheus_text,
+    registry_snapshot,
+)
+from deeplearning4j_tpu.observability.runtime import (  # noqa: F401
+    TelemetryListener,
+    device_memory_stats,
+    publish_device_memory,
+)

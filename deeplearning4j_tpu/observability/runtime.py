@@ -1,0 +1,187 @@
+"""JAX runtime telemetry: device memory, compile events, train-step
+signals.
+
+The TensorFlow system paper (PAPERS.md) credits built-in monitoring
+of step time, queue depth, and compilation events for much of its
+operability — on a TPU stack those are exactly the signals that
+explain why a step or request was slow (XLA recompile? queue wait?
+device sync?). The serving side already counts compiles
+(``serving/compile_cache.py`` feeds ``xla_compiles_total`` and, with
+a tracer attached, per-shape ``xla.compile`` events); this module
+adds the training side:
+
+- ``device_memory_stats()`` / ``publish_device_memory()``: per-device
+  HBM usage via ``jax.local_devices()[i].memory_stats()`` when the
+  backend exposes it (TPU does; CPU usually returns nothing — the
+  gauges simply stay absent).
+- ``TelemetryListener``: an ``IterationListener`` publishing step
+  time, loss, gradient global-norm, and examples/sec into a
+  ``MetricsRegistry`` from BOTH engines' fit loops —
+  ``MultiLayerNetwork`` and ``DistributedTrainer`` invoke the same
+  listener SPI. Grad global-norm is computed *in-jit* (the engines'
+  step telemetry mode adds one fused scalar output; see
+  ``enable_step_telemetry``), not by a second host-side pass.
+
+TPU note (same design as ``StatsListener``): reading loss or grad
+norm forces a device sync, so those reads are gated by ``frequency``;
+the step-time/throughput instruments are pure host clock reads and
+run every iteration.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.observability.metrics import (
+    MetricsRegistry,
+    default_registry,
+)
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+def device_memory_stats() -> dict:
+    """``{device_index: memory_stats dict}`` for every local device
+    that reports one (``memory_stats()`` is backend-optional)."""
+    import jax
+
+    out = {}
+    for i, d in enumerate(jax.local_devices()):
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[str(i)] = dict(stats)
+    return out
+
+
+def publish_device_memory(
+    registry: Optional[MetricsRegistry] = None,
+) -> dict:
+    """Publish per-device HBM gauges into ``registry`` (default: the
+    process-wide registry). Returns the raw stats so callers can log
+    them too. A backend with no ``memory_stats()`` publishes
+    nothing."""
+    reg = registry if registry is not None else default_registry()
+    stats = device_memory_stats()
+    if not stats:
+        return stats
+    in_use = reg.gauge(
+        "jax_device_memory_bytes_in_use",
+        help="per-device HBM bytes currently allocated",
+        labels=("device",),
+    )
+    peak = reg.gauge(
+        "jax_device_memory_peak_bytes",
+        help="per-device peak HBM bytes since process start",
+        labels=("device",),
+    )
+    for dev, ms in stats.items():
+        if "bytes_in_use" in ms:
+            in_use.labels(device=dev).set(float(ms["bytes_in_use"]))
+        if "peak_bytes_in_use" in ms:
+            peak.labels(device=dev).set(float(ms["peak_bytes_in_use"]))
+    return stats
+
+
+class TelemetryListener(IterationListener):
+    """Publish train-step telemetry into a ``MetricsRegistry``.
+
+    Signals (catalogued in ARCHITECTURE.md):
+
+    - ``training_steps_total`` / ``training_examples_total`` counters
+      (every iteration; host-only, no device sync);
+    - ``training_step_ms`` summary + ``training_examples_per_sec``
+      gauge (host wall clock between callbacks);
+    - ``training_loss`` gauge (device sync — gated by ``frequency``);
+    - ``training_grad_global_norm`` gauge: the in-jit fused scalar
+      the engines' telemetry step emits. The listener flips the
+      model's ``enable_step_telemetry()`` on first callback; engines
+      without the hook (or before the first telemetry step) simply
+      don't publish the gauge;
+    - per-device HBM gauges via ``publish_device_memory`` when
+      ``publish_memory=True`` and the backend reports memory stats.
+
+    Forces the per-step fit path (like ``ProfilerListener``): under
+    the fused ``lax.scan`` path all callbacks fire after one chunk
+    dispatch, so per-step timing would be fiction.
+    """
+
+    supports_batched_iterations = False
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 frequency: int = 1, grad_norm: bool = True,
+                 publish_memory: bool = True):
+        self.registry = (
+            registry if registry is not None else default_registry()
+        )
+        self.frequency = max(int(frequency), 1)
+        self.grad_norm = grad_norm
+        self.publish_memory = publish_memory
+        reg = self.registry
+        # resolved unlabeled instruments (not family proxies): one
+        # attribute hop per update on the per-step hot path
+        self._steps = reg.counter(
+            "training_steps_total", help="optimizer steps completed"
+        )._default()
+        self._examples = reg.counter(
+            "training_examples_total",
+            help="training examples consumed",
+        )._default()
+        self._loss = reg.gauge(
+            "training_loss", help="latest minibatch loss (sampled)"
+        )._default()
+        self._grad_norm = reg.gauge(
+            "training_grad_global_norm",
+            help="gradient global L2 norm of the sampled step",
+        )._default()
+        self._eps = reg.gauge(
+            "training_examples_per_sec",
+            help="host-clocked examples/sec over the last step",
+        )._default()
+        self._step_ms = reg.summary(
+            "training_step_ms",
+            help="host wall-clock per optimizer step (ms)",
+        )._default()
+        self._last_time: Optional[float] = None
+        self._enabled_on = None
+
+    def iteration_done(self, model, iteration: int) -> None:
+        now = time.perf_counter()
+        if (self.grad_norm and self.registry.enabled
+                and self._enabled_on is not model):
+            # don't compile the in-jit grad-norm output when the
+            # registry is a no-op — nobody would read the signal
+            enable = getattr(model, "enable_step_telemetry", None)
+            if enable is not None:
+                enable(True)
+            self._enabled_on = model
+        if not self.registry.enabled:  # no-op mode: one branch out
+            self._last_time = now
+            return
+        rows = getattr(model, "_last_batch_rows", None)
+        self._steps.inc()
+        if rows:
+            self._examples.inc(int(rows))
+        if self._last_time is not None:
+            dt = now - self._last_time
+            self._step_ms.observe(dt * 1000.0)
+            if rows and dt > 0:
+                self._eps.set(int(rows) / dt)
+        self._last_time = now
+        if iteration % self.frequency != 0:
+            return
+        # below the line: device syncs, gated by frequency
+        try:
+            self._loss.set(float(model.score_value))
+        except Exception:
+            pass
+        gn = getattr(model, "_last_grad_norm", None)
+        if gn is not None:
+            try:
+                self._grad_norm.set(float(gn))
+            except Exception:
+                pass
+        if self.publish_memory:
+            publish_device_memory(self.registry)

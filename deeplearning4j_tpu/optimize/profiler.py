@@ -6,6 +6,7 @@ profiler so traces open in TensorBoard/XProf/Perfetto).
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Optional
 
@@ -30,6 +31,21 @@ class ProfilerListener(IterationListener):
 
     def __init__(self, log_dir: str, start_iteration: int = 5,
                  num_iterations: int = 5):
+        # fail fast: an unwritable trace directory must error HERE,
+        # not after the run has trained start_iteration steps and the
+        # profiler tries its first write
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+        except OSError as e:
+            raise ValueError(
+                f"ProfilerListener log_dir {log_dir!r} cannot be "
+                f"created: {e}"
+            ) from e
+        if not os.access(log_dir, os.W_OK):
+            raise ValueError(
+                f"ProfilerListener log_dir {log_dir!r} is not "
+                "writable"
+            )
         self.log_dir = log_dir
         self.start_iteration = int(start_iteration)
         self.stop_iteration = int(start_iteration) + int(num_iterations)
@@ -49,6 +65,17 @@ class ProfilerListener(IterationListener):
         jax.profiler.stop_trace()
         self._active = False
         self.trace_dir = self.log_dir
+        # surface the trace location in the event log (and the span
+        # sink, when a global tracer is installed) instead of only
+        # returning it to whoever remembers to read .trace_dir
+        from deeplearning4j_tpu.observability.trace import get_tracer
+
+        get_tracer().event("profiler.trace_ready", attrs={
+            "trace_dir": self.trace_dir,
+        })
+        logging.getLogger(__name__).info(
+            "profiler trace written to %s", self.trace_dir
+        )
 
     def iteration_done(self, model, iteration: int) -> None:
         if not self._active and (

@@ -151,6 +151,23 @@ class DistributedTrainer:
         self._place_params()
         self._jit_step_sm = None
         self._jit_step_gspmd = None
+        # step-telemetry flag the jitted steps were built against
+        # (lives on the MODEL so the same TelemetryListener hook
+        # covers both engines); a change rebuilds the steps
+        self._built_telemetry = self._telemetry_enabled()
+
+    def _telemetry_enabled(self) -> bool:
+        return bool(getattr(self.model, "_telemetry_grad_norm", False))
+
+    def enable_step_telemetry(self, enabled: bool = True) -> None:
+        """(Un)install step telemetry on the distributed steps: like
+        ``MultiLayerNetwork.enable_step_telemetry``, the jitted step
+        additionally returns the gradient global L2 norm (computed
+        post-pmean, so it is the GLOBAL gradient's norm — identical
+        on every replica). Works for either engine under the trainer;
+        the flag is stored on the model so
+        ``observability.TelemetryListener`` finds it there."""
+        self.model._telemetry_grad_norm = enabled
 
     def _layer_confs(self):
         conf = self.model.conf
@@ -249,6 +266,12 @@ class DistributedTrainer:
     def _step_for(self, has_masks: bool):
         """Lazily-built step per flavor; the choice is per-minibatch
         (``auto`` must see whether THIS batch carries masks)."""
+        if self._telemetry_enabled() != self._built_telemetry:
+            # telemetry flipped since the steps were built (e.g. a
+            # TelemetryListener attached mid-run): rebuild both
+            self._built_telemetry = self._telemetry_enabled()
+            self._jit_step_sm = None
+            self._jit_step_gspmd = None
         if self._pick_shard_map(has_masks):
             if self._jit_step_sm is None:
                 self._jit_step_sm = self._build_shard_map_step()
@@ -276,12 +299,13 @@ class DistributedTrainer:
         RNG streams)."""
         from deeplearning4j_tpu.parallel.compat import shard_map_compat
         from deeplearning4j_tpu.resilience.guard import (
-            divergence_ok, select_updates,
+            divergence_ok, grad_global_norm_sq, select_updates,
         )
 
         shard_map = shard_map_compat()
 
         guarded = self.divergence_guard is not None
+        telemetry = self._telemetry_enabled()
         m = self.model
         mesh = self.mesh
         updater = m.updater_def
@@ -336,8 +360,14 @@ class DistributedTrainer:
             new_params, new_upd = updater.update(
                 grads, upd_state, params, lrs, t
             )
+            # telemetry norm is computed post-pmean: the GLOBAL
+            # gradient's L2 norm, identical on every replica
+            extras = (
+                (jnp.sqrt(grad_global_norm_sq(grads)),)
+                if telemetry else ()
+            )
             if not guarded:
-                return new_params, new_upd, new_state, score
+                return (new_params, new_upd, new_state, score) + extras
             # divergence guard: grads/score are already replica-
             # identical post-pmean, so every replica computes the same
             # ok flag and selects the same trees
@@ -346,11 +376,13 @@ class DistributedTrainer:
                 ok, new_params, params, new_upd, upd_state,
                 new_state, state,
             )
-            return new_params, new_upd, new_state, score, ok
+            return (
+                (new_params, new_upd, new_state, score) + extras + (ok,)
+            )
 
         rep = P()
         dp = P("data")
-        n_out = 5 if guarded else 4
+        n_out = 4 + int(telemetry) + int(guarded)
         sharded = shard_map(
             step, mesh=mesh,
             in_specs=(rep, rep, rep, dp, dp, dp, dp, rep, rep, rep),
@@ -361,10 +393,11 @@ class DistributedTrainer:
 
     def _build_gspmd_step(self):
         from deeplearning4j_tpu.resilience.guard import (
-            divergence_ok, select_updates,
+            divergence_ok, grad_global_norm_sq, select_updates,
         )
 
         guarded = self.divergence_guard is not None
+        telemetry = self._telemetry_enabled()
         m = self.model
         mesh = self.mesh
         rep = NamedSharding(mesh, P())
@@ -408,18 +441,26 @@ class DistributedTrainer:
             new_params, new_upd = updater.update(
                 grads, upd_state, params, lrs, t
             )
+            extras = (
+                (jnp.sqrt(grad_global_norm_sq(grads)),)
+                if telemetry else ()
+            )
             if not guarded:
-                return new_params, new_upd, new_state, score
+                return (new_params, new_upd, new_state, score) + extras
             ok = divergence_ok(score, grads)
             new_params, new_upd, new_state = select_updates(
                 ok, new_params, params, new_upd, upd_state,
                 new_state, state,
             )
-            return new_params, new_upd, new_state, score, ok
+            return (
+                (new_params, new_upd, new_state, score) + extras + (ok,)
+            )
 
         out_shardings = (
             self._param_shardings, upd_shardings, state_shardings, rep,
         )
+        if telemetry:
+            out_shardings = out_shardings + (rep,)
         if guarded:
             out_shardings = out_shardings + (rep,)
         return jax.jit(
@@ -512,10 +553,14 @@ class DistributedTrainer:
             t, rng,
         )
         guard = self.divergence_guard
-        if guard is not None:
-            m.params, m.updater_state, m.state, score, ok = out
-        else:
-            m.params, m.updater_state, m.state, score = out
+        m.params, m.updater_state, m.state = out[:3]
+        score = out[3]
+        i = 4
+        if self._built_telemetry:
+            m._last_grad_norm = out[i]  # device scalar; lazy
+            i += 1
+        ok = out[i] if guard is not None else None
+        m._last_batch_rows = batch_n  # examples/sec signal
         m.iteration_count += 1
         m.score_value = score  # lazy; reading syncs
         if guard is not None:

@@ -74,6 +74,19 @@ class CircuitBreaker:
 
     # -- state ----------------------------------------------------------
 
+    def _emit_transition(self, frm: str, to: str) -> None:
+        """Breaker transitions are rare and operationally loud — they
+        go to the global trace/event stream (no-op unless a tracer is
+        installed). Called under the breaker lock; the tracer has its
+        own lock and never calls back into the breaker."""
+        from deeplearning4j_tpu.observability.trace import get_tracer
+
+        get_tracer().event("breaker.transition", attrs={
+            "name": self.name, "from": frm, "to": to,
+            "consecutive_failures": self._consecutive_failures,
+            "trips": self.trips,
+        })
+
     def _state_locked(self) -> str:
         """Current state, applying the lazy OPEN -> HALF_OPEN
         transition (no timer thread: the clock is consulted on use)."""
@@ -81,6 +94,7 @@ class CircuitBreaker:
                 and self.clock() - self._opened_at >= self.reset_timeout):
             self._state = HALF_OPEN
             self._probes = 0
+            self._emit_transition(OPEN, HALF_OPEN)
         return self._state
 
     @property
@@ -131,16 +145,19 @@ class CircuitBreaker:
             return False
 
     def _trip_locked(self) -> None:
+        frm = self._state
         self._state = OPEN
         self._opened_at = self.clock()
         self._probes = 0
         self.trips += 1
+        self._emit_transition(frm, OPEN)
 
     def record_success(self) -> None:
         with self._lock:
             if self._state == HALF_OPEN:
                 self._state = CLOSED
                 self._probes = 0
+                self._emit_transition(HALF_OPEN, CLOSED)
             self._consecutive_failures = 0
 
     def record_failure(self) -> None:

@@ -147,24 +147,30 @@ class CheckpointManager:
     def save(self, model) -> CheckpointInfo:
         """Checkpoint ``model`` at its current iteration count.
         Re-saving the same step overwrites that version atomically."""
+        from deeplearning4j_tpu.observability.trace import get_tracer
         from deeplearning4j_tpu.util.model_serializer import write_model
 
         step = int(model.iteration_count)
         epoch = int(getattr(model, "epoch_count", 0))
-        zpath = self.directory / self._zip_name(step)
-        write_model(model, zpath)  # atomic (temp + os.replace)
-        crc, size = _crc32_of(zpath)
-        info = CheckpointInfo(
-            step=step, epoch=epoch, file=zpath.name, crc32=crc, size=size,
-        )
-        # manifest lands after the zip: a crash between the two leaves
-        # an orphan zip that available() ignores, never a manifest
-        # pointing at a missing/half zip
-        atomic_write_bytes(
-            self.directory / self._manifest_name(step),
-            json.dumps(info.to_manifest(), indent=2).encode(),
-        )
-        self._prune()
+        with get_tracer().start_span("checkpoint.save", attrs={
+            "step": step, "prefix": self.prefix,
+        }) as span:
+            zpath = self.directory / self._zip_name(step)
+            write_model(model, zpath)  # atomic (temp + os.replace)
+            crc, size = _crc32_of(zpath)
+            info = CheckpointInfo(
+                step=step, epoch=epoch, file=zpath.name, crc32=crc,
+                size=size,
+            )
+            # manifest lands after the zip: a crash between the two
+            # leaves an orphan zip that available() ignores, never a
+            # manifest pointing at a missing/half zip
+            atomic_write_bytes(
+                self.directory / self._manifest_name(step),
+                json.dumps(info.to_manifest(), indent=2).encode(),
+            )
+            self._prune()
+            span.set_attr("bytes", size)
         return info
 
     def _prune(self) -> None:
@@ -232,34 +238,48 @@ class CheckpointManager:
         back to earlier versions when the newest is corrupted — the
         recovery path a preemption mid-save exercises. Raises
         ``CheckpointCorruptedException`` when no version survives."""
-        versions = self.available()
-        if not versions:
+        from deeplearning4j_tpu.observability.trace import get_tracer
+
+        with get_tracer().start_span(
+            "checkpoint.restore", attrs={"prefix": self.prefix},
+        ) as span:
+            versions = self.available()
+            if not versions:
+                span.set_attr("outcome", "none_available")
+                raise CheckpointCorruptedException(
+                    f"no checkpoints under {self.directory}"
+                )
+            fallbacks = 0
+            for info in reversed(versions):
+                try:
+                    model = self.restore(info,
+                                         load_updater=load_updater)
+                except CheckpointCorruptedException:
+                    logger.warning(
+                        "checkpoint step %d failed verification; "
+                        "falling back to the previous version",
+                        info.step,
+                    )
+                    fallbacks += 1
+                    continue
+                except Exception:
+                    # a manifest that verifies but won't deserialize
+                    # is corruption too (valid zip, mangled npz member)
+                    logger.warning(
+                        "checkpoint step %d failed to deserialize; "
+                        "falling back to the previous version",
+                        info.step, exc_info=True,
+                    )
+                    fallbacks += 1
+                    continue
+                span.set_attr("step", info.step)
+                span.set_attr("fallbacks", fallbacks)
+                return model, info
+            span.set_attr("outcome", "all_corrupted")
             raise CheckpointCorruptedException(
-                f"no checkpoints under {self.directory}"
+                f"all {len(versions)} checkpoint versions under "
+                f"{self.directory} failed verification"
             )
-        for info in reversed(versions):
-            try:
-                model = self.restore(info, load_updater=load_updater)
-            except CheckpointCorruptedException:
-                logger.warning(
-                    "checkpoint step %d failed verification; falling "
-                    "back to the previous version", info.step,
-                )
-                continue
-            except Exception:
-                # a manifest that verifies but won't deserialize is
-                # corruption too (e.g. valid zip, mangled npz member)
-                logger.warning(
-                    "checkpoint step %d failed to deserialize; falling "
-                    "back to the previous version", info.step,
-                    exc_info=True,
-                )
-                continue
-            return model, info
-        raise CheckpointCorruptedException(
-            f"all {len(versions)} checkpoint versions under "
-            f"{self.directory} failed verification"
-        )
 
 
 def restore_into(model, source, load_updater: bool = True):

@@ -67,7 +67,11 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
     under ``policy`` (default ``RetryPolicy()``). Non-allowlisted
     exceptions propagate on the first occurrence; an exhausted budget
     raises ``RetryExhaustedException`` chained to the last cause."""
+    from deeplearning4j_tpu.observability.trace import get_tracer
+
     policy = policy or RetryPolicy()
+    tracer = get_tracer()
+    name = str(getattr(fn, "__name__", fn))
     last: Optional[BaseException] = None
     for attempt in range(policy.max_attempts):
         try:
@@ -76,9 +80,19 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
             last = e
             if attempt + 1 >= policy.max_attempts:
                 break
-            policy.sleep(policy.delay_for(attempt))
+            delay = policy.delay_for(attempt)
+            tracer.event("retry.attempt", attrs={
+                "fn": name, "attempt": attempt + 1,
+                "error": type(e).__name__,
+                "backoff_s": round(delay, 6),
+            })
+            policy.sleep(delay)
+    tracer.event("retry.exhausted", attrs={
+        "fn": name, "attempts": policy.max_attempts,
+        "error": type(last).__name__ if last else None,
+    })
     raise RetryExhaustedException(
-        f"{getattr(fn, '__name__', fn)!s} failed after "
+        f"{name} failed after "
         f"{policy.max_attempts} attempts: {last!r}",
         attempts=policy.max_attempts,
         last_cause=last,

@@ -22,7 +22,12 @@ re-exports from here).
   (``error_envelope``), opaque deterministic error ids, and strict
   Content-Length body reading (``read_request_body``: 411/400/413);
 - ``metrics.py`` — counters + fixed-size latency reservoir
-  quantiles, queue-delay reservoir, batch-occupancy histogram.
+  quantiles, queue-delay reservoir, batch-occupancy histogram — all
+  registered in a per-server ``observability.MetricsRegistry``, so
+  ``/metrics?format=prometheus`` serves text exposition alongside
+  the JSON default; pass ``tracer=`` to ``ModelServer`` and one
+  trace id follows each request across admission, queue wait, batch
+  assembly, and predict (``deeplearning4j_tpu/observability/``).
 """
 
 from deeplearning4j_tpu.serving.batcher import (  # noqa: F401

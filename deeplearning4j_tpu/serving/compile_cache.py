@@ -68,8 +68,9 @@ class CompileCache:
     ``xla_compiles_total`` / ``post_warmup_compiles_total`` counters
     plus the recompile-guard log line."""
 
-    def __init__(self, metrics=None):
+    def __init__(self, metrics=None, tracer=None):
         self.metrics = metrics
+        self.tracer = tracer  # observability.Tracer; compile events
 
     def register(self) -> ModelShapes:
         return ModelShapes()
@@ -83,6 +84,14 @@ class CompileCache:
             return verdict
         if self.metrics is not None:
             self.metrics.incr("xla_compiles_total")
+        if self.tracer is not None:
+            # compile events join the trace stream: a slow request
+            # whose trace window brackets an xla.compile event has
+            # its explanation in one place
+            self.tracer.event("xla.compile", attrs={
+                "shape": [int(d) for d in shape],
+                "verdict": verdict,
+            })
         if verdict == POST_WARMUP:
             if self.metrics is not None:
                 self.metrics.incr("post_warmup_compiles_total")

@@ -1,23 +1,32 @@
-"""Serving observability: counters + a fixed-size latency reservoir.
+"""Serving observability, rebased onto the shared
+``observability.MetricsRegistry``.
 
 The robustness behaviors (shedding, deadline kills, breaker trips,
 reloads) are only trustworthy if they are *observable*: the
-``/metrics`` endpoint serves this snapshot as JSON so a saturation
-test — or an operator — can see exactly how many requests were shed
-vs admitted vs timed out, and what the latency quantiles were.
+``/metrics`` endpoint serves this snapshot as JSON (and, since the
+observability subsystem landed, Prometheus text exposition via
+``/metrics?format=prometheus``) so a saturation test — or an
+operator — can see exactly how many requests were shed vs admitted
+vs timed out, and what the latency quantiles were.
 
-The reservoir is a fixed-size ring of the most recent latencies:
-bounded memory however long the server runs, quantiles computed on
-demand from a sorted copy (nearest-rank). Recency bias is the point —
-serving dashboards want "how slow is it NOW", not a since-boot
-average.
+``ServingMetrics`` keeps its original surface (``incr``/``get``/
+``record_latency``/``try_enter``/``snapshot`` — the admission bound
+and every call site are unchanged) but every instrument now lives in
+a per-server ``MetricsRegistry``: counters are registry counters,
+the latency and queue-delay reservoirs are registry summaries, the
+batch-occupancy histogram a registry histogram, and ``inflight`` is
+mirrored into a gauge — so one exporter renders the whole set. The
+canonical ``Reservoir`` and ``Histogram`` primitives moved to
+``observability/metrics.py``; they are re-exported here so existing
+imports (``from deeplearning4j_tpu.serving.metrics import
+Reservoir``) keep working.
 
 The micro-batching layer (``batcher.py``) adds two more instruments:
 a **batch-occupancy histogram** (valid rows per dispatch, bucketed on
 the shape ladder — the direct readout of how well coalescing is
-working) plus mean fill ratio, a **queue-delay reservoir** (admission
-to batch-drain pickup — the latency cost requests pay for
-coalescing), and the compile counters ``xla_compiles_total`` /
+working), a **queue-delay reservoir** (admission to batch-drain
+pickup — the latency cost requests pay for coalescing), and the
+compile counters ``xla_compiles_total`` /
 ``post_warmup_compiles_total`` (``compile_cache.py``) that make
 "zero compiles under steady bucketed load" falsifiable from
 ``/metrics`` alone.
@@ -25,142 +34,120 @@ coalescing), and the compile counters ``xla_compiles_total`` /
 
 from __future__ import annotations
 
-import bisect
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Optional, Sequence
 
+from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
+    Histogram,
+    MetricsRegistry,
+    Reservoir,
+)
 
-class Reservoir:
-    """Ring buffer of the last ``size`` observations with
-    nearest-rank quantiles."""
-
-    def __init__(self, size: int = 1024):
-        if size < 1:
-            raise ValueError("size must be >= 1")
-        self.size = size
-        self._ring: List[float] = []
-        self._next = 0
-        self.count = 0  # total ever recorded
-
-    def record(self, value: float) -> None:
-        if len(self._ring) < self.size:
-            self._ring.append(value)
-        else:
-            self._ring[self._next] = value
-        self._next = (self._next + 1) % self.size
-        self.count += 1
-
-    def quantile(self, q: float) -> Optional[float]:
-        if not self._ring:
-            return None
-        s = sorted(self._ring)
-        idx = min(len(s) - 1, max(0, int(q * len(s))))
-        return s[idx]
-
-    def snapshot(self) -> dict:
-        return {
-            "count": self.count,
-            "p50": self.quantile(0.50),
-            "p90": self.quantile(0.90),
-            "p99": self.quantile(0.99),
-            "max": max(self._ring) if self._ring else None,
-        }
-
-
-class Histogram:
-    """Fixed-boundary counting histogram: ``record(v)`` counts v into
-    the first boundary >= v (an overflow bin catches the rest).
-    Bounded memory, O(log b) record — the batch-occupancy instrument
-    (boundaries = the shape-bucket ladder)."""
-
-    def __init__(self, boundaries: Sequence[float]):
-        if not boundaries:
-            raise ValueError("histogram needs at least one boundary")
-        self.boundaries = sorted(float(b) for b in boundaries)
-        self._counts = [0] * (len(self.boundaries) + 1)
-        self.count = 0
-        self.total = 0.0
-
-    def record(self, value: float) -> None:
-        self._counts[bisect.bisect_left(self.boundaries, value)] += 1
-        self.count += 1
-        self.total += value
-
-    def snapshot(self) -> dict:
-        buckets = {}
-        for b, c in zip(self.boundaries, self._counts):
-            buckets[f"le_{b:g}"] = c
-        buckets["overflow"] = self._counts[-1]
-        return {
-            "count": self.count,
-            "mean": (self.total / self.count) if self.count else None,
-            "buckets": buckets,
-        }
+# name -> help, rendered into the Prometheus HELP lines and the
+# ARCHITECTURE.md signal catalog (scripts/lint_metrics.py keeps the
+# two in sync)
+COUNTER_HELP = {
+    "requests_total": "every HTTP request seen",
+    "predictions_total": "successful predicts",
+    "shed_total": "503: queue full / draining",
+    "breaker_rejected_total": "503: circuit open",
+    "deadline_timeout_total": "504: deadline exceeded",
+    "client_error_total": "4xx responses",
+    "server_error_total": "5xx from model/transform faults",
+    "abandoned_total": "worker finished after caller's 504",
+    "reload_total": "successful hot swaps",
+    "reload_failure_total": "failed reload attempts (old kept)",
+    "batches_total": "batched dispatches executed",
+    "batched_predictions_total": "requests answered via a batch",
+    "solo_fallback_total": "requests too wide for the ladder",
+    "batch_expired_total": "dropped (504) before stacking",
+    "xla_compiles_total": "forwards on a never-seen shape",
+    "post_warmup_compiles_total": "ladder escapes (recompile guard)",
+    "warmup_predicts_total": "eager bucket warmup forwards",
+}
 
 
 class ServingMetrics:
-    """Thread-safe counter set + latency reservoir for one server."""
+    """Thread-safe counter set + latency reservoir for one server,
+    backed by a per-server ``MetricsRegistry`` (pass ``registry=`` to
+    share one, e.g. a disabled ``NULL_REGISTRY`` for overhead-free
+    serving)."""
 
-    COUNTERS = (
-        "requests_total",        # every HTTP request seen
-        "predictions_total",     # successful predicts
-        "shed_total",            # 503: queue full / draining
-        "breaker_rejected_total",  # 503: circuit open
-        "deadline_timeout_total",  # 504
-        "client_error_total",    # 4xx
-        "server_error_total",    # 5xx from model/transform faults
-        "abandoned_total",       # worker finished after caller's 504
-        "reload_total",          # successful hot swaps
-        "reload_failure_total",  # failed reload attempts (old kept)
-        # -- micro-batching + compile accounting --------------------
-        "batches_total",           # batched dispatches executed
-        "batched_predictions_total",  # requests answered via a batch
-        "solo_fallback_total",     # requests too wide for the ladder
-        "batch_expired_total",     # dropped (504) before stacking
-        "xla_compiles_total",      # forwards on a never-seen shape
-        "post_warmup_compiles_total",  # ladder escapes (guard)
-        "warmup_predicts_total",   # eager bucket warmup forwards
-    )
+    COUNTERS = tuple(COUNTER_HELP)
 
     def __init__(self, reservoir_size: int = 1024,
-                 occupancy_buckets: Optional[Sequence[int]] = None):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {k: 0 for k in self.COUNTERS}
-        self._latency = Reservoir(reservoir_size)
-        self._queue_delay = Reservoir(reservoir_size)
-        self._occupancy = (
-            Histogram(occupancy_buckets) if occupancy_buckets else None
+                 occupancy_buckets: Optional[Sequence[int]] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = (
+            registry if registry is not None else MetricsRegistry()
         )
+        self._lock = threading.Lock()
+        # store the resolved unlabeled instruments, not the family
+        # proxies: one attribute hop fewer per update on the serving
+        # hot path (the overhead bench notices)
+        self._counters = {
+            name: self.registry.counter(
+                name, help=COUNTER_HELP[name]
+            )._default()
+            for name in self.COUNTERS
+        }
+        self._latency = self.registry.summary(
+            "latency_ms", reservoir_size=reservoir_size,
+            help="end-to-end request latency (ms), recent window",
+        )._default()
+        self._queue_delay = self.registry.summary(
+            "queue_delay_ms", reservoir_size=reservoir_size,
+            help="admission to batch-drain pickup (ms), recent window",
+        )._default()
+        self._occupancy = (
+            self.registry.histogram(
+                "batch_occupancy_rows", occupancy_buckets,
+                help="valid rows per batched dispatch "
+                     "(buckets = the shape ladder)",
+            )._default()
+            if occupancy_buckets else None
+        )
+        self._inflight_gauge = self.registry.gauge(
+            "inflight", help="admitted requests not yet answered"
+        )._default()
         self.inflight = 0  # admitted, response not yet written
 
     def incr(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] += n
+        if not self.registry.enabled:
+            self._counters[name]  # unknown names still KeyError
+            return
+        self._counters[name].inc(n)
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._counters[name]
+        return self._counters[name].value
 
     def record_latency(self, seconds: float) -> None:
-        with self._lock:
-            self._latency.record(seconds * 1000.0)
+        if self.registry.enabled:
+            self._latency.observe(seconds * 1000.0)
 
     def record_queue_delay(self, seconds: float) -> None:
-        with self._lock:
-            self._queue_delay.record(seconds * 1000.0)
+        if self.registry.enabled:
+            self._queue_delay.observe(seconds * 1000.0)
 
     def record_batch(self, n_valid: int, bucket: int) -> None:
         """One batched dispatch: ``n_valid`` real rows padded to
         ``bucket``. Occupancy is recorded in rows (the histogram's
         boundaries are the ladder), fill ratio rides in the mean."""
-        with self._lock:
-            self._counters["batches_total"] += 1
-            if self._occupancy is not None:
-                self._occupancy.record(n_valid)
+        if not self.registry.enabled:
+            return
+        self._counters["batches_total"].inc()
+        if self._occupancy is not None:
+            self._occupancy.observe(n_valid)
+
+    # NB: inflight accounting below is the ADMISSION BOUND, not
+    # telemetry — it stays exact in no-op mode; only the gauge
+    # mirror (export-facing) is skipped when the registry is off.
 
     def enter(self) -> None:
         with self._lock:
             self.inflight += 1
+            if self.registry.enabled:
+                self._inflight_gauge.set(self.inflight)
 
     def try_enter(self, limit: int) -> bool:
         """Atomic admission check: admit only while fewer than
@@ -171,18 +158,22 @@ class ServingMetrics:
             if self.inflight >= limit:
                 return False
             self.inflight += 1
+            if self.registry.enabled:
+                self._inflight_gauge.set(self.inflight)
             return True
 
     def exit(self) -> None:
         with self._lock:
             self.inflight -= 1
+            if self.registry.enabled:
+                self._inflight_gauge.set(self.inflight)
 
     def snapshot(self) -> dict:
+        out = {name: c.value for name, c in self._counters.items()}
         with self._lock:
-            out = dict(self._counters)
             out["inflight"] = self.inflight
-            out["latency_ms"] = self._latency.snapshot()
-            out["queue_delay_ms"] = self._queue_delay.snapshot()
-            if self._occupancy is not None:
-                out["batch_occupancy_rows"] = self._occupancy.snapshot()
-            return out
+        out["latency_ms"] = self._latency.snapshot()
+        out["queue_delay_ms"] = self._queue_delay.snapshot()
+        if self._occupancy is not None:
+            out["batch_occupancy_rows"] = self._occupancy.snapshot()
+        return out

@@ -63,6 +63,12 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observability.export import (
+    CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
+    parse_format_query,
+    prometheus_text,
+)
+from deeplearning4j_tpu.observability.trace import Tracer
 from deeplearning4j_tpu.resilience.breaker import OPEN, CircuitBreaker
 from deeplearning4j_tpu.resilience.deadline import Deadline
 from deeplearning4j_tpu.serving.batcher import (
@@ -135,9 +141,17 @@ class _WorkItem:
 
     __slots__ = ("features", "deadline", "done", "response", "lock",
                  "started", "cancelled", "timed_out", "rows",
-                 "squeeze", "enqueued_at")
+                 "squeeze", "enqueued_at", "span", "queue_span",
+                 "assembly_span")
 
     def __init__(self, features, deadline: Deadline):
+        # trace handoff: the handler thread sets ``span`` (the
+        # request's root) and ``queue_span`` before enqueueing; the
+        # drain thread ends the queue span and parents its batch/
+        # predict spans on the root — one trace id across threads
+        self.span = None
+        self.queue_span = None
+        self.assembly_span = None
         self.features = features
         self.deadline = deadline
         self.done = threading.Event()
@@ -208,7 +222,8 @@ class ModelServer:
                  max_batch_size: int = 32,
                  batch_timeout_ms: float = 2.0,
                  bucket_ladder=None,
-                 batch_workers: int = 1):
+                 batch_workers: int = 1,
+                 tracer: Optional[Tracer] = None):
         if workers < 1:
             raise ValueError("workers must be >= 1")
         if queue_depth < 0:
@@ -243,7 +258,12 @@ class ModelServer:
             self.batch_workers = workers
             occupancy = None
         self.metrics = ServingMetrics(reservoir_size, occupancy)
-        self.compile_cache = CompileCache(self.metrics)
+        # disabled by default: every span operation is a no-op costing
+        # one branch; pass a Tracer(sink=JsonlSink(...)) to record
+        self.tracer = tracer if tracer is not None else Tracer(
+            enabled=False
+        )
+        self.compile_cache = CompileCache(self.metrics, self.tracer)
 
         self._source_path: Optional[str] = None
         self._watched_step: Optional[int] = None
@@ -386,6 +406,8 @@ class ModelServer:
             if item.cancelled:
                 return
             item.started = True
+        if item.queue_span is not None:
+            item.queue_span.end()  # idempotent; batch path ends first
         if item.deadline.expired():
             # expired while queued: report without touching the model
             self.metrics.incr("deadline_timeout_total")
@@ -402,6 +424,10 @@ class ModelServer:
             ), {"Retry-After": self._retry_after_header()})
             return
         mv = self._active  # snapshot: reloads swap for later requests
+        pspan = self.tracer.start_span(
+            "serving.predict", parent=item.span,
+            attrs={"mode": "solo", "model_version": mv.version},
+        )
         try:
             feats = item.features
             if self.transform is not None:
@@ -417,12 +443,14 @@ class ModelServer:
             logger.error("predict failed (error_id=%s)", eid,
                          exc_info=True)
             self.metrics.incr("server_error_total")
+            pspan.set_attr("error_id", eid).end("error")
             item.finish(500, error_envelope(
                 "model_error", 500,
                 "prediction failed; see server log",
                 error_id=eid,
             ))
             return
+        pspan.end()
         self.breaker.record_success()
         body = {"output": out.tolist(), "model_version": mv.version}
         if self.output_classes and out.ndim == 2:
@@ -445,12 +473,19 @@ class ModelServer:
                 if item.cancelled:
                     continue
                 item.started = True
+            if item.queue_span is not None:
+                item.queue_span.end()
+            item.assembly_span = self.tracer.start_span(
+                "serving.batch_assembly", parent=item.span,
+                attrs={"batch_items": len(items)},
+            )
             self.metrics.record_queue_delay(now - item.enqueued_at)
             if item.deadline.expired():
                 # dropped BEFORE stacking: never pads a dead request
                 # into a live batch
                 self.metrics.incr("deadline_timeout_total")
                 self.metrics.incr("batch_expired_total")
+                item.assembly_span.end("timeout")
                 item.finish(504, deadline_envelope(
                     item.deadline,
                     "deadline expired while coalescing",
@@ -459,6 +494,9 @@ class ModelServer:
             if item.rows > self.batcher.ladder.max:
                 # wider than the largest bucket: solo path, own compile
                 self.metrics.incr("solo_fallback_total")
+                item.assembly_span.set_attr(
+                    "outcome", "solo_fallback"
+                ).end()
                 self._process(item)
                 continue
             try:
@@ -476,6 +514,9 @@ class ModelServer:
                 logger.error("transform failed (error_id=%s)", eid,
                              exc_info=True)
                 self.metrics.incr("server_error_total")
+                item.assembly_span.set_attr("error_id", eid).end(
+                    "error"
+                )
                 item.finish(500, error_envelope(
                     "model_error", 500,
                     "prediction failed; see server log",
@@ -499,6 +540,9 @@ class ModelServer:
     def _predict_chunk(self, mv: _ModelVersion, chunk) -> None:
         """ONE padded forward for a chunk of (item, features) pairs,
         sliced back out and completed per request."""
+        for item, _ in chunk:
+            if item.assembly_span is not None:
+                item.assembly_span.end()
         if not self.breaker.try_acquire():
             self.metrics.incr("breaker_rejected_total", len(chunk))
             body = error_envelope(
@@ -512,6 +556,15 @@ class ModelServer:
             return
         n_valid = sum(int(f.shape[0]) for _, f in chunk)
         bucket = self.batcher.ladder.bucket_for(n_valid)
+        pspans = [
+            self.tracer.start_span(
+                "serving.predict", parent=item.span,
+                attrs={"mode": "batched", "bucket": bucket,
+                       "n_valid": n_valid, "chunk_size": len(chunk),
+                       "model_version": mv.version},
+            )
+            for item, _ in chunk
+        ]
         try:
             stacked = (
                 chunk[0][1] if len(chunk) == 1
@@ -531,9 +584,13 @@ class ModelServer:
                 "prediction failed; see server log",
                 error_id=eid,
             )
+            for sp in pspans:
+                sp.set_attr("error_id", eid).end("error")
             for item, _ in chunk:
                 item.finish(500, body)
             return
+        for sp in pspans:
+            sp.end()
         self.breaker.record_success()
         self.metrics.record_batch(n_valid, bucket)
         self.metrics.incr("batched_predictions_total", len(chunk))
@@ -615,9 +672,19 @@ class ModelServer:
     def submit(self, features) -> "tuple[int, dict, dict]":
         """Admit one predict through the bounded pool and wait for its
         result under the request deadline. Returns
-        ``(status, body, headers)``."""
+        ``(status, body, headers)``. One root span brackets the whole
+        request; the admission decision, queue wait, batch assembly,
+        and predict are children sharing its trace id."""
+        shape = np.shape(features)
+        root = self.tracer.start_span("serving.request", attrs={
+            "rows": int(shape[0]) if len(shape) >= 2 else 1,
+        })
+        adm = self.tracer.start_span("serving.admission",
+                                     parent=root)
         if self._draining:
             self.metrics.incr("shed_total")
+            adm.set_attr("outcome", "draining").end("shed")
+            root.set_attr("status_code", 503).end("shed")
             return 503, error_envelope(
                 "draining", 503, "server is draining; not admitting",
                 retry_after=self.retry_after,
@@ -625,6 +692,8 @@ class ModelServer:
         if self.breaker.state == OPEN:
             # fail fast at admission: no queue slot for a doomed call
             self.metrics.incr("breaker_rejected_total")
+            adm.set_attr("outcome", "circuit_open").end("shed")
+            root.set_attr("status_code", 503).end("shed")
             return 503, error_envelope(
                 "circuit_open", 503,
                 "model circuit is open; failing fast",
@@ -634,17 +703,25 @@ class ModelServer:
         # the system (executing + queued); the excess is shed NOW
         if not self.metrics.try_enter(self.workers + self.queue_depth):
             self.metrics.incr("shed_total")
+            adm.set_attr("outcome", "shed").end("shed")
+            root.set_attr("status_code", 503).end("shed")
             return 503, error_envelope(
                 "shed", 503,
                 "worker pool and queue are full",
                 retry_after=self.retry_after,
             ), {"Retry-After": self._retry_after_header()}
+        adm.set_attr("outcome", "admitted").end()
         item = _WorkItem(features, Deadline.after(self.deadline))
+        item.span = root
+        item.queue_span = self.tracer.start_span("serving.queue",
+                                                 parent=root)
         try:
             try:
                 self._queue.put_nowait(item)
             except queue.Full:  # unreachable: sized to the bound
                 self.metrics.incr("shed_total")
+                item.queue_span.end("shed")
+                root.set_attr("status_code", 503).end("shed")
                 return 503, error_envelope(
                     "shed", 503,
                     "worker pool and queue are full",
@@ -659,8 +736,14 @@ class ModelServer:
                     item.timed_out = True
                     if not item.started:
                         item.cancelled = True
+                        item.queue_span.end("timeout")
                 self.metrics.incr("deadline_timeout_total")
+                root.set_attr("status_code", 504).end("timeout")
                 return 504, deadline_envelope(item.deadline), {}
+            code = item.response[0]
+            root.set_attr("status_code", code).end(
+                "ok" if code < 400 else "error"
+            )
             return item.response
         finally:
             self.metrics.exit()
@@ -847,6 +930,27 @@ class ModelServer:
         return 200, {"status": "ready",
                      "version": self._active.version}
 
+    def prometheus_metrics(self) -> str:
+        """Registry contents in Prometheus text exposition format
+        (``GET /metrics?format=prometheus``). Scrape-time gauges
+        mirror the snapshot-only fields so the exposition is
+        self-contained."""
+        reg = self.metrics.registry
+        reg.gauge("queue_depth",
+                  help="requests waiting in the bounded queue").set(
+            self._queue.qsize()
+        )
+        reg.gauge("model_version",
+                  help="active model version (bumps on reload)").set(
+            self._active.version
+        )
+        reg.gauge("breaker_state",
+                  help="predict breaker: 0 closed, 1 open, "
+                       "2 half-open").set(
+            {"closed": 0, "open": 1, "half_open": 2}[self.breaker.state]
+        )
+        return prometheus_text(reg)
+
     def metrics_snapshot(self) -> dict:
         out = self.metrics.snapshot()
         out["queue_depth"] = self._queue.qsize()
@@ -931,17 +1035,34 @@ def _make_handler(server: ModelServer):
             except OSError:
                 pass  # client went away; nothing to tell it
 
+        def _text(self, body: str, content_type: str,
+                  code: int = 200):
+            data = body.encode()
+            try:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except OSError:
+                pass
+
         def do_GET(self):
             server.metrics.incr("requests_total")
-            if self.path == "/healthz":
+            route, fmt = parse_format_query(self.path)
+            if route == "/healthz":
                 self._json(server.health())
                 return
-            if self.path == "/readyz":
+            if route == "/readyz":
                 code, body = server.readiness()
                 self._json(body, code)
                 return
-            if self.path == "/metrics":
-                self._json(server.metrics_snapshot())
+            if route == "/metrics":
+                if fmt == "prometheus":
+                    self._text(server.prometheus_metrics(),
+                               PROMETHEUS_CONTENT_TYPE)
+                else:  # JSON stays the default
+                    self._json(server.metrics_snapshot())
                 return
             self._json(error_envelope("not_found", 404, "not found"),
                        404)

@@ -20,6 +20,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from deeplearning4j_tpu.observability.export import (
+    CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
+    parse_format_query,
+    prometheus_text,
+    registry_snapshot,
+)
+from deeplearning4j_tpu.observability.metrics import default_registry
 from deeplearning4j_tpu.serving.envelope import (
     HttpBodyError,
     error_envelope,
@@ -387,6 +394,22 @@ def _make_handler(server: "UIServer"):
             if url.path == "/train/activations":
                 self._json(server.activations())
                 return
+            if url.path == "/metrics":
+                # training-side registry (TelemetryListener /
+                # StatsListener publish here): JSON by default,
+                # ?format=prometheus for scraping
+                _, fmt = parse_format_query(self.path)
+                if fmt == "prometheus":
+                    body = prometheus_text(server.registry).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     PROMETHEUS_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(registry_snapshot(server.registry))
+                return
             self._json(error_envelope("not_found", 404, "not found"),
                        404)
 
@@ -463,6 +486,9 @@ class UIServer:
         )
         self._storages: List[StatsStorage] = []
         self.remote_enabled = False
+        # the process-wide training registry this server exports at
+        # /metrics (StatsListener / TelemetryListener publish there)
+        self.registry = default_registry()
         self._httpd = ThreadingHTTPServer(
             (self.host, self.port), _make_handler(self)
         )

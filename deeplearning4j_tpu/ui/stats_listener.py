@@ -17,6 +17,12 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observability.metrics import (
+    MetricsRegistry,
+    array_histograms,
+    default_registry,
+    mean_magnitudes,
+)
 from deeplearning4j_tpu.optimize.listeners import IterationListener
 from deeplearning4j_tpu.ui.model import (
     StatsInitializationReport,
@@ -67,26 +73,11 @@ def _graph_structure_json(model) -> str:
         return "{}"
 
 
-def _mean_magnitudes(tree: dict) -> dict:
-    out = {}
-    for lname, params in tree.items():
-        for pname, arr in params.items():
-            a = np.asarray(arr)
-            out[f"{lname}_{pname}"] = float(np.mean(np.abs(a)))
-    return out
-
-
-def _histograms(tree: dict, bins: int = 20) -> dict:
-    out = {}
-    for lname, params in tree.items():
-        for pname, arr in params.items():
-            a = np.asarray(arr).ravel()
-            counts, edges = np.histogram(a, bins=bins)
-            out[f"{lname}_{pname}"] = {
-                "min": float(edges[0]), "max": float(edges[-1]),
-                "counts": counts.tolist(),
-            }
-    return out
+# canonical implementations live in observability/metrics.py (one
+# copy of "summarize this param tree" for every consumer); the old
+# private names stay importable
+_mean_magnitudes = mean_magnitudes
+_histograms = array_histograms
 
 
 class StatsListener(IterationListener):
@@ -96,12 +87,28 @@ class StatsListener(IterationListener):
     def __init__(self, storage: StatsStorage, frequency: int = 1,
                  collect_histograms: bool = False,
                  session_id: Optional[str] = None,
-                 worker_id: str = "worker-0"):
+                 worker_id: str = "worker-0",
+                 registry: Optional[MetricsRegistry] = None):
         self.storage = storage
         self.frequency = max(int(frequency), 1)
         self.collect_histograms = collect_histograms
         self.session_id = session_id or uuid.uuid4().hex[:12]
         self.worker_id = worker_id
+        # shared metrics substrate: the same signals the StatsReport
+        # records also land in the registry the UI server exports at
+        # /metrics?format=prometheus
+        self.registry = (
+            registry if registry is not None else default_registry()
+        )
+        self._score_gauge = self.registry.gauge(
+            "training_score", help="latest sampled minibatch score"
+        )
+        self._iter_gauge = self.registry.gauge(
+            "training_iteration", help="latest sampled iteration"
+        )
+        self._rss_gauge = self.registry.gauge(
+            "training_host_rss_mb", help="host max RSS (MB)"
+        )
         self._init_sent = False
         self._last_time: Optional[float] = None
         self._prev_params: Optional[dict] = None
@@ -169,6 +176,9 @@ class StatsListener(IterationListener):
             for ln, lp in params.items()
         }
         maxrss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        self._score_gauge.set(float(model.score_value))
+        self._iter_gauge.set(iteration)
+        self._rss_gauge.set(maxrss_kb / 1024.0)
         rec = StatsReport(
             session_id=self.session_id, worker_id=self.worker_id,
             timestamp=now_ms(), iteration=iteration,

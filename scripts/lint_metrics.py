@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Static check: the metric signal catalog and the code agree.
+
+Every metric name registered in ``deeplearning4j_tpu/`` (via
+``registry.counter/gauge/histogram/summary("name", ...)`` calls, or
+via the ``COUNTER_HELP``/``COUNTERS`` name tables in
+``serving/metrics.py``) must appear in the ARCHITECTURE.md signal
+catalog (the table between the ``metric-catalog`` markers), and vice
+versa — so the catalog an operator builds dashboards from cannot
+silently drift from what the code actually exports.
+
+Pure AST + text scan: nothing is imported, so this runs in
+milliseconds and in any environment (it is part of the
+``scripts/run_chaos.sh`` preamble — drift fails loudly before the
+chaos suite spends a second).
+
+Exit 0 when in sync; exit 1 with the exact missing names otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "deeplearning4j_tpu"
+DOC = REPO / "docs" / "ARCHITECTURE.md"
+
+REGISTER_METHODS = {"counter", "gauge", "histogram", "summary"}
+NAME_TABLE_TARGETS = {"COUNTER_HELP", "COUNTERS"}
+CATALOG_BEGIN = "<!-- metric-catalog:begin -->"
+CATALOG_END = "<!-- metric-catalog:end -->"
+
+
+def registered_names() -> "dict[str, list[str]]":
+    """{metric name: [source files]} from the package's AST."""
+    out: dict = {}
+
+    def add(name, path):
+        out.setdefault(name, []).append(str(path.relative_to(REPO)))
+
+    for path in sorted(PACKAGE.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            # obj.counter("name", ...) and friends
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in REGISTER_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                add(node.args[0].value, path)
+            # COUNTER_HELP = {"name": "help", ...} / COUNTERS = (...)
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if not (isinstance(tgt, ast.Name)
+                            and tgt.id in NAME_TABLE_TARGETS):
+                        continue
+                    # dict tables contribute their KEYS (values are
+                    # help strings); tuples contribute every element
+                    lits = (node.value.keys
+                            if isinstance(node.value, ast.Dict)
+                            else list(ast.walk(node.value)))
+                    for lit in lits:
+                        if (isinstance(lit, ast.Constant)
+                                and isinstance(lit.value, str)
+                                and re.fullmatch(
+                                    r"[a-z][a-z0-9_]*", lit.value
+                                )):
+                            add(lit.value, path)
+    return out
+
+
+def catalog_names() -> "set[str]":
+    """Backticked first-column names from the ARCHITECTURE.md signal
+    catalog table (between the metric-catalog markers)."""
+    text = DOC.read_text()
+    try:
+        start = text.index(CATALOG_BEGIN)
+        end = text.index(CATALOG_END)
+    except ValueError:
+        print(f"lint_metrics: {DOC} has no "
+              f"{CATALOG_BEGIN} .. {CATALOG_END} section",
+              file=sys.stderr)
+        sys.exit(1)
+    names = set()
+    for line in text[start:end].splitlines():
+        m = re.match(r"\s*\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`", line)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def main() -> int:
+    code = registered_names()
+    doc = catalog_names()
+    missing_in_doc = sorted(set(code) - doc)
+    missing_in_code = sorted(doc - set(code))
+    if missing_in_doc:
+        print("metrics registered in code but MISSING from the "
+              "ARCHITECTURE.md signal catalog:", file=sys.stderr)
+        for n in missing_in_doc:
+            print(f"  {n}  (registered in {', '.join(code[n])})",
+                  file=sys.stderr)
+    if missing_in_code:
+        print("metrics in the ARCHITECTURE.md signal catalog but "
+              "NOT registered anywhere in code:", file=sys.stderr)
+        for n in missing_in_code:
+            print(f"  {n}", file=sys.stderr)
+    if missing_in_doc or missing_in_code:
+        return 1
+    print(f"lint_metrics: {len(code)} metric names in sync with the "
+          "signal catalog")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,11 @@ cd "$(dirname "$0")/.."
 
 export DL4J_TPU_CHAOS_SEED="${DL4J_TPU_CHAOS_SEED:-1337}"
 echo "chaos seed: ${DL4J_TPU_CHAOS_SEED}"
+
+# Preamble: the metric signal catalog (docs/ARCHITECTURE.md) must
+# match the names registered in code — drift fails loudly here,
+# before the chaos suite spends a second (see scripts/lint_metrics.py).
+python scripts/lint_metrics.py
 # Registered chaos suites:
 #   tests/test_resilience.py — training runtime (retry/checkpoint/guard)
 #   tests/test_serving.py    — serving tier (breaker + fault storms)

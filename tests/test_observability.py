@@ -1,0 +1,481 @@
+"""Tier-1 tests for the unified observability subsystem: registry
+thread-safety, Prometheus exposition format (label escaping, bucket
+cumulativity), deterministic seeded span ids, trace-id propagation
+across the MicroBatcher drain thread, JSONL sink bounds, resilience-
+primitive tracing, telemetry listeners on both engines, and the
+metric-catalog lint."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability import (
+    JsonlSink,
+    MetricsRegistry,
+    TelemetryListener,
+    Tracer,
+    get_tracer,
+    prometheus_text,
+    registry_snapshot,
+    set_global_tracer,
+)
+from deeplearning4j_tpu.serving import ModelServer, ServingMetrics
+from deeplearning4j_tpu.serving.metrics import (
+    Histogram,
+    Reservoir,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _small_net(seed=2, n_in=4, n_out=3):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.1)
+        .list()
+        .layer(DenseLayer(n_in=n_in, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=n_out))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _dataset(rng, n=16, n_in=4, n_out=3):
+    x = rng.randn(n, n_in).astype(np.float32)
+    y = np.eye(n_out)[rng.randint(0, n_out, n)].astype(np.float32)
+    return DataSet(features=x, labels=y)
+
+
+def _post(base, payload, path="/predict", timeout=30):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode()
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# -- registry -----------------------------------------------------------
+
+
+class TestRegistry:
+    def test_concurrent_increments_are_exact(self):
+        reg = MetricsRegistry()
+        c = reg.counter("hits_total")
+        lc = reg.counter("labeled_total", labels=("who",))
+        g = reg.gauge("level")
+        s = reg.summary("lat")
+        n_threads, per = 8, 2000
+
+        def work(i):
+            child = lc.labels(who=str(i % 2))
+            for _ in range(per):
+                c.inc()
+                child.inc()
+                g.add(1)
+                s.observe(1.0)
+
+        ts = [threading.Thread(target=work, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value == n_threads * per
+        assert sum(ch.value for ch in lc.children()) == n_threads * per
+        assert g.value == n_threads * per
+        assert s._default().count == n_threads * per
+
+    def test_idempotent_registration_and_kind_mismatch(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total")
+        assert reg.counter("x_total") is a
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+        with pytest.raises(ValueError):
+            reg.counter("bad name")
+
+    def test_noop_mode_counts_nothing(self):
+        reg = MetricsRegistry(enabled=False)
+        c = reg.counter("x_total")
+        c.inc(5)
+        assert c.value == 0
+        reg.enable(True)
+        c.inc(5)
+        assert c.value == 5
+
+    def test_serving_metrics_noop_keeps_admission_exact(self):
+        m = ServingMetrics(registry=MetricsRegistry(enabled=False))
+        assert m.try_enter(2)
+        assert m.try_enter(2)
+        assert not m.try_enter(2)  # the bound still binds
+        m.exit()
+        assert m.try_enter(2)
+        m.incr("predictions_total")
+        assert m.get("predictions_total") == 0  # telemetry is off
+        with pytest.raises(KeyError):
+            m.incr("nonexistent_total")
+
+    def test_reservoir_histogram_reexports(self):
+        # the serving import path must keep working post-dedupe
+        r = Reservoir(4)
+        for v in (1.0, 2.0, 3.0):
+            r.record(v)
+        assert r.snapshot()["count"] == 3
+        h = Histogram([1, 2, 4])
+        h.record(3)
+        assert h.snapshot()["buckets"]["le_4"] == 1
+        from deeplearning4j_tpu.observability.metrics import (
+            Histogram as H2,
+            Reservoir as R2,
+        )
+
+        assert Histogram is H2 and Reservoir is R2
+
+
+# -- Prometheus exposition ---------------------------------------------
+
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$"
+)
+
+
+class TestPrometheusExposition:
+    def test_every_line_parses(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", help="with \\ backslash\nand newline")
+        reg.gauge("b").set(2.5)
+        reg.histogram("h", [1, 5]).observe(3)
+        reg.summary("s").observe(1.0)
+        for line in prometheus_text(reg).strip().splitlines():
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                assert "\n" not in line
+                continue
+            assert _SAMPLE_RE.match(line), line
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("g", labels=("path",))
+        g.labels(path='a"b\\c\nd').set(1)
+        text = prometheus_text(reg)
+        assert 'path="a\\"b\\\\c\\nd"' in text
+
+    def test_histogram_buckets_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", [1, 2, 4])
+        for v in (0.5, 0.5, 1.5, 3, 100):
+            h.observe(v)
+        text = prometheus_text(reg)
+        buckets = re.findall(
+            r'lat_bucket\{le="([^"]+)"\} (\d+)', text
+        )
+        assert buckets == [("1", "2"), ("2", "3"), ("4", "4"),
+                           ("+Inf", "5")]
+        counts = [int(c) for _, c in buckets]
+        assert counts == sorted(counts)  # cumulativity
+        assert "lat_count 5" in text
+        assert f"lat_sum {0.5 + 0.5 + 1.5 + 3 + 100}" in text
+
+    def test_summary_quantiles(self):
+        reg = MetricsRegistry()
+        s = reg.summary("q")
+        for v in range(100):
+            s.observe(float(v))
+        text = prometheus_text(reg)
+        assert re.search(r'q\{quantile="0\.5"\} 50', text)
+        assert "q_count 100" in text
+
+
+# -- tracing ------------------------------------------------------------
+
+
+class TestTracer:
+    def test_deterministic_span_ids_under_pinned_seed(self):
+        def run(seed):
+            tr = Tracer(seed=seed)
+            with tr.start_span("a") as a:
+                tr.start_span("b", parent=a).end()
+            return [(s.name, s.trace_id, s.span_id)
+                    for s in tr.finished_spans()]
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)
+
+    def test_explicit_context_handoff_across_threads(self):
+        tr = Tracer(seed=1)
+        root = tr.start_span("root")
+        ctx = root.context
+        done = []
+
+        def worker():
+            child = tr.start_span("child", parent=ctx)
+            child.end()
+            done.append(child)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        root.end()
+        assert done[0].trace_id == root.trace_id
+        assert done[0].parent_id == root.span_id
+
+    def test_disabled_tracer_is_noop(self):
+        tr = Tracer(enabled=False)
+        span = tr.start_span("x")
+        span.set_attr("a", 1).add_event("e").end()
+        assert tr.finished_spans() == []
+
+    def test_jsonl_sink_bounded_rotation(self, tmp_path):
+        path = tmp_path / "spans.jsonl"
+        sink = JsonlSink(path, max_bytes=2000)
+        tr = Tracer(seed=3, sink=sink)
+        for i in range(200):
+            tr.event("e", attrs={"i": i})
+        sink.close()
+        assert sink.rotations > 0
+        assert os.path.getsize(path) <= 2000
+        assert os.path.getsize(str(path) + ".1") <= 2000
+        for line in open(path):
+            assert json.loads(line)["name"] == "e"
+
+    def test_span_error_status_on_exception(self):
+        tr = Tracer(seed=5)
+        with pytest.raises(RuntimeError):
+            with tr.start_span("boom"):
+                raise RuntimeError("x")
+        (span,) = tr.finished_spans()
+        assert span.status == "error"
+        assert span.attrs["error_type"] == "RuntimeError"
+
+
+# -- resilience-primitive tracing --------------------------------------
+
+
+class TestResilienceTracing:
+    def test_checkpoint_retry_breaker_events(self, tmp_path):
+        from deeplearning4j_tpu.resilience import (
+            CheckpointManager,
+            CircuitBreaker,
+            RetryPolicy,
+            retry_call,
+        )
+        from deeplearning4j_tpu.exceptions import (
+            RetryExhaustedException,
+        )
+
+        tracer = Tracer(seed=11)
+        prev = set_global_tracer(tracer)
+        try:
+            net = _small_net()
+            mgr = CheckpointManager(tmp_path / "ckpt")
+            mgr.save(net)
+            mgr.restore_latest()
+
+            def always_fails():
+                raise OSError("flaky")
+
+            with pytest.raises(RetryExhaustedException):
+                retry_call(always_fails, policy=RetryPolicy(
+                    max_attempts=3, sleep=lambda s: None, seed=1,
+                ))
+
+            clock = {"t": 0.0}
+            br = CircuitBreaker(failure_threshold=1,
+                                reset_timeout=10,
+                                clock=lambda: clock["t"])
+            br.record_failure()         # closed -> open
+            clock["t"] = 11.0
+            assert br.try_acquire()     # open -> half_open (lazy)
+            br.record_success()         # half_open -> closed
+        finally:
+            set_global_tracer(prev)
+        names = [s.name for s in tracer.finished_spans()]
+        assert "checkpoint.save" in names
+        assert "checkpoint.restore" in names
+        assert names.count("retry.attempt") == 2  # attempts 1, 2
+        assert "retry.exhausted" in names
+        transitions = [
+            (s.attrs["from"], s.attrs["to"])
+            for s in tracer.finished_spans()
+            if s.name == "breaker.transition"
+        ]
+        assert transitions == [
+            ("closed", "open"), ("open", "half_open"),
+            ("half_open", "closed"),
+        ]
+
+    def test_profiler_listener_unwritable_log_dir(self, tmp_path):
+        from deeplearning4j_tpu.optimize.profiler import (
+            ProfilerListener,
+        )
+
+        # a log_dir whose parent is a regular FILE can never be
+        # created — fails at construction for any uid
+        blocker = tmp_path / "blocker"
+        blocker.write_text("")
+        with pytest.raises(ValueError, match="log_dir"):
+            ProfilerListener(str(blocker / "sub"))
+        # permission-based unwritability (meaningless for root)
+        if os.geteuid() != 0:
+            ro = tmp_path / "ro"
+            ro.mkdir()
+            os.chmod(ro, 0o555)
+            try:
+                with pytest.raises(ValueError, match="log_dir"):
+                    ProfilerListener(str(ro / "sub"))
+            finally:
+                os.chmod(ro, 0o755)
+
+
+# -- serving trace propagation ------------------------------------------
+
+
+class _StubModel:
+    def output(self, feats):
+        return np.asarray(feats, np.float32) * 2.0
+
+
+class TestServingTraces:
+    def test_one_trace_id_spans_the_batched_request(self, tmp_path):
+        path = tmp_path / "spans.jsonl"
+        tracer = Tracer(seed=1234, sink=JsonlSink(path))
+        s = ModelServer(
+            _StubModel(), workers=2, tracer=tracer,
+            canary=np.zeros((1, 3), np.float32),
+        ).start()
+        try:
+            base = f"http://127.0.0.1:{s.port}"
+            code, body = _post(base, {"features": [[1, 2, 3]]})
+            assert code == 200
+            snap = s.metrics_snapshot()
+        finally:
+            s.stop()
+        recs = [json.loads(line) for line in open(path)]
+        roots = [r for r in recs if r["name"] == "serving.request"]
+        assert len(roots) == 1
+        tid = roots[0]["trace_id"]
+        names = {r["name"] for r in recs if r["trace_id"] == tid}
+        # admission -> queue wait -> batch assembly -> predict, one id
+        assert {"serving.request", "serving.admission",
+                "serving.queue", "serving.batch_assembly",
+                "serving.predict"} <= names
+        # the drain thread ran the predict in batched mode
+        predict = [r for r in recs if r["trace_id"] == tid
+                   and r["name"] == "serving.predict"]
+        assert predict[0]["attrs"]["mode"] == "batched"
+        # and the trace agrees with /metrics
+        assert snap["predictions_total"] == 1
+        assert snap["batched_predictions_total"] == 1
+
+    def test_prometheus_endpoint_parses(self):
+        s = ModelServer(
+            _StubModel(), workers=1,
+            canary=np.zeros((1, 3), np.float32),
+        ).start()
+        try:
+            base = f"http://127.0.0.1:{s.port}"
+            _post(base, {"features": [[1, 2, 3]]})
+            with urllib.request.urlopen(
+                base + "/metrics?format=prometheus", timeout=10
+            ) as r:
+                assert r.headers["Content-Type"].startswith(
+                    "text/plain"
+                )
+                text = r.read().decode()
+            # JSON stays the default
+            with urllib.request.urlopen(
+                base + "/metrics", timeout=10
+            ) as r:
+                snap = json.loads(r.read())
+        finally:
+            s.stop()
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert _SAMPLE_RE.match(line), line
+        m = re.search(r"^predictions_total (\d+)$", text, re.M)
+        assert int(m.group(1)) == snap["predictions_total"] == 1
+        assert "batch_occupancy_rows_bucket" in text
+
+
+# -- telemetry listener -------------------------------------------------
+
+
+class TestTelemetryListener:
+    def test_multilayer_engine_signals(self, rng):
+        net = _small_net()
+        reg = MetricsRegistry()
+        net.listeners.append(TelemetryListener(
+            registry=reg, frequency=1, publish_memory=False,
+        ))
+        ds = _dataset(rng)
+        for _ in range(5):
+            net.fit_minibatch(ds)
+        snap = registry_snapshot(reg)
+        assert snap["training_steps_total"] == 5
+        assert snap["training_examples_total"] == 5 * 16
+        assert np.isfinite(snap["training_loss"])
+        assert snap["training_grad_global_norm"] > 0
+        assert snap["training_step_ms"]["count"] == 4
+
+    def test_distributed_trainer_signals(self, rng):
+        from deeplearning4j_tpu.parallel.trainer import (
+            DistributedTrainer,
+        )
+
+        net = _small_net()
+        reg = MetricsRegistry()
+        net.listeners.append(TelemetryListener(
+            registry=reg, frequency=1, publish_memory=False,
+        ))
+        trainer = DistributedTrainer(net)
+        ds = _dataset(rng)
+        for _ in range(3):
+            trainer.fit_minibatch(ds)
+        snap = registry_snapshot(reg)
+        assert snap["training_steps_total"] == 3
+        assert snap["training_grad_global_norm"] > 0
+
+    def test_telemetry_does_not_change_trajectory(self, rng):
+        ds = _dataset(rng)
+        a, b = _small_net(seed=5), _small_net(seed=5)
+        b.listeners.append(TelemetryListener(
+            registry=MetricsRegistry(), frequency=1,
+            publish_memory=False,
+        ))
+        for _ in range(4):
+            a.fit_minibatch(ds)
+            b.fit_minibatch(ds)
+        for lname in a.params:
+            for pname in a.params[lname]:
+                np.testing.assert_array_equal(
+                    np.asarray(a.params[lname][pname]),
+                    np.asarray(b.params[lname][pname]),
+                )
+
+
+# -- catalog lint -------------------------------------------------------
+
+
+def test_metric_catalog_in_sync():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "lint_metrics.py")],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
