@@ -802,7 +802,7 @@ def bench_word2vec(n_sentences=5000, sent_len=40, vocab=2000) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def bench_resnet50(batch=128, chunk=2, epochs=8) -> dict:
+def bench_resnet50(batch=128, chunk=2, epochs=4) -> dict:
     """ResNet-50 v1 at 224x224x3, pure bf16, momentum SGD — the config
     that can actually saturate the MXU (~12 GFLOP/image fwd+bwd). The
     dataset chunk stays HBM-resident across epochs; images ride to the
@@ -847,7 +847,7 @@ def bench_resnet50(batch=128, chunk=2, epochs=8) -> dict:
 
 def bench_transformer(batch=16, seq=512, vocab=256, d_model=768,
                       n_layers=12, n_heads=12, chunk=4,
-                      epochs=6) -> dict:
+                      epochs=4) -> dict:
     """Decoder-only byte-level LM: d=768, 12 layers, t=512, causal
     flash attention (Pallas kernel on the TPU backend), bf16 compute
     with f32 master weights (Adam needs f32 state). Metric is
@@ -937,7 +937,7 @@ print(json.dumps({"devices": n, "batch": b,
 """
 
 
-def bench_dp_scaling(batch=64, steps=4) -> dict:
+def bench_dp_scaling(batch=64, steps=4, budget_s=None) -> dict:
     """ResNet-50 (CIFAR stem) DP overhead on the 8-device virtual CPU
     mesh. The host serializes all virtual devices onto its core(s), so
     total FLOPs executed per step is what costs time and two ratios
@@ -968,9 +968,12 @@ def bench_dp_scaling(batch=64, steps=4) -> dict:
                 + env.get("PYTHONPATH", "").split(os.pathsep)
             ),
         })
+        timeout = 1800
+        if budget_s is not None:
+            timeout = max(60, min(timeout, int(budget_s)))
         out = subprocess.run(
             [sys.executable, "-c", _DP_CHILD], env=env,
-            capture_output=True, text=True, timeout=1800,
+            capture_output=True, text=True, timeout=timeout,
         )
         if out.returncode != 0:
             raise RuntimeError(f"dp child failed: {out.stderr[-2000:]}")
@@ -1035,6 +1038,35 @@ def bench_serving(budget_s=None) -> dict:
     if out.returncode != 0:
         raise RuntimeError(
             f"bench_serving failed: {out.stderr[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def bench_input_pipeline(budget_s=None) -> dict:
+    """Synchronous vs pipelined (prefetch + async dispatch) training
+    fit on an iterator with nontrivial host-side batch cost, via the
+    standalone A/B script (subprocess — it builds its own nets and
+    trainers). Reports the script's JSON verbatim; the acceptance
+    gates are ``speedup`` > 1 (steps/sec improvement) and
+    ``trajectory_match`` == true (the pipeline never changes what is
+    trained). ``input_stall_fraction`` per mode is the device-idle-
+    on-input proxy."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_training.py",
+    )
+    timeout = 300
+    if budget_s is not None:
+        timeout = max(30, min(timeout, int(budget_s)))
+    out = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        timeout=timeout,
+        env={**os.environ,
+             "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE},
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_training failed: {out.stderr[-2000:]}"
         )
     return json.loads(out.stdout.strip().splitlines()[-1])
 
@@ -1189,6 +1221,12 @@ def bench_observability(iters=300, windows=5) -> dict:
 # ---------------------------------------------------------------------------
 
 
+# Default wall budget: the driver's kill timer matches the 870 s
+# tier-1 budget; leave headroom for interpreter+jax startup, the
+# final JSON, and the `timeout -k` grace window.
+_DEFAULT_BUDGET_S = 600.0
+
+
 class _BenchInterrupted(Exception):
     """SIGTERM/SIGALRM landed: stop the current section and emit the
     partial JSON instead of dying silently under ``timeout -k``."""
@@ -1198,23 +1236,130 @@ def _raise_interrupted(signum, frame):
     raise _BenchInterrupted(f"signal {signum}")
 
 
+def _section_table(budget_fn):
+    """(key, fn, unit) for every section. ``budget_fn()`` -> seconds
+    left (None = unbounded) for the sections that shell out and must
+    cap their own subprocess timeouts."""
+    return [
+        ("lenet_mnist", bench_lenet, "examples/sec/chip"),
+        ("vgg16_cifar10", bench_vgg16, "examples/sec/chip"),
+        ("lstm_char_rnn", bench_lstm_char_rnn, "chars/sec/chip"),
+        ("lstm_saturated", bench_lstm_saturated, "chars/sec/chip"),
+        ("word2vec_sg", bench_word2vec, "words/sec"),
+        ("resnet50_imagenet", bench_resnet50, "examples/sec/chip"),
+        ("transformer_lm", bench_transformer, "tokens/sec/chip"),
+        ("dp_scaling", lambda: bench_dp_scaling(budget_s=budget_fn()),
+         "dp sharding-overhead efficiency, fixed global batch "
+         "(8 virtual cpu devices; 1.0 = zero overhead)"),
+        ("serving_microbatch",
+         lambda: bench_serving(budget_fn()),
+         "batched-vs-solo serving req/s at concurrency 32 "
+         "(scripts/bench_serving.py; speedup >= 4 is the gate)"),
+        ("input_pipeline",
+         lambda: bench_input_pipeline(budget_fn()),
+         "pipelined-vs-synchronous training fit steps/sec "
+         "(scripts/bench_training.py; speedup > 1 and "
+         "trajectory_match are the gates)"),
+        ("observability_overhead", bench_observability,
+         "instrumented vs uninstrumented predict/train hot paths "
+         "(no-op registry/tracer must be <= 5% overhead)"),
+    ]
+
+
+def _shape_entry(key, value, unit, peak) -> dict:
+    """configs[key] payload from a section's raw result dict."""
+    if set(value) == {"error"}:
+        return value
+    if "sharding_overhead_efficiency" in value:
+        eff = value["sharding_overhead_efficiency"]
+        return {"value": eff, "unit": unit, "vs_baseline": eff,
+                "detail": value}
+    if "value" not in value:
+        # sectioned detail payloads (serving / input-pipeline A/Bs)
+        return {"unit": unit, **value}
+    value = dict(value)
+    rate = value.pop("value")
+    entry = {
+        "value": round(rate, 1), "unit": unit,
+        "vs_baseline": round(rate / BASELINES[key], 3),
+    }
+    f_ex = value.pop("flops_per_example", None)
+    if f_ex:
+        achieved = rate * f_ex
+        entry["flops_per_example"] = round(f_ex)
+        entry["achieved_tflops"] = round(achieved / 1e12, 2)
+        if peak:
+            entry["mfu"] = round(achieved / peak, 4)
+    entry.update(value)  # data source, input-pipeline metrics, ...
+    return entry
+
+
+def _child_main(key: str) -> None:
+    """``bench.py --section KEY``: run ONE section in this process
+    and print its raw result dict as one JSON line. The parent runs
+    each section in such a child so a section stuck inside an
+    uninterruptible XLA compile can be SIGKILLed at its time box
+    without taking the final JSON down with it (SIGALRM/SIGTERM only
+    fire between Python bytecodes — a minutes-long C call sails
+    straight through them, which is how BENCH_r05 died at rc=124)."""
+    budget = float(
+        os.environ.get("BENCH_SECTION_BUDGET_S", "0") or 0
+    )
+    t0 = time.monotonic()
+
+    def rem():
+        if budget <= 0:
+            return None
+        return max(budget - (time.monotonic() - t0), 10.0)
+
+    table = {k: fn for k, fn, _ in _section_table(rem)}
+    if key not in table:
+        print(json.dumps({"error": f"unknown section {key!r}"}))
+        return
+    try:
+        value = table[key]()
+    except Exception as e:  # the parent shapes/records this
+        value = {"error": str(e)[:500]}
+    print(json.dumps(value), flush=True)
+
+
 def main() -> None:
+    if "--section" in sys.argv:  # child mode: one section, no boxing
+        _child_main(sys.argv[sys.argv.index("--section") + 1])
+        return
+
     from deeplearning4j_tpu.util.flops import device_peak_flops
 
     peak, device_kind = device_peak_flops()
     configs = {}
-    # BENCH_BUDGET_S: wall budget for the whole run. Each section is
-    # time-boxed to the remaining budget (SIGALRM) and sections that
-    # don't fit are SKIPPED — the run always prints one valid JSON
-    # line with `sections_skipped` instead of dying on the driver's
-    # `timeout -k` (BENCH_r05 rc=124 was exactly that death).
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "0") or 0)
+    # BENCH_BUDGET_S: wall budget for the whole run (default derived
+    # from the ~870 s driver/tier-1 kill timer, minus startup and
+    # final-JSON margin). Every section runs in a KILLABLE child
+    # process under a fair-share time box, so the parent — which
+    # does no jax work — always reaches the final JSON print and
+    # exits 0 before the driver's `timeout -k` fires, whatever a
+    # section does (BENCH_r05 rc=124 was an uninterruptible XLA
+    # compile outliving SIGTERM's grace window in-process).
+    # BENCH_BUDGET_S=0 disables the boxing and runs every section
+    # in-process (the old path; use for unattended full runs).
+    env_budget = os.environ.get("BENCH_BUDGET_S")
+    budget_s = (
+        float(env_budget) if env_budget not in (None, "")
+        else _DEFAULT_BUDGET_S
+    )
     t_start = time.monotonic()
     sections_skipped = []
-    state = {"terminated": False}
+    state = {"terminated": False, "child": None}
+
+    def on_term(signum, frame):
+        state["terminated"] = True
+        child = state["child"]
+        if child is not None:
+            child.kill()
+        raise _BenchInterrupted(f"signal {signum}")
+
     try:  # signals only bind on the main thread
-        signal.signal(signal.SIGTERM, _raise_interrupted)
-        signal.signal(signal.SIGALRM, _raise_interrupted)
+        signal.signal(signal.SIGTERM, on_term)
         on_main = True
     except ValueError:
         on_main = False
@@ -1224,102 +1369,93 @@ def main() -> None:
             return None
         return budget_s - (time.monotonic() - t_start)
 
-    def run_config(key, fn, unit):
-        if state["terminated"]:
-            sections_skipped.append(key)
-            return
-        rem = remaining()
-        if rem is not None and rem <= 5:
-            sections_skipped.append(key)  # budget spent: skip, report
-            return
-        # a failure in one config must never lose the others' numbers
+    def run_child(key, cap) -> dict:
+        env = dict(os.environ)
+        env["BENCH_SECTION_BUDGET_S"] = str(max(cap - 10.0, 15.0))
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", _COMPILE_CACHE)
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--section", key],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env,
+        )
+        state["child"] = child
         try:
-            if rem is not None and on_main:
-                signal.alarm(max(int(rem), 1))
-            try:
-                value = fn()
-            finally:
-                if on_main:
-                    signal.alarm(0)
-        except _BenchInterrupted:
-            # SIGTERM kills the whole run; an expired SIGALRM only
-            # this section — either way the JSON still prints
-            sections_skipped.append(key)
-            configs[key] = {"error": "timed out (BENCH_BUDGET_S)"}
-            if remaining() is not None and remaining() > 5:
-                return  # alarm, not terminate: later sections may fit
-            state["terminated"] = True
-            return
-        except Exception as e:
-            configs[key] = {"error": str(e)[:500]}
-            return
-        if "sharding_overhead_efficiency" in value:
-            eff = value["sharding_overhead_efficiency"]
-            configs[key] = {
-                "value": eff, "unit": unit, "vs_baseline": eff,
-                "detail": value,
-            }
-            return
-        if "value" not in value:
-            # sectioned detail payloads (serving A/B) pass through
-            configs[key] = {"unit": unit, **value}
-            return
-        rate = value.pop("value")
-        entry = {
-            "value": round(rate, 1), "unit": unit,
-            "vs_baseline": round(rate / BASELINES[key], 3),
-        }
-        f_ex = value.pop("flops_per_example", None)
-        if f_ex:
-            achieved = rate * f_ex
-            entry["flops_per_example"] = round(f_ex)
-            entry["achieved_tflops"] = round(achieved / 1e12, 2)
-            if peak:
-                entry["mfu"] = round(achieved / peak, 4)
-        entry.update(value)  # data source, input-pipeline metrics, ...
-        configs[key] = entry
+            out, err = child.communicate(timeout=cap)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.communicate()
+            return {"error": "timed out (section time box under "
+                             "BENCH_BUDGET_S)"}
+        finally:
+            state["child"] = None
+        if child.returncode != 0:
+            return {"error": f"section exited rc={child.returncode}: "
+                             f"{err[-400:]}"}
+        try:
+            return json.loads(out.strip().splitlines()[-1])
+        except Exception:
+            return {"error":
+                    f"unparseable section output: {out[-200:]!r}"}
 
-    sections = [
-        ("lenet_mnist", bench_lenet, "examples/sec/chip"),
-        ("vgg16_cifar10", bench_vgg16, "examples/sec/chip"),
-        ("lstm_char_rnn", bench_lstm_char_rnn, "chars/sec/chip"),
-        ("lstm_saturated", bench_lstm_saturated, "chars/sec/chip"),
-        ("word2vec_sg", bench_word2vec, "words/sec"),
-        ("resnet50_imagenet", bench_resnet50, "examples/sec/chip"),
-        ("transformer_lm", bench_transformer, "tokens/sec/chip"),
-        ("dp_scaling", bench_dp_scaling,
-         "dp sharding-overhead efficiency, fixed global batch "
-         "(8 virtual cpu devices; 1.0 = zero overhead)"),
-        ("serving_microbatch",
-         lambda: bench_serving(remaining()),
-         "batched-vs-solo serving req/s at concurrency 32 "
-         "(scripts/bench_serving.py; speedup >= 4 is the gate)"),
-        ("observability_overhead", bench_observability,
-         "instrumented vs uninstrumented predict/train hot paths "
-         "(no-op registry/tracer must be <= 5% overhead)"),
-    ]
+    sections = _section_table(remaining)
+    # The final JSON is non-negotiable: whatever happens inside the
+    # section loop (SIGTERM, a wedged child, an unexpected error),
+    # the one-line result still prints and the process exits 0 with
+    # whatever sections completed.
     try:
-        for key, fn, unit in sections:
-            run_config(key, fn, unit)
-    except _BenchInterrupted:  # SIGTERM between sections
+        if budget_s <= 0:
+            for key, fn, unit in sections:  # unboxed in-process run
+                try:
+                    configs[key] = _shape_entry(key, fn(), unit, peak)
+                except _BenchInterrupted:
+                    raise
+                except Exception as e:
+                    configs[key] = {"error": str(e)[:500]}
+        else:
+            for i, (key, _fn, unit) in enumerate(sections):
+                rem = remaining()
+                if state["terminated"] or rem <= 15:
+                    sections_skipped.append(key)
+                    continue
+                # fair-share time box: 1.5x this section's even share
+                # of the remaining budget (finishing early donates
+                # slack to later sections) — one slow section cannot
+                # starve everything after it
+                left = len(sections) - i
+                cap = rem if left <= 1 else min(
+                    rem, max(45.0, rem / left * 1.5)
+                )
+                value = run_child(key, cap)
+                if "error" in value and "timed out" in value["error"]:
+                    sections_skipped.append(key)
+                configs[key] = _shape_entry(key, value, unit, peak)
+    except _BenchInterrupted:  # SIGTERM: finish the JSON now
+        pass
+    except BaseException as e:  # noqa: BLE001 — JSON > stack trace
+        configs.setdefault(
+            "run_error", {"error": f"{type(e).__name__}: {e}"[:500]}
+        )
+    finally:
+        if on_main:  # don't let a late signal corrupt the JSON line
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
         done = set(configs) | set(sections_skipped)
         sections_skipped.extend(
             k for k, _, _ in sections if k not in done
         )
-
-    primary = configs.get("lenet_mnist", {})
-    print(json.dumps({
-        "metric": "lenet_mnist_fit_examples_per_sec",
-        "value": primary.get("value"),
-        "unit": "examples/sec/chip",
-        "vs_baseline": primary.get("vs_baseline"),
-        "device": device_kind,
-        "peak_bf16_tflops": peak / 1e12 if peak else None,
-        "budget_s": budget_s or None,
-        "elapsed_s": round(time.monotonic() - t_start, 1),
-        "sections_skipped": sections_skipped,
-        "configs": configs,
-    }))
+        primary = configs.get("lenet_mnist", {})
+        print(json.dumps({
+            "metric": "lenet_mnist_fit_examples_per_sec",
+            "value": primary.get("value"),
+            "unit": "examples/sec/chip",
+            "vs_baseline": primary.get("vs_baseline"),
+            "device": device_kind,
+            "peak_bf16_tflops": peak / 1e12 if peak else None,
+            "budget_s": budget_s or None,
+            "elapsed_s": round(time.monotonic() - t_start, 1),
+            "sections_skipped": sections_skipped,
+            "configs": configs,
+        }), flush=True)
 
 
 if __name__ == "__main__":

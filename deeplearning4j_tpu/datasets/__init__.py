@@ -7,6 +7,10 @@ from deeplearning4j_tpu.datasets.api import (  # noqa: F401
     ExistingDataSetIterator,
     ListDataSetIterator,
     MultiDataSet,
+    PlacedDataSet,
+)
+from deeplearning4j_tpu.datasets.prefetch import (  # noqa: F401
+    PrefetchIterator,
 )
 from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     AsyncDataSetIterator,

@@ -146,6 +146,41 @@ class ChunkedDataSet:
 
 
 @dataclass
+class PlacedDataSet:
+    """A minibatch that has already been materialized, cast, and
+    placed on device (sharded when a mesh is in play) by an input
+    pipeline — the payload ``datasets.prefetch.PrefetchIterator``
+    hands the engines so the host->device scatter happens on the
+    prefetch thread, off the step's critical path.
+
+    ``features``/``labels``/masks are device arrays (or, for the DAG
+    engine, lists of per-slot device arrays) in exactly the layout the
+    consumer's placement function produced; consumers that receive one
+    skip their own placement. ``num_rows`` is the count of VALID
+    examples — when a trailing partial batch was padded up to the
+    data-parallel degree, ``num_rows`` is the pre-padding size (the
+    honest examples/sec signal) while the arrays carry the padded
+    rows, masked out of the loss. ``has_masks`` caches whether any
+    mask rides along (the trainer's step choice needs it without
+    re-walking graph mask lists)."""
+
+    features: object
+    labels: object
+    features_mask: object = None
+    labels_mask: object = None
+    num_rows: Optional[int] = None
+    has_masks: Optional[bool] = None
+
+    def num_examples(self) -> int:
+        if self.num_rows is not None:
+            return int(self.num_rows)
+        first = self.features
+        if isinstance(first, (list, tuple)):
+            first = first[0]
+        return int(np.shape(first)[0])
+
+
+@dataclass
 class MultiDataSet:
     """Multi-input/multi-output container (reference nd4j MultiDataSet,
     consumed by ComputationGraph)."""

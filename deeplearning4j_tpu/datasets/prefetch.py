@@ -1,0 +1,161 @@
+"""Prefetching training input pipeline.
+
+The training loop was the last fully synchronous hot path in the
+repo: the device idled while the host materialized the next
+minibatch (CSV parse, augmentation, shard fetch), cast it, and — for
+the distributed trainer — scattered it across the mesh with a
+sharding-aware ``device_put``. The TensorFlow system paper makes
+overlapping input preparation with accelerator compute a first-class
+design requirement (PAPERS.md); this module is that overlap for the
+training tier, the way ``serving/batcher.py`` is for serving.
+
+:class:`PrefetchIterator` wraps any ``DataSetIterator`` with a
+bounded background queue (depth ``queue_depth``, default 2). The
+worker thread does the expensive parts off the critical path:
+
+- **materialization** — ``base.next()`` runs on the worker, so a
+  slow source (decode, network shard read) overlaps device compute;
+- **placement** — an optional ``placement(ds)`` callable runs on the
+  worker too. ``DistributedTrainer.place_minibatch`` is the intended
+  placement: dtype cast + the ``NamedSharding(mesh, P("data"))``
+  scatter that used to run inline in ``fit_minibatch``. The consumer
+  then receives device-resident :class:`PlacedDataSet` batches and
+  the step dispatch never waits on a host->device copy.
+
+Contracts the tier-1 suite enforces:
+
+- **deterministic ordering** — one worker, one FIFO queue: the
+  consumer sees exactly the base iterator's batch order, so a
+  pipelined ``fit`` replays the synchronous trajectory bitwise;
+- **exception propagation** — a worker-thread failure (flaky source,
+  placement error) surfaces on the consumer thread as
+  ``DL4JFaultException`` (original exception chained as
+  ``__cause__``), after every batch fetched before the fault has
+  been delivered — no silent truncation, no lost batches;
+- **clean shutdown** — ``shutdown()`` (also run by ``reset()`` and
+  ``close()``) cancels and joins the worker even when it is blocked
+  on a full queue.
+
+Observability (PR-4 registry; catalogued in ARCHITECTURE.md):
+``training_prefetch_queue_depth`` gauge (batches ready at each
+consumer take) and ``training_prefetch_wait_ms`` histogram (how long
+the consumer stalled for the next batch — the host-bound signal:
+near-zero means the pipeline keeps the device fed, heavy upper
+buckets mean the source is the bottleneck).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+from deeplearning4j_tpu.exceptions import DL4JFaultException
+
+# fine buckets at the bottom (a fed pipeline waits ~0) and coarse at
+# the top (a starved one waits a whole batch-materialization)
+WAIT_MS_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                   250.0, 1000.0)
+
+
+class _PlacingIterator:
+    """Producer-side adapter: run the user's placement on the worker
+    thread so cast + sharded device_put overlap training (same shape
+    as ``_EncodingIterator`` for the device-codec pipeline)."""
+
+    def __init__(self, base: DataSetIterator,
+                 placement: Optional[Callable]):
+        self.base = base
+        self.placement = placement
+
+    def __iter__(self):
+        for ds in self.base:
+            yield self.placement(ds) if self.placement else ds
+
+    def reset(self) -> None:
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+
+class PrefetchIterator(AsyncDataSetIterator):
+    """Bounded background prefetch + optional device placement (see
+    module docstring). Drop-in for any ``DataSetIterator``::
+
+        it = PrefetchIterator(base, queue_depth=2,
+                              placement=trainer.place_minibatch)
+        trainer.fit(it, epochs=3)   # or: trainer.fit(base, prefetch=2)
+
+    Without ``placement`` the worker only materializes host batches —
+    still worthwhile when ``base.next()`` is expensive. With it, the
+    consumer receives :class:`~..api.PlacedDataSet` device batches.
+    """
+
+    def __init__(self, base: DataSetIterator, queue_depth: int = 2,
+                 placement: Optional[Callable] = None,
+                 registry=None):
+        if queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        super().__init__(
+            _PlacingIterator(base, placement), queue_depth
+        )
+        self._user_base = base
+        if registry is None:
+            from deeplearning4j_tpu.observability.metrics import (
+                default_registry,
+            )
+
+            registry = default_registry()
+        self.registry = registry
+        self._depth_gauge = registry.gauge(
+            "training_prefetch_queue_depth",
+            help="prefetched batches ready at the last consumer take",
+        )._default()
+        self._wait_hist = registry.histogram(
+            "training_prefetch_wait_ms", buckets=WAIT_MS_BUCKETS,
+            help="consumer stall waiting for the next prefetched "
+                 "batch (ms)",
+        )._default()
+
+    # -- instrumented queue take ---------------------------------------
+
+    def _advance(self) -> None:
+        t0 = time.perf_counter()
+        super()._advance()
+        self._wait_hist.observe((time.perf_counter() - t0) * 1000.0)
+        q = self._queue
+        if q is not None:
+            self._depth_gauge.set(q.qsize())
+
+    # -- fault taxonomy -------------------------------------------------
+
+    def next(self) -> DataSet:
+        try:
+            return super().next()
+        except (StopIteration, DL4JFaultException):
+            raise
+        except BaseException as e:
+            # a worker-thread fault (source iterator, placement) is a
+            # runtime fault of the input pipeline: surface it in the
+            # resilience taxonomy with the original chained
+            raise DL4JFaultException(
+                f"prefetch pipeline failed: {type(e).__name__}: {e}"
+            ) from e
+
+    def close(self) -> None:
+        """Alias for ``shutdown()`` (context-manager friendly)."""
+        self.shutdown()
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- SPI delegation to the USER base (not the adapter) --------------
+
+    def batch(self) -> int:
+        return self._user_base.batch()
+
+    def total_examples(self) -> int:
+        return self._user_base.total_examples()

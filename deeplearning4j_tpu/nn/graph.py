@@ -74,6 +74,14 @@ class ComputationGraph:
         self._it0_shadow = -1
         self._pretrain_done = False
         self._base_key = jax.random.PRNGKey(conf.seed)
+        # async dispatch knobs (the _fit_batches per-step loop runs
+        # through an AsyncDispatchWindow — the DAG engine's step has
+        # no guard flag, so the window only bounds in-flight steps
+        # and records the step-gap histogram)
+        self.max_in_flight = 2
+        self.guard_lag = None
+        self._dispatch_window = None
+        self._last_batch_rows = None  # host int; examples/sec signal
 
     @property
     def score_value(self) -> float:
@@ -649,22 +657,46 @@ class ComputationGraph:
             return
         if self._fit_epochs_device_cached(iterator, epochs):
             return
-        for epoch in range(epochs):
-            if self._can_scan_steps() and self.scan_chunk > 1:
-                n = self._fit_epoch_scan(iter(iterator))
-            else:
-                n = 0
-                for ds in iter(iterator):
-                    self.fit_minibatch(ds)
-                    n += 1
-            if epoch > 0 and n == 0:
-                raise ValueError(
-                    "Iterator yielded no batches after the first epoch — "
-                    "pass a list or an iterator with reset()"
-                )
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            self.epoch_count += 1
+        from deeplearning4j_tpu.parallel.dispatch import (
+            AsyncDispatchWindow,
+        )
+
+        window = AsyncDispatchWindow(
+            model=self, max_in_flight=self.max_in_flight,
+            guard_lag=self.guard_lag,
+        )
+        try:
+            for epoch in range(epochs):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                if self._can_scan_steps() and self.scan_chunk > 1:
+                    n = self._fit_epoch_scan(iter(iterator))
+                else:
+                    n = 0
+                    self._dispatch_window = window
+                    try:
+                        for ds in iter(iterator):
+                            self.fit_minibatch(ds)
+                            n += 1
+                    finally:
+                        self._dispatch_window = None
+                    window.drain()
+                if epoch > 0 and n == 0:
+                    raise ValueError(
+                        "Iterator yielded no batches after the first "
+                        "epoch — pass a list or an iterator with "
+                        "reset()"
+                    )
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch_count += 1
+        except BaseException:
+            window.abandon()
+            raise
 
     def fit_minibatch(self, ds) -> float:
         from deeplearning4j_tpu.datasets.api import ChunkedDataSet
@@ -714,6 +746,7 @@ class ComputationGraph:
             x.ndim == 3 and x.shape[2] > fwd for x in inputs
         ):
             return self._fit_tbptt(inputs, labels, lmasks, fmasks)
+        self._last_batch_rows = int(inputs[0].shape[0])
         score = None
         for _ in range(self.conf.iterations):
             lrs = self.updater_def.scheduled_lrs(self.iteration_count)
@@ -729,6 +762,8 @@ class ComputationGraph:
             )
             self.iteration_count += 1
             self._last_score = score  # device array; sync deferred
+            if self._dispatch_window is not None:
+                self._dispatch_window.push(score)
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count)
             self._reset_recurrent_state()

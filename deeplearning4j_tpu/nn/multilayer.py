@@ -319,6 +319,14 @@ class MultiLayerNetwork:
         # host applies skip/rollback policy; forces the per-step path
         # (the fused scan cannot consult the guard mid-dispatch)
         self.divergence_guard = None
+        # async dispatch knobs (the _fit_batches per-step loop runs
+        # through an AsyncDispatchWindow): at most max_in_flight
+        # steps dispatched-but-incomplete; the guard's ok-flag is
+        # collected guard_lag steps late (None -> max_in_flight;
+        # rollback policy forces 0 — see parallel/dispatch.py)
+        self.max_in_flight = 2
+        self.guard_lag = None
+        self._dispatch_window = None
         # observability.TelemetryListener (enable_step_telemetry):
         # when set, the jitted step also returns the gradient global
         # L2 norm — one fused scalar, read lazily by the listener
@@ -982,30 +990,49 @@ class MultiLayerNetwork:
             return
         if self._fit_epochs_device_cached(iterator, epochs):
             return
-        for epoch in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            it = iter(iterator)
-            if self._can_scan_steps() and self.scan_chunk > 1:
-                n_batches = self._fit_epoch_scan(it)
-            else:
-                n_batches = 0
-                for ds in it:
-                    self.fit_minibatch(ds)
-                    n_batches += 1
-            if epoch > 0 and n_batches == 0:
-                raise ValueError(
-                    "Iterator yielded no batches after the first epoch — "
-                    "a plain generator cannot be re-iterated; pass a list, "
-                    "a DataSetIterator with reset(), or epochs=1"
-                )
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch_count += 1
+        from deeplearning4j_tpu.parallel.dispatch import (
+            AsyncDispatchWindow,
+        )
+
+        window = AsyncDispatchWindow(
+            model=self, guard_fn=lambda: self.divergence_guard,
+            max_in_flight=self.max_in_flight,
+            guard_lag=self.guard_lag,
+        )
+        try:
+            for epoch in range(epochs):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                it = iter(iterator)
+                if self._can_scan_steps() and self.scan_chunk > 1:
+                    n_batches = self._fit_epoch_scan(it)
+                else:
+                    n_batches = 0
+                    self._dispatch_window = window
+                    try:
+                        for ds in it:
+                            self.fit_minibatch(ds)
+                            n_batches += 1
+                    finally:
+                        self._dispatch_window = None
+                    window.drain()  # guard aborts surface per epoch
+                if epoch > 0 and n_batches == 0:
+                    raise ValueError(
+                        "Iterator yielded no batches after the first "
+                        "epoch — a plain generator cannot be "
+                        "re-iterated; pass a list, a DataSetIterator "
+                        "with reset(), or epochs=1"
+                    )
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch_count += 1
+        except BaseException:
+            window.abandon()  # keep the original exception
+            raise
 
     def _fit_epochs_device_cached(self, iterator, epochs: int) -> bool:
         """Multi-epoch fit over a materialized dataset with the batches
@@ -1205,7 +1232,14 @@ class MultiLayerNetwork:
             score, ok = self._apply_step_out(out)
             self.iteration_count += 1
             self._last_score = score  # device array; sync deferred
-            if guard is not None:
+            window = self._dispatch_window
+            if window is not None:
+                # async path (_fit_batches): bounded in-flight, guard
+                # flag collected guard_lag steps late — the in-jit
+                # select already suppressed a bad update, so the
+                # trajectory is unchanged (parallel/dispatch.py)
+                window.push(score, ok)
+            elif guard is not None:
                 if bool(ok):  # device sync — the cost of supervision
                     guard.good_step()
                 else:

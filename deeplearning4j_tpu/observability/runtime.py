@@ -94,7 +94,7 @@ class TelemetryListener(IterationListener):
       (every iteration; host-only, no device sync);
     - ``training_step_ms`` summary + ``training_examples_per_sec``
       gauge (host wall clock between callbacks);
-    - ``training_loss`` gauge (device sync — gated by ``frequency``);
+    - ``training_loss`` gauge (gated by ``frequency``);
     - ``training_grad_global_norm`` gauge: the in-jit fused scalar
       the engines' telemetry step emits. The listener flips the
       model's ``enable_step_telemetry()`` on first callback; engines
@@ -102,6 +102,18 @@ class TelemetryListener(IterationListener):
       don't publish the gauge;
     - per-device HBM gauges via ``publish_device_memory`` when
       ``publish_memory=True`` and the backend reports memory stats.
+
+    **Batched host reads** (``defer_reads=True``, the default): the
+    sampled device scalars (loss, grad norm) are NOT converted in the
+    callback that sampled them — that ``float()`` would block until
+    the step completes, serializing dispatch against execution
+    (exactly the per-step sync the async fit loop removes). Instead
+    the listener holds the device references and publishes them on
+    the NEXT sampled callback, by which time the step has long
+    retired and the read is a copy, not a stall; ``flush()`` (also
+    run from ``on_epoch_end``) publishes the final pending sample.
+    The published value therefore trails by one sampling interval.
+    ``defer_reads=False`` restores the synchronous read.
 
     Forces the per-step fit path (like ``ProfilerListener``): under
     the fused ``lax.scan`` path all callbacks fire after one chunk
@@ -112,7 +124,8 @@ class TelemetryListener(IterationListener):
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  frequency: int = 1, grad_norm: bool = True,
-                 publish_memory: bool = True):
+                 publish_memory: bool = True,
+                 defer_reads: bool = True):
         self.registry = (
             registry if registry is not None else default_registry()
         )
@@ -146,6 +159,30 @@ class TelemetryListener(IterationListener):
         )._default()
         self._last_time: Optional[float] = None
         self._enabled_on = None
+        self.defer_reads = defer_reads
+        self._pending = None  # (loss_ref, grad_norm_ref) device refs
+
+    def _publish_sample(self, loss_ref, gn_ref) -> None:
+        if loss_ref is not None:
+            try:
+                self._loss.set(float(loss_ref))
+            except Exception:
+                pass
+        if gn_ref is not None:
+            try:
+                self._grad_norm.set(float(gn_ref))
+            except Exception:
+                pass
+
+    def flush(self) -> None:
+        """Publish the pending deferred sample (epoch end / end of
+        fit)."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._publish_sample(*pending)
+
+    def on_epoch_end(self, model) -> None:
+        self.flush()
 
     def iteration_done(self, model, iteration: int) -> None:
         now = time.perf_counter()
@@ -172,16 +209,16 @@ class TelemetryListener(IterationListener):
         self._last_time = now
         if iteration % self.frequency != 0:
             return
-        # below the line: device syncs, gated by frequency
-        try:
-            self._loss.set(float(model.score_value))
-        except Exception:
-            pass
-        gn = getattr(model, "_last_grad_norm", None)
-        if gn is not None:
-            try:
-                self._grad_norm.set(float(gn))
-            except Exception:
-                pass
+        # below the line: sampled device scalars, gated by frequency
+        loss_ref = getattr(model, "_last_score", None)
+        gn_ref = getattr(model, "_last_grad_norm", None)
+        if self.defer_reads:
+            # publish LAST sample's refs (long since completed — the
+            # read is a copy, not a pipeline stall), park this one
+            pending, self._pending = self._pending, (loss_ref, gn_ref)
+            if pending is not None:
+                self._publish_sample(*pending)
+        else:
+            self._publish_sample(loss_ref, gn_ref)
         if self.publish_memory:
             publish_device_memory(self.registry)

@@ -43,6 +43,9 @@ from deeplearning4j_tpu.parallel.sequence import (  # noqa: F401
     ring_attention,
     ring_self_attention_sharded,
 )
+from deeplearning4j_tpu.parallel.dispatch import (  # noqa: F401
+    AsyncDispatchWindow,
+)
 from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
     DistributedTrainer,
     default_partition_rules,

@@ -1,0 +1,158 @@
+"""Bounded async step dispatch for the training hot loop.
+
+JAX dispatch is asynchronous by construction — a jitted train step
+returns device futures immediately — but the fit loops used to
+serialize it right back with per-step host syncs: the divergence
+guard's ``bool(ok)`` round-trips every step, and a listener reading
+``score_value`` blocks until the step completes. The Julia-to-TPU
+paper (PAPERS.md) identifies exactly these per-step host round-trips
+as what keeps an XLA device from saturating.
+
+:class:`AsyncDispatchWindow` is the fix, shared by
+``DistributedTrainer.fit``, ``MultiLayerNetwork`` and
+``ComputationGraph`` ``_fit_batches``:
+
+- **bounded in-flight**: at most ``max_in_flight`` steps may be
+  dispatched-but-incomplete; past that the window blocks on the
+  OLDEST step's score (``jax.block_until_ready`` — a completion
+  wait, not a value transfer), so host runahead cannot queue
+  unbounded device work or pin unbounded batch memory.
+- **lagged guard collection**: the guard's ok-flag for step *i* is
+  read back at step *i + guard_lag* instead of immediately. This is
+  safe because the in-jit ``select_updates`` already suppressed the
+  bad update — the trajectory is bitwise identical whether the host
+  learns about the bad step now or K steps later (tier-1-asserted).
+  What shifts by up to K steps is host-side *policy*: skip counters
+  and the ``max_consecutive`` abort. The ``rollback`` policy restores
+  a checkpoint — state the next K steps would have consumed — so it
+  forces ``guard_lag = 0`` (synchronous consult, exactly the
+  pre-window behavior).
+- **step-gap histogram**: ``training_step_gap_ms`` records the host
+  wall-clock between consecutive dispatches — together with
+  ``training_prefetch_wait_ms`` it answers "host-bound or
+  device-bound?" from ``/metrics`` alone.
+
+``drain()`` collects every outstanding flag and completion (epoch
+boundaries, end of fit); ``abandon()`` drops them without consulting
+the guard (exception unwind — never raise a guard abort while
+another exception is in flight).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional
+
+import time
+
+GAP_MS_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                  1000.0)
+
+
+class AsyncDispatchWindow:
+    """One fit-loop's dispatch window. ``guard_fn`` returns the
+    currently-installed DivergenceGuard (or None) so a listener
+    flipping the guard mid-fit is honored; ``on_restore`` runs after
+    a rollback (the distributed trainer re-places params on its
+    mesh)."""
+
+    def __init__(self, model=None,
+                 guard_fn: Optional[Callable] = None,
+                 on_restore: Optional[Callable] = None,
+                 max_in_flight: int = 2,
+                 guard_lag: Optional[int] = None,
+                 registry=None):
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        if guard_lag is not None and guard_lag < 0:
+            raise ValueError("guard_lag must be >= 0")
+        self.model = model
+        self.guard_fn = guard_fn or (lambda: None)
+        self.on_restore = on_restore
+        self.max_in_flight = int(max_in_flight)
+        self.guard_lag = guard_lag
+        self._flags: deque = deque()     # uncollected guard ok-flags
+        self._inflight: deque = deque()  # unretired step scores
+        self._last_dispatch: Optional[float] = None
+        if registry is None:
+            from deeplearning4j_tpu.observability.metrics import (
+                default_registry,
+            )
+
+            registry = default_registry()
+        self._gap_hist = registry.histogram(
+            "training_step_gap_ms", buckets=GAP_MS_BUCKETS,
+            help="host wall-clock between consecutive step "
+                 "dispatches (ms)",
+        )._default()
+
+    # -- per-step -------------------------------------------------------
+
+    def _effective_lag(self, guard) -> int:
+        if guard is not None and getattr(guard, "policy", None) == \
+                "rollback":
+            # rollback restores checkpoint state the next steps would
+            # consume: exactness requires the synchronous consult
+            return 0
+        if self.guard_lag is not None:
+            return self.guard_lag
+        return self.max_in_flight
+
+    def push(self, score, ok=None) -> None:
+        """Record one dispatched step: ``score`` (device scalar, used
+        only as a completion handle) and the guard's ``ok`` flag
+        (device bool, or None when no guard rode the step)."""
+        now = time.perf_counter()
+        if self._last_dispatch is not None:
+            self._gap_hist.observe((now - self._last_dispatch) * 1e3)
+        self._last_dispatch = now
+        guard = self.guard_fn()
+        if ok is not None and guard is not None:
+            self._flags.append(ok)
+            lag = self._effective_lag(guard)
+            while len(self._flags) > lag:
+                self._consult(self._flags.popleft(), guard)
+        if score is not None:
+            self._inflight.append(score)
+            while len(self._inflight) > self.max_in_flight:
+                self._retire(self._inflight.popleft())
+
+    # -- internals ------------------------------------------------------
+
+    def _consult(self, ok, guard) -> None:
+        if bool(ok):  # the (amortized) device sync
+            guard.good_step()
+        else:
+            guard.bad_step(self.model, on_restore=self.on_restore)
+
+    @staticmethod
+    def _retire(score) -> None:
+        import jax
+
+        jax.block_until_ready(score)
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        """Uncollected guard flags + unretired steps (introspection)."""
+        return len(self._flags) + len(self._inflight)
+
+    def drain(self) -> None:
+        """Collect every outstanding guard flag and block until all
+        in-flight steps complete. May raise ``DL4JFaultException``
+        (the guard's max_consecutive abort, surfaced at the epoch
+        boundary instead of mid-window)."""
+        guard = self.guard_fn()
+        while self._flags:
+            ok = self._flags.popleft()
+            if guard is not None:
+                self._consult(ok, guard)
+        while self._inflight:
+            self._retire(self._inflight.popleft())
+
+    def abandon(self) -> None:
+        """Drop outstanding work without consulting the guard — the
+        exception-unwind path."""
+        self._flags.clear()
+        self._inflight.clear()

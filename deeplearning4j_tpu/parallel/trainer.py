@@ -101,7 +101,9 @@ class DistributedTrainer:
                  tensor_parallel: bool = False,
                  partition_rules=default_partition_rules,
                  batch_stats: str = "auto",
-                 divergence_guard=None):
+                 divergence_guard=None,
+                 max_in_flight: int = 2,
+                 guard_lag: Optional[int] = None):
         """``batch_stats`` picks the data-parallel batch-statistics
         semantics:
 
@@ -144,6 +146,13 @@ class DistributedTrainer:
         # skips or rolls back to the last checkpoint. Reading the
         # ok-flag synchronizes per step.
         self.divergence_guard = divergence_guard
+        # async dispatch (fit loop only; fit_minibatch called directly
+        # keeps the synchronous per-step consult): at most
+        # max_in_flight steps dispatched-but-incomplete, guard flags
+        # collected guard_lag steps late (None -> max_in_flight;
+        # rollback policy forces 0 — see parallel/dispatch.py)
+        self.max_in_flight = max(int(max_in_flight), 1)
+        self.guard_lag = guard_lag
         self._is_graph = hasattr(model.conf, "vertices")
         if model.params is None:
             model.init()
@@ -473,20 +482,107 @@ class DistributedTrainer:
             donate_argnums=(0, 1, 2),
         )
 
-    # -- public API -----------------------------------------------------
+    # -- input placement ------------------------------------------------
 
-    def fit(self, iterator, epochs: int = 1) -> None:
-        m = self.model
-        for _ in range(epochs):
-            n = 0
-            for ds in iter(iterator):
-                self.fit_minibatch(ds)
-                n += 1
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            m.epoch_count += 1
+    def _pad_rows(self, a, pad: int):
+        """Pad ``pad`` zero rows onto axis 0 (host-side; runs before
+        placement so the padded batch transfers as one array)."""
+        a = np.asarray(a)
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
 
-    def fit_minibatch(self, ds) -> float:
+    def _pad_minibatch(self, ds, batch_n: int, n_data: int):
+        """Pad-and-mask a trailing partial batch up to the next
+        multiple of the data-parallel degree (the training analog of
+        serving's ``output_padded`` masking trick): features/labels
+        gain zero rows, and a labels mask zeroes the padding out of
+        the loss — ``losses.score`` divides by the mask sum, so score
+        and gradients equal the unpadded batch's exactly, and the
+        epoch-end remnant trains instead of raising.
+
+        Batch-coupled layers are the one exception: padding rows
+        would enter BatchNormalization's batch statistics, so those
+        configs keep the explicit error."""
+        from deeplearning4j_tpu.datasets.api import (
+            DataSet, MultiDataSet,
+        )
+
+        if self._uses_batch_statistics():
+            raise ValueError(
+                f"Batch size {batch_n} is not divisible by the data-"
+                f"parallel degree {n_data}, and this model uses batch "
+                "statistics (BatchNormalization) — zero padding rows "
+                "would corrupt the batch stats. Drop or regroup the "
+                "trailing partial batch."
+            )
+        pad = n_data - batch_n % n_data
+
+        def mask_ones(labels):
+            y = np.asarray(labels)
+            # per-row loss mask: [b] for 2-d labels, [b, t] for
+            # sequence labels (matches losses._to_row_mask)
+            if y.ndim == 3:
+                return np.ones((y.shape[0], y.shape[2]), np.float32)
+            return np.ones((y.shape[0],), np.float32)
+
+        def padded(v, make_mask_from=None):
+            if v is None:
+                if make_mask_from is None:
+                    return None
+                v = mask_ones(make_mask_from)
+            return self._pad_rows(v, pad)
+
+        if self._is_graph:
+            def aslist(v):
+                if v is None:
+                    return None
+                return list(v) if isinstance(v, (list, tuple)) else [v]
+
+            feats = aslist(ds.features)
+            labels = aslist(ds.labels)
+            lmasks = aslist(getattr(ds, "labels_masks", None)
+                            or getattr(ds, "labels_mask", None))
+            fmasks = aslist(getattr(ds, "features_masks", None)
+                            or getattr(ds, "features_mask", None))
+            lmasks = lmasks or [None] * len(labels)
+            fmasks = fmasks or [None] * len(feats)
+            return MultiDataSet(
+                features=[padded(f) for f in feats],
+                labels=[padded(y) for y in labels],
+                # every output slot gets a mask so each padded row is
+                # excluded from each output's loss term
+                labels_masks=[
+                    padded(m, make_mask_from=y)
+                    for m, y in zip(lmasks, labels)
+                ],
+                features_masks=(
+                    None
+                    if all(m is None for m in fmasks)
+                    else [padded(m) for m in fmasks]
+                ),
+            )
+        return DataSet(
+            features=padded(ds.features),
+            labels=padded(ds.labels),
+            labels_mask=padded(
+                getattr(ds, "labels_mask", None),
+                make_mask_from=ds.labels,
+            ),
+            features_mask=padded(getattr(ds, "features_mask", None)),
+        )
+
+    def place_minibatch(self, ds):
+        """Materialize, pad-and-mask (trailing partial batches), cast,
+        and scatter one minibatch onto the mesh with the ``data``
+        sharding. This is the host work ``fit_minibatch`` used to do
+        inline; ``PrefetchIterator(base, placement=trainer.
+        place_minibatch)`` runs it on the prefetch thread instead, so
+        the step dispatch never waits on a host->device copy.
+        Idempotent: an already-placed batch passes through."""
+        from deeplearning4j_tpu.datasets.api import PlacedDataSet
+
+        if isinstance(ds, PlacedDataSet):
+            return ds
         m = self.model
         dtype = jnp.dtype(m.conf.dtype)
         # Place batch arrays WITH the data sharding (the scatter
@@ -498,12 +594,9 @@ class DistributedTrainer:
         first = ds.features
         if isinstance(first, (list, tuple)):
             first = first[0]
-        batch_n = np.shape(first)[0]
+        batch_n = int(np.shape(first)[0])
         if batch_n % n_data != 0:
-            raise ValueError(
-                f"Batch size {batch_n} must be divisible by the data-"
-                f"parallel degree {n_data}"
-            )
+            ds = self._pad_minibatch(ds, batch_n, n_data)
 
         def _put(a):
             # host arrays go to device_put directly so each shard is
@@ -531,6 +624,9 @@ class DistributedTrainer:
                            or getattr(ds, "labels_mask", None))
             fmask = _aslist(getattr(ds, "features_masks", None)
                             or getattr(ds, "features_mask", None))
+            has_masks = any(
+                a is not None for a in (mask or []) + (fmask or [])
+            )
         else:
             x = _put(ds.features)
             y = _put(ds.labels)
@@ -538,12 +634,93 @@ class DistributedTrainer:
             fmask = getattr(ds, "features_mask", None)
             mask = _put(mask) if mask is not None else None
             fmask = _put(fmask) if fmask is not None else None
-        has_masks = mask is not None or fmask is not None
-        if self._is_graph:
-            has_masks = any(
-                a is not None for a in (mask or []) + (fmask or [])
+            has_masks = mask is not None or fmask is not None
+        return PlacedDataSet(
+            features=x, labels=y, labels_mask=mask,
+            features_mask=fmask, num_rows=batch_n,
+            has_masks=has_masks,
+        )
+
+    # -- public API -----------------------------------------------------
+
+    def fit(self, iterator, epochs: int = 1,
+            prefetch: Optional[int] = None) -> list:
+        """Fit ``epochs`` passes of ``iterator``, pipelined: batch
+        materialization + sharded placement can run on a prefetch
+        thread (``prefetch=N`` wraps the iterator in a depth-N
+        ``PrefetchIterator`` with this trainer's placement; an
+        already-wrapped iterator is used as-is), and dispatch runs
+        through an ``AsyncDispatchWindow`` — up to ``max_in_flight``
+        steps in flight, guard flags collected ``guard_lag`` steps
+        late. The trajectory is bitwise identical to the synchronous
+        per-step loop (tier-1-asserted on both engines).
+
+        Returns the per-epoch mean scores (one float per epoch; the
+        single device sync per epoch happens at the epoch boundary).
+        ``iterator.reset()`` runs in a ``finally`` per epoch, so an
+        exception that unwinds mid-epoch leaves the iterator rewound
+        and a retried epoch starts from the top, not mid-stream."""
+        from deeplearning4j_tpu.parallel.dispatch import (
+            AsyncDispatchWindow,
+        )
+
+        m = self.model
+        source = iterator
+        owned_prefetch = None
+        if prefetch is not None and int(prefetch) > 0:
+            from deeplearning4j_tpu.datasets.prefetch import (
+                PrefetchIterator,
             )
-        step = self._step_for(has_masks)
+
+            if not isinstance(iterator, PrefetchIterator):
+                source = owned_prefetch = PrefetchIterator(
+                    iterator, queue_depth=int(prefetch),
+                    placement=self.place_minibatch,
+                )
+        window = AsyncDispatchWindow(
+            model=m, guard_fn=lambda: self.divergence_guard,
+            on_restore=self._place_params,
+            max_in_flight=self.max_in_flight,
+            guard_lag=self.guard_lag,
+        )
+        epoch_scores = []
+        try:
+            for _ in range(epochs):
+                for listener in m.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(m)
+                scores = []
+                try:
+                    for ds in iter(source):
+                        scores.append(
+                            self.fit_minibatch(ds, _window=window)
+                        )
+                    window.drain()  # guard aborts surface here
+                finally:
+                    if hasattr(source, "reset"):
+                        source.reset()
+                epoch_scores.append(
+                    float(jnp.mean(jnp.stack(scores)))
+                    if scores else float("nan")
+                )
+                for listener in m.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(m)
+                m.epoch_count += 1
+        except BaseException:
+            window.abandon()  # keep the original exception
+            raise
+        finally:
+            if owned_prefetch is not None:
+                owned_prefetch.shutdown()
+        return epoch_scores
+
+    def fit_minibatch(self, ds, _window=None) -> float:
+        m = self.model
+        placed = self.place_minibatch(ds)
+        x, y = placed.features, placed.labels
+        mask, fmask = placed.labels_mask, placed.features_mask
+        step = self._step_for(bool(placed.has_masks))
         lrs = m.updater_def.scheduled_lrs(m.iteration_count)
         t = jnp.asarray(m.iteration_count + 1, jnp.float32)
         rng = jax.random.fold_in(m._base_key, m.iteration_count)
@@ -560,10 +737,14 @@ class DistributedTrainer:
             m._last_grad_norm = out[i]  # device scalar; lazy
             i += 1
         ok = out[i] if guard is not None else None
-        m._last_batch_rows = batch_n  # examples/sec signal
+        m._last_batch_rows = placed.num_rows  # examples/sec signal
         m.iteration_count += 1
         m.score_value = score  # lazy; reading syncs
-        if guard is not None:
+        if _window is not None:
+            # async path (fit): flag collected guard_lag steps late,
+            # completion awaited max_in_flight steps late
+            _window.push(score, ok)
+        elif guard is not None:
             if bool(ok):  # device sync — the cost of supervision
                 guard.good_step()
             else:

@@ -129,9 +129,13 @@ class ParallelWrapper:
     def fit(self, iterator, epochs: int = 1) -> None:
         """Each averaging round consumes ``workers`` minibatches — one
         per replica (reference: MagicQueue distributing batches across
-        device queues)."""
-        from deeplearning4j_tpu.datasets.iterators import (
-            AsyncDataSetIterator,
+        device queues). Prefetch rides the shared training input
+        pipeline (``datasets.prefetch.PrefetchIterator``): host
+        materialization overlaps the replica rounds, worker-thread
+        faults surface as ``DL4JFaultException``, and the queue-depth
+        / prefetch-wait signals land in the metrics registry."""
+        from deeplearning4j_tpu.datasets.prefetch import (
+            PrefetchIterator,
         )
 
         m = self.model
@@ -140,27 +144,33 @@ class ParallelWrapper:
             self._jit_replica_step = self._build_replica_step()
             self._jit_average = self._build_average()
         dtype = jnp.dtype(m.conf.dtype)
-        source = (
-            AsyncDataSetIterator(iterator, self.prefetch_buffer)
-            if self.prefetch_buffer > 0 and hasattr(iterator, "has_next")
-            else iterator
-        )
-        for _ in range(epochs):
-            buf = []
-            for ds in iter(source):
-                buf.append(ds)
-                if len(buf) == self.workers:
+        owned_prefetch = None
+        source = iterator
+        if self.prefetch_buffer > 0 and hasattr(iterator, "has_next"):
+            source = owned_prefetch = PrefetchIterator(
+                iterator, queue_depth=self.prefetch_buffer,
+            )
+        try:
+            for _ in range(epochs):
+                buf = []
+                for ds in iter(source):
+                    buf.append(ds)
+                    if len(buf) == self.workers:
+                        self._round(buf, dtype)
+                        buf = []
+                # trailing partial round: recycle batches to fill
+                # workers
+                if buf:
+                    orig = len(buf)
+                    while len(buf) < self.workers:
+                        buf.append(buf[len(buf) % orig])
                     self._round(buf, dtype)
-                    buf = []
-            # trailing partial round: recycle batches to fill workers
-            if buf:
-                orig = len(buf)
-                while len(buf) < self.workers:
-                    buf.append(buf[len(buf) % orig])
-                self._round(buf, dtype)
-            if hasattr(source, "reset"):
-                source.reset()
-            m.epoch_count += 1
+                if hasattr(source, "reset"):
+                    source.reset()
+                m.epoch_count += 1
+        finally:
+            if owned_prefetch is not None:
+                owned_prefetch.shutdown()
         self._sync_model()
 
     def _stack_batches(self, batches, get, dtype):

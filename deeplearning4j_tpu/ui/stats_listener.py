@@ -88,10 +88,23 @@ class StatsListener(IterationListener):
                  collect_histograms: bool = False,
                  session_id: Optional[str] = None,
                  worker_id: str = "worker-0",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 collect_param_stats: bool = True,
+                 defer_score_read: bool = True):
+        """``collect_param_stats=False`` drops the per-sample param
+        reads (mean magnitudes, update deltas, histograms) — those
+        ``np.asarray`` calls block until the sampled step completes,
+        which serializes the async fit loop's dispatch; without them
+        (and with ``defer_score_read``, which publishes the sampled
+        score one sampled callback LATE, when its step has already
+        retired) the listener forces no per-step device sync at
+        all."""
         self.storage = storage
         self.frequency = max(int(frequency), 1)
         self.collect_histograms = collect_histograms
+        self.collect_param_stats = collect_param_stats
+        self.defer_score_read = defer_score_read
+        self._pending_report = None  # (StatsReport, score_ref)
         self.session_id = session_id or uuid.uuid4().hex[:12]
         self.worker_id = worker_id
         # shared metrics substrate: the same signals the StatsReport
@@ -164,38 +177,74 @@ class StatsListener(IterationListener):
             )
         params = model.params
         update_mags = {}
-        if self._prev_params is not None:
-            for lname, lp in params.items():
-                for pname, arr in lp.items():
-                    prev = self._prev_params[lname][pname]
-                    update_mags[f"{lname}_{pname}"] = float(
-                        np.mean(np.abs(np.asarray(arr) - prev))
-                    )
-        self._prev_params = {
-            ln: {pn: np.asarray(a) for pn, a in lp.items()}
-            for ln, lp in params.items()
-        }
+        param_mags = {}
+        histograms = {}
+        if self.collect_param_stats:
+            # these np.asarray reads block until the sampled step
+            # completes — the price of param introspection (they must
+            # run before the next dispatch donates these buffers)
+            if self._prev_params is not None:
+                for lname, lp in params.items():
+                    for pname, arr in lp.items():
+                        prev = self._prev_params[lname][pname]
+                        update_mags[f"{lname}_{pname}"] = float(
+                            np.mean(np.abs(np.asarray(arr) - prev))
+                        )
+            self._prev_params = {
+                ln: {pn: np.asarray(a) for pn, a in lp.items()}
+                for ln, lp in params.items()
+            }
+            param_mags = _mean_magnitudes(params)
+            if self.collect_histograms:
+                histograms = _histograms(params)
         maxrss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        self._score_gauge.set(float(model.score_value))
         self._iter_gauge.set(iteration)
         self._rss_gauge.set(maxrss_kb / 1024.0)
         rec = StatsReport(
             session_id=self.session_id, worker_id=self.worker_id,
             timestamp=now_ms(), iteration=iteration,
-            score=float(model.score_value),
+            score=float("nan"),  # filled at publish time
             duration_ms=duration_ms,
             memory={
                 "host_rss_mb": maxrss_kb / 1024.0,
                 "pid": float(os.getpid()),
             },
             learning_rates=lrs,
-            param_mean_magnitudes=_mean_magnitudes(params),
+            param_mean_magnitudes=param_mags,
             update_mean_magnitudes=update_mags,
-            param_histograms=(
-                _histograms(params) if self.collect_histograms else {}
-            ),
+            param_histograms=histograms,
         )
+        score_ref = getattr(model, "_last_score", None)
+        if self.defer_score_read:
+            # publish the PREVIOUS sampled report now (its score ref
+            # completed long ago — reading it is a copy, not a
+            # dispatch stall), park this one until the next sample
+            # or flush()/on_epoch_end
+            pending = self._pending_report
+            self._pending_report = (rec, score_ref)
+            if pending is not None:
+                self._publish(*pending)
+        else:
+            self._publish(rec, score_ref)
+
+    def _publish(self, rec, score_ref) -> None:
+        try:
+            score = float(score_ref)
+        except Exception:
+            score = float("nan")
+        rec.score = score
+        self._score_gauge.set(score)
         self.storage.put_update(rec)
+
+    def flush(self) -> None:
+        """Publish the pending deferred report (epoch end / end of
+        fit)."""
+        pending, self._pending_report = self._pending_report, None
+        if pending is not None:
+            self._publish(*pending)
+
+    def on_epoch_end(self, model) -> None:
+        self.flush()
 
 
 class J7StatsListener(StatsListener):

@@ -20,13 +20,18 @@ echo "chaos seed: ${DL4J_TPU_CHAOS_SEED}"
 # before the chaos suite spends a second (see scripts/lint_metrics.py).
 python scripts/lint_metrics.py
 # Registered chaos suites:
-#   tests/test_resilience.py — training runtime (retry/checkpoint/guard)
-#   tests/test_serving.py    — serving tier (breaker + fault storms)
-#   tests/test_batching.py   — micro-batch drain loop (seeded storms
-#                              through the batched path: sequential
-#                              determinism + concurrent chunk faults)
+#   tests/test_resilience.py     — training runtime (retry/checkpoint/
+#                                  guard, kill/resume incl. prefetch)
+#   tests/test_serving.py        — serving tier (breaker + fault storms)
+#   tests/test_batching.py       — micro-batch drain loop (seeded storms
+#                                  through the batched path: sequential
+#                                  determinism + concurrent chunk faults)
+#   tests/test_input_pipeline.py — prefetch pipeline (flaky-source
+#                                  storms surface as DL4JFaultException;
+#                                  guarded bad-step trajectory
+#                                  equivalence under async dispatch)
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest tests/test_resilience.py tests/test_serving.py \
-    tests/test_batching.py \
+    tests/test_batching.py tests/test_input_pipeline.py \
     -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -1,0 +1,463 @@
+"""Pipelined training hot path: prefetching input pipeline
+(``datasets/prefetch.py``) + bounded async dispatch
+(``parallel/dispatch.py``).
+
+The load-bearing contract here is TRAJECTORY EQUIVALENCE: pipelining
+may change when the host waits, never what is trained. Params and
+updater state after N steps must be bitwise identical between the
+synchronous per-step loop and the pipelined fit on both engines —
+including with the divergence guard installed and a mid-run
+non-finite step (the in-jit select suppresses the bad update either
+way; the lagged host consult only shifts policy bookkeeping).
+
+Fault-injection tests are marked ``chaos`` (registered in
+``scripts/run_chaos.sh``) but stay fast and CPU-only so the file
+also runs under tier-1.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import conftest
+
+from deeplearning4j_tpu.datasets.api import (
+    DataSet,
+    ListDataSetIterator,
+    PlacedDataSet,
+)
+from deeplearning4j_tpu.datasets.prefetch import PrefetchIterator
+from deeplearning4j_tpu.exceptions import DL4JFaultException
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.parallel import (
+    AsyncDispatchWindow,
+    DistributedTrainer,
+    build_mesh,
+)
+from deeplearning4j_tpu.resilience import ChaosPolicy, DivergenceGuard
+from deeplearning4j_tpu.resilience.chaos import FlakyIterator
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(12345)
+
+
+def make_net(seed=7, updater="ADAM", lr=0.05):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(updater)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def make_graph(seed=2):
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.1)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("d", DenseLayer(n_in=4, n_out=8,
+                                   activation="tanh"), "in")
+        .add_layer("out", OutputLayer(n_in=8, n_out=3), "d")
+        .set_outputs("out")
+        .build()
+    )
+    return ComputationGraph(conf).init()
+
+
+def batches(rng, n_batches=8, batch=8):
+    out = []
+    for _ in range(n_batches):
+        x = rng.randn(batch, 4).astype(np.float32)
+        y = np.eye(3)[rng.randint(0, 3, batch)].astype(np.float32)
+        out.append(DataSet(features=x, labels=y))
+    return out
+
+
+def nan_batch(batch=8):
+    return DataSet(
+        features=np.full((batch, 4), np.nan, np.float32),
+        labels=np.eye(3)[np.zeros(batch, int)].astype(np.float32),
+    )
+
+
+def assert_params_equal(a, b):
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(a.params[ln][pn]),
+                np.asarray(b.params[ln][pn]),
+                err_msg=f"{ln}/{pn}",
+            )
+
+
+def assert_updater_equal(a, b):
+    for ln in a.updater_state:
+        for pn in a.updater_state[ln]:
+            for i, (u, v) in enumerate(
+                zip(a.updater_state[ln][pn], b.updater_state[ln][pn])
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(u), np.asarray(v),
+                    err_msg=f"{ln}/{pn}[{i}]",
+                )
+
+
+class SlowIterator(ListDataSetIterator):
+    """A source with measurable per-batch host cost."""
+
+    def __init__(self, data, delay_s=0.002):
+        super().__init__(data)
+        self.delay_s = delay_s
+        self.served = 0
+
+    def next(self):
+        time.sleep(self.delay_s)
+        self.served += 1
+        return super().next()
+
+
+# -- PrefetchIterator basics -------------------------------------------
+
+
+def test_prefetch_preserves_order_and_count(rng):
+    data = batches(rng, n_batches=12)
+    for depth in (1, 2, 5):
+        it = PrefetchIterator(
+            ListDataSetIterator(data), queue_depth=depth,
+            registry=MetricsRegistry(),
+        )
+        seen = list(it)
+        assert len(seen) == 12
+        for got, want in zip(seen, data):
+            np.testing.assert_array_equal(got.features, want.features)
+        it.shutdown()
+
+
+def test_prefetch_reset_restarts_from_top(rng):
+    data = batches(rng, n_batches=5)
+    it = PrefetchIterator(ListDataSetIterator(data),
+                          registry=MetricsRegistry())
+    first = [it.next() for _ in range(2) if it.has_next()]
+    it.reset()
+    again = list(it)
+    assert len(first) == 2 and len(again) == 5
+    np.testing.assert_array_equal(
+        again[0].features, data[0].features
+    )
+    it.shutdown()
+
+
+def test_prefetch_shutdown_joins_worker(rng):
+    data = batches(rng, n_batches=50)
+    it = PrefetchIterator(
+        SlowIterator(data), queue_depth=2, registry=MetricsRegistry(),
+    )
+    assert it.has_next()  # spins the worker up
+    it.next()
+    it.shutdown()  # mid-stream: must cancel, not deadlock
+    assert it._thread is None
+
+
+def test_prefetch_placement_yields_device_resident_batches(rng):
+    import jax
+
+    net = make_net()
+    tr = DistributedTrainer(net, mesh=build_mesh())
+    data = batches(rng, n_batches=4, batch=16)
+    it = PrefetchIterator(
+        ListDataSetIterator(data), queue_depth=2,
+        placement=tr.place_minibatch, registry=MetricsRegistry(),
+    )
+    seen = list(it)
+    it.shutdown()
+    assert all(isinstance(ds, PlacedDataSet) for ds in seen)
+    for ds in seen:
+        assert isinstance(ds.features, jax.Array)
+        assert ds.num_rows == 16
+    # placement happened with the trainer's batch sharding
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    want = NamedSharding(tr.mesh, P("data"))
+    assert seen[0].features.sharding.is_equivalent_to(
+        want, seen[0].features.ndim
+    )
+
+
+def test_prefetch_metrics_registered(rng):
+    reg = MetricsRegistry()
+    data = batches(rng, n_batches=6)
+    it = PrefetchIterator(ListDataSetIterator(data), queue_depth=2,
+                          registry=reg)
+    list(it)
+    it.shutdown()
+    wait = reg.get("training_prefetch_wait_ms")
+    depth = reg.get("training_prefetch_queue_depth")
+    assert wait is not None and depth is not None
+    assert wait._default().count >= 6  # one observation per take
+
+
+# -- fault propagation (chaos) ------------------------------------------
+
+
+@pytest.mark.chaos
+def test_prefetch_thread_exception_surfaces_as_fault(rng):
+    data = batches(rng, n_batches=5)
+    chaos = ChaosPolicy(fail_calls={"next": {2}})
+    it = PrefetchIterator(
+        FlakyIterator(ListDataSetIterator(data), chaos),
+        queue_depth=2, registry=MetricsRegistry(),
+    )
+    seen = []
+    with pytest.raises(DL4JFaultException) as ei:
+        for ds in it:
+            seen.append(ds)
+    # batches fetched before the fault were delivered, in order
+    assert len(seen) == 2
+    for got, want in zip(seen, data):
+        np.testing.assert_array_equal(got.features, want.features)
+    assert ei.value.__cause__ is not None
+    it.shutdown()
+
+
+@pytest.mark.chaos
+def test_prefetch_chaos_storm_deterministic(rng):
+    """Seeded storm through the pipelined TRAINER fit: the flaky
+    source's fault surfaces as DL4JFaultException out of fit(), the
+    iterator is left rewound (try/finally reset), and a retried
+    epoch trains from the top — bit-identically across two runs."""
+    import os
+
+    seed = int(os.environ.get("DL4J_TPU_CHAOS_SEED", "1337"))
+    data = batches(rng, n_batches=6, batch=16)
+
+    def run():
+        net = make_net()
+        tr = DistributedTrainer(net, mesh=build_mesh())
+        chaos = ChaosPolicy(seed=seed, failure_rate=0.35)
+        flaky = FlakyIterator(ListDataSetIterator(data), chaos)
+        pf = PrefetchIterator(flaky, queue_depth=2,
+                              placement=tr.place_minibatch,
+                              registry=MetricsRegistry())
+        faults = 0
+        for _ in range(6):  # retry the epoch through the storm
+            try:
+                tr.fit(pf, epochs=1)
+            except DL4JFaultException:
+                faults += 1
+                net.iteration_count = 0  # replay from the top
+                net.init()
+                tr._place_params()
+        pf.shutdown()
+        return faults, np.concatenate([
+            np.asarray(a).ravel()
+            for ln in sorted(net.params)
+            for _, a in sorted(net.params[ln].items())
+        ])
+
+    f1, p1 = run()
+    f2, p2 = run()
+    assert f1 == f2 and f1 > 0  # the storm injected, deterministically
+    np.testing.assert_array_equal(p1, p2)
+
+
+# -- trajectory equivalence ---------------------------------------------
+
+
+def test_pipelined_fit_bitwise_equivalent_multilayer(rng):
+    """MLN per-step loop (window active) vs direct fit_minibatch."""
+    data = batches(rng, n_batches=8)
+    sync = make_net()
+    for ds in data:
+        sync.fit_minibatch(ds)
+    piped = make_net()
+    piped.max_in_flight = 3
+
+    class ForcesPerStep:
+        supports_batched_iterations = False
+
+        def iteration_done(self, model, iteration):
+            pass
+
+    piped.listeners.append(ForcesPerStep())
+    piped.fit(ListDataSetIterator(data), epochs=1)
+    assert_params_equal(sync, piped)
+    assert_updater_equal(sync, piped)
+
+
+def test_pipelined_fit_bitwise_equivalent_trainer(rng):
+    """DistributedTrainer: prefetched+async fit vs synchronous
+    fit_minibatch loop, MLN engine, on the full mesh."""
+    conftest.require_devices(2)
+    data = batches(rng, n_batches=8, batch=16)
+    a = make_net()
+    tr_a = DistributedTrainer(a, mesh=build_mesh())
+    for ds in data:
+        tr_a.fit_minibatch(ds)
+    b = make_net()
+    tr_b = DistributedTrainer(b, mesh=build_mesh(), max_in_flight=3)
+    scores = tr_b.fit(ListDataSetIterator(data), epochs=1, prefetch=2)
+    assert len(scores) == 1 and np.isfinite(scores[0])
+    assert_params_equal(a, b)
+    assert_updater_equal(a, b)
+
+
+def test_pipelined_fit_bitwise_equivalent_graph_engine(rng):
+    """Same contract for the DAG engine under the trainer."""
+    conftest.require_devices(2)
+    data = batches(rng, n_batches=6, batch=16)
+    a = make_graph()
+    tr_a = DistributedTrainer(a, mesh=build_mesh())
+    for ds in data:
+        tr_a.fit_minibatch(ds)
+    b = make_graph()
+    tr_b = DistributedTrainer(b, mesh=build_mesh())
+    tr_b.fit(ListDataSetIterator(data), epochs=1, prefetch=2)
+    assert_params_equal(a, b)
+    assert_updater_equal(a, b)
+
+
+@pytest.mark.chaos
+def test_pipelined_fit_guarded_bad_step_equivalent(rng):
+    """The tentpole guarantee: with the divergence guard installed
+    and a mid-run non-finite step, the pipelined fit (prefetch +
+    lagged flag collection) replays the synchronous trajectory
+    bitwise, and the guard still counts the skip."""
+    conftest.require_devices(2)
+    data = batches(rng, n_batches=7, batch=16)
+    seq = data[:3] + [nan_batch(16)] + data[3:]
+
+    a = make_net()
+    guard_a = DivergenceGuard(policy="skip")
+    tr_a = DistributedTrainer(a, mesh=build_mesh(),
+                              divergence_guard=guard_a)
+    for ds in seq:
+        tr_a.fit_minibatch(ds)
+
+    b = make_net()
+    guard_b = DivergenceGuard(policy="skip")
+    tr_b = DistributedTrainer(b, mesh=build_mesh(),
+                              divergence_guard=guard_b,
+                              max_in_flight=3, guard_lag=3)
+    tr_b.fit(ListDataSetIterator(seq), epochs=1, prefetch=2)
+
+    assert guard_a.skipped_steps == 1
+    assert guard_b.skipped_steps == 1  # collected late, still counted
+    assert_params_equal(a, b)
+    assert_updater_equal(a, b)
+
+
+@pytest.mark.chaos
+def test_guarded_bad_step_equivalent_multilayer_engine(rng):
+    """Same guarantee on the solo MLN engine's windowed loop."""
+    data = batches(rng, n_batches=6)
+    seq = data[:2] + [nan_batch()] + data[2:]
+
+    sync = make_net()
+    sync.set_divergence_guard(DivergenceGuard(policy="skip"))
+    for ds in seq:
+        sync.fit_minibatch(ds)
+
+    piped = make_net()
+    guard = DivergenceGuard(policy="skip")
+    piped.set_divergence_guard(guard)
+    piped.max_in_flight = 3
+    piped.fit(ListDataSetIterator(seq), epochs=1)
+    assert guard.skipped_steps == 1
+    assert sync.divergence_guard.skipped_steps == 1
+    assert_params_equal(sync, piped)
+
+
+def test_rollback_policy_forces_synchronous_consult(rng, tmp_path):
+    """guard_lag is ignored under rollback: the consult happens on
+    push (lag 0), so the checkpoint restore fires at the bad step,
+    exactly like the unpipelined loop."""
+    from deeplearning4j_tpu.resilience import CheckpointManager
+
+    data = batches(rng, n_batches=4)
+    net = make_net()
+    mgr = CheckpointManager(tmp_path)
+    for ds in data[:2]:
+        net.fit_minibatch(ds)
+    mgr.save(net)
+    guard = DivergenceGuard(policy="rollback", checkpoint_manager=mgr)
+    window = AsyncDispatchWindow(
+        model=net, guard_fn=lambda: guard, max_in_flight=4,
+        guard_lag=4, registry=MetricsRegistry(),
+    )
+    assert window._effective_lag(guard) == 0
+    net.set_divergence_guard(guard)
+    net._dispatch_window = None  # direct fit_minibatch path below
+    net.fit_minibatch(nan_batch())
+    assert guard.rollbacks == 1
+
+
+def test_window_bounds_in_flight(rng):
+    import jax
+
+    reg = MetricsRegistry()
+    window = AsyncDispatchWindow(max_in_flight=2, registry=reg)
+    for i in range(6):
+        window.push(jax.numpy.asarray(float(i)))
+    assert len(window._inflight) <= 2
+    window.drain()
+    assert window.pending == 0
+    # step-gap histogram recorded push-to-push gaps
+    assert reg.get("training_step_gap_ms")._default().count == 5
+
+
+# -- fit() contract satellites ------------------------------------------
+
+
+def test_trainer_fit_returns_per_epoch_mean_scores(rng):
+    data = batches(rng, n_batches=4, batch=16)
+    net = make_net()
+    tr = DistributedTrainer(net, mesh=build_mesh())
+    scores = tr.fit(ListDataSetIterator(data), epochs=3)
+    assert len(scores) == 3
+    assert all(np.isfinite(s) for s in scores)
+    assert scores[2] < scores[0]  # it actually learns
+
+
+def test_trainer_fit_resets_iterator_on_exception(rng):
+    """An exception unwinding mid-epoch leaves the iterator rewound,
+    so a retried epoch starts from the top, not mid-stream."""
+    data = batches(rng, n_batches=6, batch=16)
+
+    class Exploding(ListDataSetIterator):
+        def __init__(self, data):
+            super().__init__(data)
+            self.resets = 0
+            self.armed = True
+
+        def next(self):
+            if self.armed and self._pos == 3:
+                self.armed = False
+                raise RuntimeError("boom mid-epoch")
+            return super().next()
+
+        def reset(self):
+            self.resets += 1
+            super().reset()
+
+    it = Exploding(data)
+    net = make_net()
+    tr = DistributedTrainer(net, mesh=build_mesh())
+    with pytest.raises(RuntimeError, match="boom"):
+        tr.fit(it, epochs=1)
+    assert it.resets >= 1 and it._pos == 0
+    # retried epoch consumes all 6 batches from the top
+    tr.fit(it, epochs=1)
+    assert net.iteration_count == 3 + 6

@@ -80,10 +80,48 @@ def test_dp_trainer_adam_and_listeners(rng):
     assert net.score(x=x, labels=y) < s0 * 0.5
 
 
-def test_dp_batch_divisibility_error(rng):
+def test_dp_partial_batch_pads_and_masks(rng):
+    """A trailing non-divisible batch no longer raises: it is padded
+    up to the data-parallel degree with zero rows masked out of the
+    loss, so the update equals the unpadded batch's (the training
+    analog of serving's ``output_padded`` trick)."""
     conftest.require_devices(2)
     x, y = blob_data(rng, n=30)  # 30 % 8 != 0
-    net = make_net()
+    ds = DataSet(features=x, labels=y)
+    single = make_net(seed=5)
+    net = make_net(seed=5)
+    trainer = DistributedTrainer(net, mesh=build_mesh())
+    for _ in range(3):
+        single.fit_minibatch(ds)
+        trainer.fit_minibatch(ds)
+    # honest examples/sec signal: valid rows, not padded rows
+    assert net._last_batch_rows == 30
+    for lname in single.params:
+        for pname in single.params[lname]:
+            np.testing.assert_allclose(
+                np.asarray(single.params[lname][pname]),
+                np.asarray(net.params[lname][pname]),
+                rtol=2e-5, atol=1e-6,
+            )
+
+
+def test_dp_partial_batch_with_batchnorm_still_raises(rng):
+    """Zero padding rows would enter BatchNormalization's batch
+    statistics, so batch-coupled configs keep the explicit error."""
+    from deeplearning4j_tpu.nn.layers import BatchNormalization
+
+    conftest.require_devices(2)
+    x, y = blob_data(rng, n=30)
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(7).learning_rate(0.1)
+        .list()
+        .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+        .layer(BatchNormalization(n_out=16))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
     trainer = DistributedTrainer(net, mesh=build_mesh())
     with pytest.raises(ValueError, match="divisible"):
         trainer.fit_minibatch(DataSet(features=x, labels=y))

@@ -392,6 +392,59 @@ def test_kill_resume_equivalence_distributed_trainer(rng, tmp_path):
     assert_updater_state_match(full, survivor)
 
 
+@pytest.mark.chaos
+def test_kill_resume_mid_epoch_with_prefetch(rng, tmp_path):
+    """Kill/resume mid-epoch WITH the prefetching pipeline + async
+    dispatch enabled: the victim trains through a PrefetchIterator
+    (sharded placement on the worker thread, guard-less async
+    window), dies mid-epoch, and the survivor — also pipelined —
+    replays the identical trajectory bitwise. Prefetch runahead must
+    not advance training state past the checkpoint: batches sitting
+    in the queue at the kill are simply dropped with the worker."""
+    from deeplearning4j_tpu.datasets.prefetch import PrefetchIterator
+    from deeplearning4j_tpu.parallel import (
+        DistributedTrainer, build_mesh,
+    )
+
+    conftest.require_devices(2)
+    data = batches(rng, n_batches=8, batch=16)
+
+    # uninterrupted pipelined run: N steps
+    full = simple_net()
+    tr_full = DistributedTrainer(full, mesh=build_mesh())
+    tr_full.fit(ListDataSetIterator(data), epochs=1, prefetch=2)
+
+    # interrupted: 3 steps through a prefetched iterator -> checkpoint
+    # -> kill (prefetch queue holds runahead batches; they die with
+    # the worker) -> resume -> finish the epoch pipelined
+    mgr = CheckpointManager(tmp_path)
+    victim = simple_net()
+    tr_victim = DistributedTrainer(victim, mesh=build_mesh())
+    pf = PrefetchIterator(
+        ListDataSetIterator(data), queue_depth=4,
+        placement=tr_victim.place_minibatch,
+    )
+    consumed = 0
+    for ds in pf:
+        tr_victim.fit_minibatch(ds)
+        consumed += 1
+        if consumed == 3:
+            break
+    mgr.save(victim)
+    pf.shutdown()  # the kill: worker joined, queued runahead dropped
+    del victim, tr_victim
+
+    survivor = simple_net()
+    tr = DistributedTrainer(survivor, mesh=build_mesh())
+    step = tr.resume(mgr)
+    assert step == 3
+    tr.fit(ListDataSetIterator(data[step:]), epochs=1, prefetch=2)
+
+    assert survivor.iteration_count == full.iteration_count
+    conftest.assert_params_match(full, survivor)
+    assert_updater_state_match(full, survivor)
+
+
 def test_fit_resume_from_kwarg(rng, tmp_path):
     data = batches(rng, n_batches=4)
     mgr = CheckpointManager(tmp_path)
