@@ -66,6 +66,9 @@ _LAZY_IMPORTS = {
     "BucketLadder": "deeplearning4j_tpu.serving.batcher",
     "MicroBatcher": "deeplearning4j_tpu.serving.batcher",
     "CompileCache": "deeplearning4j_tpu.serving.compile_cache",
+    "enable_persistent_cache": "deeplearning4j_tpu.compile",
+    "export_serving_bundle": "deeplearning4j_tpu.compile",
+    "install_serving_bundle": "deeplearning4j_tpu.compile",
     "MetricsRegistry": "deeplearning4j_tpu.observability",
     "Tracer": "deeplearning4j_tpu.observability",
     "JsonlSink": "deeplearning4j_tpu.observability",

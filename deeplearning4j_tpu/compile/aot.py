@@ -1,0 +1,452 @@
+"""Tier 2: ahead-of-time exported executables as durable artifacts.
+
+``jit`` re-traces and re-compiles in every process; AOT export makes
+the *compiled executable* a file. The primary format serializes the
+result of ``jit(...).lower().compile()`` via
+``jax.experimental.serialize_executable`` — loading it performs ZERO
+XLA compilation (the backend deserializes the machine code directly).
+Where a backend cannot serialize executables, export falls back to
+``jax.export`` StableHLO bytes, which skip tracing and — under the
+tier-1 persistent cache — compile from disk.
+
+An artifact is self-describing: ``MAGIC | meta-length | meta-JSON |
+blob``. The meta carries a **fingerprint** over (model config JSON,
+input shape, dtype, kind, backend, jax/jaxlib versions) — the full
+set of facts that must match for a serialized executable to be valid
+here. Loading enforces the fingerprint and degrades *silently* to
+JIT on any mismatch or decode failure: a stale artifact (yesterday's
+jax, another backend), a truncated file, or plain garbage may cost a
+compile, never an error on the request path. The ladder:
+
+    exact fingerprint match  -> run the deserialized executable
+    stale / corrupt / absent -> count ``aot_fallback_total``, JIT
+
+Engines expose ``aot_export_output`` / ``aot_install_output`` (the
+serving forward, one executable per shape bucket) and
+``aot_export_step`` / ``aot_install_step`` (the jitted train step);
+``export_serving_bundle`` / ``install_serving_bundle`` map a bucket
+ladder onto those per-model entry points, and
+``resilience/checkpoint.py`` persists the named blobs next to the
+checkpoint zip under the manifest's ``artifacts`` CRC map.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import pickle
+import struct
+import threading
+import time
+from hashlib import sha256
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+MAGIC = b"DL4JAOT1"
+FORMAT_PJRT = "pjrt-executable"
+FORMAT_STABLEHLO = "stablehlo-export"
+
+ARTIFACT_OUTPUT_PREFIX = "aot-output-b"  # + bucket rows
+
+
+class AotArtifactError(ValueError):
+    """The bytes are not a usable AOT artifact (bad magic, truncated
+    framing, undecodable meta). Loaders catch this and fall back to
+    JIT; it never propagates to a request."""
+
+
+# -- metrics ------------------------------------------------------------
+
+_reg_lock = threading.Lock()
+_instrument_cache: Dict[int, dict] = {}
+
+
+def _instruments(registry=None) -> dict:
+    """aot_* instruments on ``registry`` (default process registry).
+    Family registration is idempotent; the tiny cache just skips the
+    registry lock on the hot path."""
+    from deeplearning4j_tpu.observability.metrics import (
+        default_registry,
+    )
+
+    reg = registry if registry is not None else default_registry()
+    key = id(reg)
+    with _reg_lock:
+        inst = _instrument_cache.get(key)
+        if inst is not None and inst["registry"] is reg:
+            return inst
+        inst = {
+            "registry": reg,
+            "export_ms": reg.summary(
+                "aot_export_ms",
+                help="lower+compile+serialize time per AOT artifact",
+            )._default(),
+            "load_ms": reg.summary(
+                "aot_load_ms",
+                help="deserialize+load time per AOT artifact",
+            )._default(),
+            "installed": reg.counter(
+                "aot_installed_total",
+                help="AOT executables installed (fingerprint matched)",
+            )._default(),
+            "fallback": reg.counter(
+                "aot_fallback_total",
+                help="AOT artifacts skipped (missing/stale/corrupt) "
+                     "— silently degraded to JIT",
+            )._default(),
+        }
+        _instrument_cache[key] = inst
+        return inst
+
+
+def _trace_event(outcome: str, **attrs) -> None:
+    from deeplearning4j_tpu.observability.trace import get_tracer
+
+    get_tracer().event("xla.compile.aot",
+                       attrs={"outcome": outcome, **attrs})
+
+
+# -- fingerprint --------------------------------------------------------
+
+
+def artifact_fingerprint(conf, shape, dtype: str, kind: str,
+                         backend: Optional[str] = None,
+                         extra: str = "") -> str:
+    """Hex digest over everything that must match for a serialized
+    executable to be valid: the model configuration (its canonical
+    JSON — a different architecture or init seed is a different
+    program), the input shape and dtype, the entry-point kind
+    (``output``/``step``), the backend platform string, and the
+    jax/jaxlib versions (executable serialization is not stable
+    across either)."""
+    import jax
+
+    if backend is None:
+        backend = jax.default_backend()
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover
+        jaxlib_version = "?"
+    conf_json = (
+        conf if isinstance(conf, str)
+        else json.dumps(conf, sort_keys=True, default=str)
+    )
+    doc = json.dumps({
+        "conf": conf_json,
+        "shape": _shape_key_to_list(shape),
+        "dtype": str(dtype),
+        "kind": kind,
+        "backend": str(backend),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "extra": extra,
+    }, sort_keys=True)
+    return sha256(doc.encode()).hexdigest()[:32]
+
+
+def _shape_key_to_list(shape):
+    """Shape keys are a tuple of ints (one array) or a tuple of such
+    tuples (multi-input graphs); normalize to JSON-able lists."""
+    shape = tuple(shape)
+    if shape and isinstance(shape[0], (tuple, list)):
+        return [[int(d) for d in s] for s in shape]
+    return [int(d) for d in shape]
+
+
+def shape_key(shape) -> tuple:
+    """Canonical hashable form of a shape key (ints, tuples)."""
+    shape = tuple(shape)
+    if shape and isinstance(shape[0], (tuple, list)):
+        return tuple(tuple(int(d) for d in s) for s in shape)
+    return tuple(int(d) for d in shape)
+
+
+# -- framing ------------------------------------------------------------
+
+
+def pack_artifact(meta: dict, blob: bytes) -> bytes:
+    head = json.dumps(meta, sort_keys=True).encode()
+    return MAGIC + struct.pack("<I", len(head)) + head + blob
+
+
+def unpack_artifact(data: bytes) -> Tuple[dict, bytes]:
+    if not isinstance(data, (bytes, bytearray)):
+        raise AotArtifactError("artifact is not bytes")
+    if len(data) < len(MAGIC) + 4 or data[:len(MAGIC)] != MAGIC:
+        raise AotArtifactError("bad artifact magic")
+    (n,) = struct.unpack_from("<I", data, len(MAGIC))
+    start = len(MAGIC) + 4
+    if start + n > len(data):
+        raise AotArtifactError("truncated artifact meta")
+    try:
+        meta = json.loads(bytes(data[start:start + n]))
+    except ValueError as e:
+        raise AotArtifactError(f"undecodable artifact meta: {e}")
+    if not isinstance(meta, dict):
+        raise AotArtifactError("artifact meta is not an object")
+    return meta, bytes(data[start + n:])
+
+
+def peek_meta(data: bytes) -> dict:
+    """Artifact meta without touching the payload (cheap triage)."""
+    return unpack_artifact(data)[0]
+
+
+# -- export / load ------------------------------------------------------
+
+
+def _pjrt_blob_validated(jitfn, args, bypass_cache: bool = False
+                         ) -> bytes:
+    """Compile, serialize, and PROVE the payload deserializes in this
+    process before anyone persists it — a pjrt blob that cannot load
+    here would silently poison every consumer into JIT fallback.
+    ``bypass_cache`` forces a fresh backend compile (executables the
+    persistent disk cache handed back may not re-serialize)."""
+    import jax
+    from jax.experimental import serialize_executable
+
+    prev = None
+    if bypass_cache:
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        compiled = jitfn.lower(*args).compile()
+    finally:
+        if bypass_cache:
+            jax.config.update("jax_enable_compilation_cache", prev)
+    payload, in_tree, out_tree = serialize_executable.serialize(
+        compiled
+    )
+    serialize_executable.deserialize_and_load(
+        payload, in_tree, out_tree
+    )  # validation: raises when the round-trip is broken
+    return pickle.dumps((payload, in_tree, out_tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def export_artifact(jitfn, args: Sequence, *, fingerprint: str,
+                    shape, kind: str, name: str = "",
+                    meta_extra: Optional[dict] = None,
+                    registry=None) -> bytes:
+    """Lower+compile ``jitfn`` on ``args`` (arrays or
+    ``ShapeDtypeStruct``s) and serialize the executable. Primary
+    format is the backend's native executable (zero compile at load);
+    falls back to ``jax.export`` StableHLO when the backend cannot
+    serialize executables. Raises on export failure — exporting is a
+    *save-time* operation where errors should be loud (loading is
+    where silence is required)."""
+    import jax
+
+    inst = _instruments(registry)
+    t0 = time.perf_counter()
+    blob = None
+    fmt = None
+    try:
+        blob = _pjrt_blob_validated(jitfn, args)
+        fmt = FORMAT_PJRT
+    except Exception:
+        # an executable loaded FROM the persistent disk cache may not
+        # re-serialize on some backends (CPU: "Symbols not found" at
+        # the consumer) — retry once with the cache bypassed so the
+        # compile is fresh, then validate again
+        try:
+            blob = _pjrt_blob_validated(jitfn, args,
+                                        bypass_cache=True)
+            fmt = FORMAT_PJRT
+        except Exception:
+            logger.info(
+                "executable serialization unavailable on backend "
+                "%s; exporting StableHLO instead",
+                jax.default_backend(),
+            )
+    if blob is None:
+        # backend can't serialize executables: ship StableHLO; the
+        # load-side compile then rides the tier-1 persistent cache
+        from jax import export as jax_export
+
+        blob = bytes(jax_export.export(jitfn)(*args).serialize())
+        fmt = FORMAT_STABLEHLO
+    ms = (time.perf_counter() - t0) * 1000.0
+    inst["export_ms"].observe(ms)
+    _trace_event("export", kind=kind, format=fmt,
+                 name=name, ms=round(ms, 2))
+    meta = {
+        "format": fmt,
+        "fingerprint": fingerprint,
+        "kind": kind,
+        "name": name,
+        "shape": _shape_key_to_list(shape),
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+    }
+    if meta_extra:
+        meta.update(meta_extra)
+    return pack_artifact(meta, blob)
+
+
+def load_artifact(data: bytes, *, expected_fingerprint: str,
+                  registry=None) -> Optional[Callable]:
+    """Deserialize an artifact into a callable, or ``None`` when it
+    is unusable — wrong magic, truncated, stale fingerprint (other
+    backend / jax / model), or a payload the backend rejects. Every
+    ``None`` is counted in ``aot_fallback_total`` and logged once;
+    nothing raises (the JIT path is always behind this)."""
+    inst = _instruments(registry)
+    try:
+        meta, blob = unpack_artifact(data)
+    except AotArtifactError as e:
+        inst["fallback"].inc()
+        _trace_event("fallback", reason="corrupt")
+        logger.warning("AOT artifact unusable (%s); falling back "
+                       "to JIT", e)
+        return None
+    if meta.get("fingerprint") != expected_fingerprint:
+        inst["fallback"].inc()
+        _trace_event("fallback", reason="stale",
+                     name=meta.get("name", ""))
+        logger.warning(
+            "AOT artifact %r is stale (fingerprint %s != expected "
+            "%s; backend/jax/model changed); falling back to JIT",
+            meta.get("name", "?"), meta.get("fingerprint"),
+            expected_fingerprint,
+        )
+        return None
+    t0 = time.perf_counter()
+    try:
+        fmt = meta.get("format")
+        if fmt == FORMAT_PJRT:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = pickle.loads(blob)
+            fn = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree
+            )
+        elif fmt == FORMAT_STABLEHLO:
+            from jax import export as jax_export
+
+            fn = jax_export.deserialize(bytearray(blob)).call
+        else:
+            raise AotArtifactError(f"unknown artifact format {fmt!r}")
+    except Exception as e:
+        inst["fallback"].inc()
+        _trace_event("fallback", reason="load_failed",
+                     name=meta.get("name", ""))
+        logger.warning(
+            "AOT artifact %r failed to load (%s: %s); falling back "
+            "to JIT", meta.get("name", "?"), type(e).__name__, e,
+        )
+        return None
+    ms = (time.perf_counter() - t0) * 1000.0
+    inst["load_ms"].observe(ms)
+    inst["installed"].inc()
+    _trace_event("load", kind=meta.get("kind", "?"),
+                 name=meta.get("name", ""), format=meta.get("format"),
+                 ms=round(ms, 2))
+    return fn
+
+
+# -- train-step dispatch wrapper ----------------------------------------
+
+
+class AotStepFunction:
+    """Stands in for an engine's ``_jit_step``: dispatches to the
+    AOT-restored executable when the call matches its specialization
+    (same x/y shapes, no masks — the shapes it was lowered on) and
+    lazily builds the normal jitted step for everything else, so an
+    AOT step never *narrows* what the engine can fit."""
+
+    def __init__(self, compiled: Callable, x_shape, y_shape,
+                 fallback_builder: Callable[[], Callable]):
+        self._compiled = compiled
+        self._x_shape = shape_key(x_shape)
+        self._y_shape = shape_key(y_shape)
+        self._build_fallback = fallback_builder
+        self._fallback: Optional[Callable] = None
+
+    @staticmethod
+    def _key_of(v) -> tuple:
+        # MultiLayerNetwork passes arrays; ComputationGraph passes
+        # lists of arrays — both normalize to the shape-key form
+        if isinstance(v, (list, tuple)):
+            return tuple(
+                tuple(int(d) for d in a.shape) for a in v
+            )
+        return tuple(int(d) for d in v.shape)
+
+    def __call__(self, params, upd_state, state, x, y, mask, fmask,
+                 lrs, t, rng):
+        if (mask is None and fmask is None
+                and self._key_of(x) == self._x_shape
+                and self._key_of(y) == self._y_shape):
+            return self._compiled(params, upd_state, state, x, y,
+                                  mask, fmask, lrs, t, rng)
+        if self._fallback is None:
+            self._fallback = self._build_fallback()
+        return self._fallback(params, upd_state, state, x, y, mask,
+                              fmask, lrs, t, rng)
+
+
+# -- serving bundle -----------------------------------------------------
+
+
+def serving_bucket_name(bucket: int) -> str:
+    return f"{ARTIFACT_OUTPUT_PREFIX}{int(bucket)}"
+
+
+def export_serving_bundle(model, buckets: Sequence[int],
+                          feature_shape: Optional[Sequence[int]] = None,
+                          registry=None) -> Dict[str, bytes]:
+    """One AOT artifact per ladder bucket for ``model``'s inference
+    forward: ``{artifact name: bytes}``, ready for
+    ``CheckpointManager.save(model, artifacts=...)``. The per-row
+    feature shape comes from the model config (first layer's
+    ``n_in``) unless ``feature_shape`` overrides it (multi-dim or
+    config-less models)."""
+    if feature_shape is None:
+        n_in = getattr(
+            getattr(model, "conf", None), "layers", [None]
+        )[0]
+        n_in = getattr(n_in, "n_in", None)
+        if not isinstance(n_in, int) or n_in <= 0:
+            raise ValueError(
+                "model declares no input width; pass feature_shape="
+            )
+        feature_shape = (n_in,)
+    out: Dict[str, bytes] = {}
+    for b in buckets:
+        shape = (int(b),) + tuple(int(d) for d in feature_shape)
+        out[serving_bucket_name(b)] = model.aot_export_output(
+            shape, registry=registry
+        )
+    return out
+
+
+def install_serving_bundle(model, blobs: Dict[str, bytes],
+                           registry=None) -> List[tuple]:
+    """Install every loadable forward artifact in ``blobs`` onto
+    ``model``; returns the shape keys installed. Unusable artifacts
+    (stale fingerprint, corrupt bytes, non-forward kinds) are skipped
+    silently — serving then JIT-compiles those buckets at warmup,
+    exactly as without a bundle."""
+    installed: List[tuple] = []
+    for name, data in sorted(blobs.items()):
+        if not name.startswith(ARTIFACT_OUTPUT_PREFIX):
+            continue
+        try:
+            meta = peek_meta(data)
+            key = shape_key(
+                tuple(meta["shape"]) if meta.get("shape") else ()
+            )
+        except (AotArtifactError, KeyError, TypeError):
+            _instruments(registry)["fallback"].inc()
+            logger.warning(
+                "AOT artifact %r has no readable shape; skipping",
+                name,
+            )
+            continue
+        if model.aot_install_output(key, data, registry=registry):
+            installed.append(key)
+    return installed

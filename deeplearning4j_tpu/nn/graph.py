@@ -63,6 +63,9 @@ class ComputationGraph:
 
         self.device_cache_bytes = device_cache_budget_bytes()
         self._jit_output = None
+        # AOT-restored inference executables by exact input-shape key
+        # (compile/aot.py): consulted by output() before the jit path
+        self._aot_outputs: Dict[tuple, Any] = {}
         self._jit_rnn_step = None
         self._rnn_state: Dict[str, Any] = {}  # streaming rnnTimeStep
         self._stream_steps = 0  # timesteps consumed vs finite caches
@@ -847,6 +850,18 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
 
+    def _output_fn(self):
+        """Pure inference forward closure shared by the jitted
+        ``output`` path and the AOT export (identical trace ->
+        bitwise identical results)."""
+        def out_fn(params, state, inputs, fmasks):
+            values, _, _ = self._forward_values(
+                params, state, inputs, train=False, rng=None,
+                fmasks=fmasks,
+            )
+            return [values[n] for n in self.conf.outputs]
+        return out_fn
+
     def output(self, *inputs, features_masks=None) -> List[jax.Array]:
         """Activated values of the output vertices (reference
         ``ComputationGraph.output``). ``features_masks``: per-graph-
@@ -854,15 +869,16 @@ class ComputationGraph:
         ``output(..., featureMaskArrays)``)."""
         if self.params is None:
             self.init()
-        if self._jit_output is None:
-            def out_fn(params, state, inputs, fmasks):
-                values, _, _ = self._forward_values(
-                    params, state, inputs, train=False, rng=None,
-                    fmasks=fmasks,
-                )
-                return [values[n] for n in self.conf.outputs]
-            self._jit_output = jax.jit(out_fn)
         dtype = self._dtype()
+        if self._aot_outputs and features_masks is None:
+            fn = self._aot_outputs.get(tuple(
+                tuple(int(d) for d in np.shape(x)) for x in inputs
+            ))
+            if fn is not None:
+                return fn(self.params, self.state,
+                          [jnp.asarray(x, dtype) for x in inputs])
+        if self._jit_output is None:
+            self._jit_output = jax.jit(self._output_fn())
         arr = [jnp.asarray(x, dtype) for x in inputs]
         fm = None
         if features_masks is not None:
@@ -871,6 +887,133 @@ class ComputationGraph:
                 for m in _as_list(features_masks)
             ]
         return self._jit_output(self.params, self.state, arr, fm)
+
+    # -- AOT export/install (compile/aot.py) ---------------------------
+
+    def _aot_shape_key(self, shapes) -> tuple:
+        """Normalize to the nested key form: one shape -> a 1-tuple
+        of shape tuples (the DAG engine is list-of-inputs shaped)."""
+        shapes = tuple(shapes)
+        if shapes and not isinstance(shapes[0], (tuple, list)):
+            shapes = (shapes,)
+        return tuple(tuple(int(d) for d in s) for s in shapes)
+
+    def aot_fingerprint(self, shapes, kind: str = "output") -> str:
+        from deeplearning4j_tpu.compile.aot import artifact_fingerprint
+
+        return artifact_fingerprint(
+            self.conf.to_dict(), self._aot_shape_key(shapes),
+            str(self._dtype()), kind,
+        )
+
+    def aot_export_output(self, shapes, registry=None) -> bytes:
+        """Serialize the compiled inference forward for inputs of
+        exactly ``shapes`` (one shape tuple, or a tuple of them for
+        multi-input graphs; inference mode, no masks)."""
+        if self.params is None:
+            self.init()
+        from deeplearning4j_tpu.compile.aot import export_artifact
+
+        key = self._aot_shape_key(shapes)
+        dtype = self._dtype()
+        base = self._output_fn()
+        fn = jax.jit(lambda p, s, arr: base(p, s, arr, None))
+        specs = [jax.ShapeDtypeStruct(s, dtype) for s in key]
+        return export_artifact(
+            fn, (self.params, self.state, specs),
+            fingerprint=self.aot_fingerprint(key),
+            shape=key, kind="output",
+            name="output-" + "+".join(
+                "x".join(str(d) for d in s) for s in key
+            ),
+            registry=registry,
+        )
+
+    def aot_install_output(self, shapes, artifact,
+                           registry=None) -> bool:
+        """Install an inference executable for exactly ``shapes``
+        from artifact bytes (fingerprint-checked; stale/corrupt
+        artifacts are refused silently) or a callable."""
+        key = self._aot_shape_key(shapes)
+        if callable(artifact):
+            self._aot_outputs[key] = artifact
+            return True
+        from deeplearning4j_tpu.compile.aot import load_artifact
+
+        fn = load_artifact(
+            artifact,
+            expected_fingerprint=self.aot_fingerprint(key),
+            registry=registry,
+        )
+        if fn is None:
+            return False
+        self._aot_outputs[key] = fn
+        return True
+
+    def aot_output_shapes(self) -> List[tuple]:
+        return list(self._aot_outputs)
+
+    def aot_export_step(self, ds, registry=None) -> bytes:
+        """Serialize the compiled train step specialized to ``ds``'s
+        input/label shapes (no masks)."""
+        if self.params is None:
+            self.init()
+        from deeplearning4j_tpu.compile.aot import export_artifact
+
+        dtype = self._dtype()
+        inputs = [jnp.asarray(f, dtype)
+                  for f in _as_list(ds.features)]
+        labels = [jnp.asarray(l, dtype) for l in _as_list(ds.labels)]
+        lrs = {
+            k: jnp.asarray(v, jnp.float32) for k, v in
+            self.updater_def.scheduled_lrs(self.iteration_count).items()
+        }
+        t = jnp.asarray(1, jnp.float32)
+        rng = jax.random.fold_in(self._base_key, 0)
+        x_key = tuple(tuple(int(d) for d in a.shape) for a in inputs)
+        y_key = tuple(tuple(int(d) for d in a.shape) for a in labels)
+        return export_artifact(
+            self._build_step(),
+            (self.params, self.updater_state, self.state, inputs,
+             labels, None, None, lrs, t, rng),
+            fingerprint=self.aot_fingerprint(x_key, kind="step"),
+            shape=x_key, kind="step",
+            name="step-" + "+".join(
+                "x".join(str(d) for d in s) for s in x_key
+            ),
+            meta_extra={"label_shape": [list(s) for s in y_key]},
+            registry=registry,
+        )
+
+    def aot_install_step(self, artifact, registry=None) -> bool:
+        """Install an AOT train-step executable as ``_jit_step``
+        (matching shapes run the restored executable; anything else
+        lazily JITs — ``compile.aot.AotStepFunction``)."""
+        from deeplearning4j_tpu.compile.aot import (
+            AotStepFunction,
+            load_artifact,
+            peek_meta,
+        )
+
+        try:
+            meta = peek_meta(artifact)
+            x_key = self._aot_shape_key(meta["shape"])
+            y_key = self._aot_shape_key(meta["label_shape"])
+        except Exception:
+            return False
+        fn = load_artifact(
+            artifact,
+            expected_fingerprint=self.aot_fingerprint(
+                x_key, kind="step"
+            ),
+            registry=registry,
+        )
+        if fn is None:
+            return False
+        self._jit_step = AotStepFunction(
+            fn, x_key, y_key, self._build_step
+        )
+        return True
 
     def output_padded(self, *inputs, n_valid, features_masks=None):
         """Inference on row-padded batches: every graph input is

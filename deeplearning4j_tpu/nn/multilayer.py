@@ -305,6 +305,11 @@ class MultiLayerNetwork:
 
         self.device_cache_bytes = device_cache_budget_bytes()
         self._jit_output = None
+        # AOT-restored inference executables by exact input shape
+        # (compile/aot.py): consulted by output() before the jit
+        # path, so a warm restart serves without ever building
+        # _jit_output. Empty dict = one falsy check on the hot path.
+        self._aot_outputs: Dict[Tuple[int, ...], Callable] = {}
         self._jit_rnn_step = None
         self._jit_pretrain_steps: Dict[int, Callable] = {}
         self._jit_pretrain_input = None
@@ -1433,6 +1438,18 @@ class MultiLayerNetwork:
 
     # -- inference -----------------------------------------------------
 
+    def _output_fn(self) -> Callable:
+        """The pure inference forward closure — the single source of
+        truth behind both the jitted ``output`` path and the AOT
+        export (identical trace -> identical executable -> bitwise
+        identical results between the two)."""
+        def out_fn(params, state, x, fmask, rng, train):
+            out, _, _, _ = self._forward_pure(
+                params, state, x, train=train, rng=rng, fmask=fmask
+            )
+            return out
+        return out_fn
+
     def output(self, x, train: bool = False, features_mask=None):
         """Activated network output (reference ``output:1638``;
         ``train=True`` applies training-mode ops like dropout, and
@@ -1440,16 +1457,21 @@ class MultiLayerNetwork:
         ``output(INDArray,...,featuresMask,labelsMask)``)."""
         if self.params is None:
             self.init()
-        if self._jit_output is None:
-            def out_fn(params, state, x, fmask, rng, train):
-                out, _, _, _ = self._forward_pure(
-                    params, state, x, train=train, rng=rng, fmask=fmask
-                )
-                return out
-            self._jit_output = jax.jit(
-                out_fn, static_argnames=("train",)
-            )
         dtype = _dtype_of(self.conf)
+        if self._aot_outputs and not train and features_mask is None:
+            # AOT-restored executable for this exact shape: same
+            # program output() would have jitted, deserialized from
+            # disk instead of compiled (compile/aot.py)
+            fn = self._aot_outputs.get(
+                tuple(int(d) for d in np.shape(x))
+            )
+            if fn is not None:
+                return fn(self.params, self.state,
+                          jnp.asarray(x, dtype))
+        if self._jit_output is None:
+            self._jit_output = jax.jit(
+                self._output_fn(), static_argnames=("train",)
+            )
         fm = (
             None if features_mask is None
             else jnp.asarray(features_mask, dtype)
@@ -1462,6 +1484,155 @@ class MultiLayerNetwork:
             self.params, self.state, jnp.asarray(x, dtype), fm, rng,
             train,
         )
+
+    # -- AOT export/install (compile/aot.py) ---------------------------
+
+    def aot_fingerprint(self, shape, kind: str = "output") -> str:
+        """Validity fingerprint for this model's AOT artifacts at
+        ``shape``: config JSON + shape + dtype + backend + jax
+        versions (see ``compile.aot.artifact_fingerprint``)."""
+        from deeplearning4j_tpu.compile.aot import artifact_fingerprint
+
+        return artifact_fingerprint(
+            self.conf.to_dict(), shape,
+            str(jnp.dtype(_dtype_of(self.conf))), kind,
+        )
+
+    def aot_export_output(self, x_shape, registry=None) -> bytes:
+        """Serialize the compiled inference forward for inputs of
+        exactly ``x_shape`` (inference mode, no mask — the serving
+        bucket contract) into an AOT artifact."""
+        if self.params is None:
+            self.init()
+        from deeplearning4j_tpu.compile.aot import export_artifact
+
+        dtype = _dtype_of(self.conf)
+        base = self._output_fn()
+        fn = jax.jit(lambda p, s, xin: base(p, s, xin, None, None,
+                                            False))
+        spec = jax.ShapeDtypeStruct(
+            tuple(int(d) for d in x_shape), dtype
+        )
+        return export_artifact(
+            fn, (self.params, self.state, spec),
+            fingerprint=self.aot_fingerprint(x_shape),
+            shape=x_shape, kind="output",
+            name=f"output-{'x'.join(str(int(d)) for d in x_shape)}",
+            registry=registry,
+        )
+
+    def aot_install_output(self, x_shape, artifact,
+                           registry=None) -> bool:
+        """Install an inference executable for exactly ``x_shape``
+        from artifact bytes (fingerprint-checked; silently refused
+        and counted in ``aot_fallback_total`` when stale/corrupt) or
+        a pre-loaded callable. Returns True when installed."""
+        key = tuple(int(d) for d in x_shape)
+        if callable(artifact):
+            self._aot_outputs[key] = artifact
+            return True
+        from deeplearning4j_tpu.compile.aot import load_artifact
+
+        fn = load_artifact(
+            artifact,
+            expected_fingerprint=self.aot_fingerprint(key),
+            registry=registry,
+        )
+        if fn is None:
+            return False
+        self._aot_outputs[key] = fn
+        return True
+
+    def aot_output_shapes(self) -> List[Tuple[int, ...]]:
+        """Input shapes with an installed AOT inference executable."""
+        return list(self._aot_outputs)
+
+    def aot_export_step(self, ds, registry=None) -> bytes:
+        """Serialize the compiled SGD train step specialized to
+        ``ds``'s feature/label shapes (no masks) — the executable a
+        warm restart installs via ``aot_install_step`` to resume
+        fitting without a compile. Exported fresh (never from the
+        live ``_jit_step``) so guard/telemetry flags at export time
+        are captured in the fingerprint."""
+        if self.params is None:
+            self.init()
+        from deeplearning4j_tpu.compile.aot import export_artifact
+
+        # the EXACT arrays fit_minibatch would dispatch (same device
+        # conversion -> same dtypes -> the executable matches)
+        dtype = _dtype_of(self.conf)
+        x = _to_device(ds.features, dtype)
+        y = _to_device(ds.labels, dtype)
+        lrs = {
+            k: jnp.asarray(v, jnp.float32) for k, v in
+            self.updater_def.scheduled_lrs(self.iteration_count).items()
+        }
+        t = jnp.asarray(1, jnp.float32)
+        rng = jax.random.fold_in(self._base_key, 0)
+        return export_artifact(
+            self._build_step(),
+            (self.params, self.updater_state, self.state, x, y,
+             None, None, lrs, t, rng),
+            fingerprint=self.aot_fingerprint(
+                x.shape, kind=self._step_kind()
+            ),
+            shape=x.shape, kind=self._step_kind(),
+            name=f"step-{'x'.join(str(d) for d in x.shape)}",
+            meta_extra={"label_shape": [int(d) for d in y.shape]},
+            registry=registry,
+        )
+
+    def aot_install_step(self, artifact, registry=None) -> bool:
+        """Install an AOT train-step executable as ``_jit_step``
+        (dispatching to it on matching shapes, JIT otherwise — see
+        ``compile.aot.AotStepFunction``). Fingerprint-checked;
+        returns True when installed."""
+        from deeplearning4j_tpu.compile.aot import (
+            AotStepFunction,
+            load_artifact,
+            peek_meta,
+        )
+
+        try:
+            meta = peek_meta(artifact)
+            x_shape = tuple(meta["shape"])
+        except Exception:
+            return False
+        fn = load_artifact(
+            artifact,
+            expected_fingerprint=self.aot_fingerprint(
+                x_shape, kind=self._step_kind()
+            ),
+            registry=registry,
+        )
+        if fn is None:
+            return False
+        y_shape = tuple(
+            meta.get("label_shape")
+            or self._step_label_shape(x_shape)
+        )
+        self._jit_step = AotStepFunction(
+            fn, x_shape, y_shape, self._build_step
+        )
+        return True
+
+    def _step_kind(self) -> str:
+        """AOT kind string for the train step: the guard/telemetry
+        flags change the compiled program (extra outputs), so they
+        are part of the artifact identity."""
+        return (
+            "step"
+            + ("+guard" if self.divergence_guard is not None else "")
+            + ("+telemetry" if self._telemetry_grad_norm else "")
+        )
+
+    def _step_label_shape(self, x_shape) -> Tuple[int, ...]:
+        """Label shape implied by the config for a feature batch of
+        ``x_shape`` (n_out of the last layer; 3-d for recurrent)."""
+        n_out = getattr(self.conf.layers[-1], "n_out", None)
+        if len(x_shape) == 3:
+            return (x_shape[0], int(n_out), x_shape[2])
+        return (x_shape[0], int(n_out))
 
     def output_padded(self, x, n_valid, features_mask=None):
         """Inference on a row-padded batch: the serving micro-batcher

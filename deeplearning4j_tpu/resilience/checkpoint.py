@@ -20,7 +20,21 @@ Manifest format (version 1), one JSON object per checkpoint:
 
     {"format": 1, "step": 128, "epoch": 2,
      "file": "checkpoint-00000128.zip",
-     "crc32": 2914207069, "size": 18007}
+     "crc32": 2914207069, "size": 18007,
+     "artifacts": {"aot-output-b8": {
+         "file": "checkpoint-00000128.aot-output-b8.aot",
+         "crc32": 1234567, "size": 40960}}}
+
+The optional ``artifacts`` map carries named side blobs — AOT-
+exported executables (``compile/aot.py``) ride here — each written
+atomically next to the zip and CRC-verified on read by the SAME
+manifest machinery as the model zip. The asymmetry is deliberate:
+a corrupt *model* zip fails that version (restore falls back to the
+previous one), while a corrupt *artifact* only disables that
+artifact (``load_artifact`` returns None and the consumer JITs) —
+a lost executable costs a compile, never a restore. Manifests
+without the field parse as ``artifacts={}`` (old checkpoints keep
+restoring).
 
 ``CheckpointListener`` plugs the manager into any fit loop via the
 ``IterationListener`` SPI (``optimize/listeners.py``).
@@ -35,7 +49,7 @@ import re
 import tempfile
 import zipfile
 import zlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 from typing import List, Optional, Tuple
 
@@ -83,7 +97,10 @@ def _crc32_of(path, chunk: int = 1 << 20) -> Tuple[int, int]:
 
 @dataclass(frozen=True)
 class CheckpointInfo:
-    """One verified-writable checkpoint version."""
+    """One verified-writable checkpoint version. ``artifacts`` maps
+    artifact name -> {file, crc32, size} for side blobs (AOT
+    executables) that ride the manifest's CRC story without gating
+    the model restore."""
 
     step: int
     epoch: int
@@ -91,13 +108,17 @@ class CheckpointInfo:
     crc32: int
     size: int
     format: int = MANIFEST_FORMAT
+    artifacts: dict = field(default_factory=dict)
 
     def to_manifest(self) -> dict:
-        return {
+        doc = {
             "format": self.format, "step": self.step,
             "epoch": self.epoch, "file": self.file,
             "crc32": self.crc32, "size": self.size,
         }
+        if self.artifacts:
+            doc["artifacts"] = self.artifacts
+        return doc
 
     @classmethod
     def from_manifest(cls, doc: dict) -> "CheckpointInfo":
@@ -106,6 +127,7 @@ class CheckpointInfo:
             file=doc["file"], crc32=int(doc["crc32"]),
             size=int(doc["size"]),
             format=int(doc.get("format", MANIFEST_FORMAT)),
+            artifacts=dict(doc.get("artifacts") or {}),
         )
 
 
@@ -142,11 +164,24 @@ class CheckpointManager:
     def _manifest_name(self, step: int) -> str:
         return f"{self.prefix}-{step:08d}.json"
 
+    def _artifact_file_name(self, step: int, name: str) -> str:
+        if not re.fullmatch(r"[A-Za-z0-9._-]+", name):
+            raise ValueError(
+                f"artifact name {name!r} must be filename-safe "
+                "(letters/digits/dot/underscore/dash)"
+            )
+        return f"{self.prefix}-{step:08d}.{name}.aot"
+
     # -- write ----------------------------------------------------------
 
-    def save(self, model) -> CheckpointInfo:
+    def save(self, model, artifacts=None) -> CheckpointInfo:
         """Checkpoint ``model`` at its current iteration count.
-        Re-saving the same step overwrites that version atomically."""
+        Re-saving the same step overwrites that version atomically.
+        ``artifacts`` (optional ``{name: bytes}``, e.g. the AOT
+        executables from ``compile.aot.export_serving_bundle``) are
+        written as sibling files and CRC-recorded in the manifest's
+        ``artifacts`` map — verified on read, but never gating the
+        model restore."""
         from deeplearning4j_tpu.observability.trace import get_tracer
         from deeplearning4j_tpu.util.model_serializer import write_model
 
@@ -158,9 +193,18 @@ class CheckpointManager:
             zpath = self.directory / self._zip_name(step)
             write_model(model, zpath)  # atomic (temp + os.replace)
             crc, size = _crc32_of(zpath)
+            artifact_map = {}
+            for name, data in sorted((artifacts or {}).items()):
+                fname = self._artifact_file_name(step, name)
+                atomic_write_bytes(self.directory / fname, data)
+                artifact_map[name] = {
+                    "file": fname,
+                    "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                    "size": len(data),
+                }
             info = CheckpointInfo(
                 step=step, epoch=epoch, file=zpath.name, crc32=crc,
-                size=size,
+                size=size, artifacts=artifact_map,
             )
             # manifest lands after the zip: a crash between the two
             # leaves an orphan zip that available() ignores, never a
@@ -176,7 +220,12 @@ class CheckpointManager:
     def _prune(self) -> None:
         versions = self.available()
         for info in versions[:-self.keep_last]:
-            for name in (info.file, self._manifest_name(info.step)):
+            names = [info.file, self._manifest_name(info.step)]
+            names.extend(
+                a.get("file") for a in info.artifacts.values()
+                if isinstance(a, dict) and a.get("file")
+            )
+            for name in names:
                 try:
                     os.unlink(self.directory / name)
                 except OSError:
@@ -218,6 +267,43 @@ class CheckpointManager:
                 return zf.testzip() is None
         except (OSError, zipfile.BadZipFile):
             return False
+
+    def load_artifact(self, info: CheckpointInfo,
+                      name: str) -> Optional[bytes]:
+        """Bytes of one named side artifact, CRC-verified against the
+        manifest — or ``None`` when absent, unreadable, or corrupted
+        (logged; the consumer falls back to computing the artifact's
+        content, e.g. JIT-compiling instead of loading AOT). Never
+        raises and never affects model-restore eligibility."""
+        entry = info.artifacts.get(name)
+        if not isinstance(entry, dict) or not entry.get("file"):
+            return None
+        path = self.directory / entry["file"]
+        try:
+            data = path.read_bytes()
+        except OSError:
+            logger.warning("artifact %r of step %d is missing (%s)",
+                           name, info.step, path)
+            return None
+        if (len(data) != int(entry.get("size", -1))
+                or (zlib.crc32(data) & 0xFFFFFFFF)
+                != int(entry.get("crc32", -1))):
+            logger.warning(
+                "artifact %r of step %d failed CRC verification; "
+                "ignoring it", name, info.step,
+            )
+            return None
+        return data
+
+    def load_artifacts(self, info: CheckpointInfo) -> dict:
+        """All verifiable side artifacts of ``info`` as
+        ``{name: bytes}`` (corrupted/missing ones silently absent)."""
+        out = {}
+        for name in info.artifacts:
+            data = self.load_artifact(info, name)
+            if data is not None:
+                out[name] = data
+        return out
 
     def restore(self, info: CheckpointInfo, load_updater: bool = True):
         """Restore one specific version (verified)."""

@@ -57,6 +57,15 @@ class ModelShapes:
             self.seen.add(shape)
             return POST_WARMUP if self.warmed else NEW
 
+    def preload(self, shapes) -> None:
+        """Mark shapes as already-compiled without counting them: an
+        AOT-restored executable (compile/aot.py) arrives compiled, so
+        its first run is a disk load, not an XLA compile —
+        ``xla_compiles_total`` must stay flat for it."""
+        with self._lock:
+            for s in shapes:
+                self.seen.add(tuple(int(d) for d in s))
+
     def mark_warmed(self) -> None:
         with self._lock:
             self.warmed = True

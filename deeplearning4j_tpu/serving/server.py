@@ -206,6 +206,18 @@ class ModelServer:
     capacity meaning in the k+q admission bound. Pass
     ``micro_batch=False`` for the PR-2 one-predict-per-request solo
     loop.
+
+    Compile once, run anywhere (``deeplearning4j_tpu/compile/``):
+    ``compile_cache`` (default on) points JAX's persistent
+    compilation cache at ``DL4J_TPU_COMPILE_CACHE_DIR`` (or a
+    per-host default) so every warmup/restart compile after the
+    first is a disk read; ``aot`` (default on) additionally installs
+    AOT-exported executables bundled in the checkpoint manifest
+    (``CheckpointManager.save(model, artifacts=...)``) so
+    ``start()``/``reload()`` from such a checkpoint *deserialize*
+    the bucket ladder instead of compiling it — with silent
+    per-artifact fallback to JIT when an artifact is missing, stale,
+    or corrupt.
     """
 
     def __init__(self, model_or_path=None, host: str = "127.0.0.1",
@@ -223,7 +235,9 @@ class ModelServer:
                  batch_timeout_ms: float = 2.0,
                  bucket_ladder=None,
                  batch_workers: int = 1,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 compile_cache=True,
+                 aot: bool = True):
         if workers < 1:
             raise ValueError("workers must be >= 1")
         if queue_depth < 0:
@@ -264,13 +278,34 @@ class ModelServer:
             enabled=False
         )
         self.compile_cache = CompileCache(self.metrics, self.tracer)
+        # tier-1 persistent XLA cache: on by default (dir resolved
+        # from DL4J_TPU_COMPILE_CACHE_DIR / the per-host default) so
+        # restarts hit disk instead of the compiler; pass
+        # compile_cache=False to opt out, or a directory string to
+        # pin one. Never raises — a cache problem costs compiles.
+        self.compile_cache_dir: Optional[str] = None
+        if compile_cache is not False:
+            from deeplearning4j_tpu.compile.persistent import (
+                enable_persistent_cache,
+            )
+
+            self.compile_cache_dir = enable_persistent_cache(
+                compile_cache if isinstance(compile_cache, str)
+                else None,
+                registry=self.metrics.registry,
+            )
+        # tier-2 AOT: when the model comes from a CheckpointManager
+        # whose manifest bundles exported executables, install them
+        # so warmup deserializes instead of compiling
+        self.aot = aot
+        self._aot_buckets = 0
 
         self._source_path: Optional[str] = None
         self._watched_step: Optional[int] = None
+        self._last_restore_info = None  # CheckpointInfo when manager-sourced
         model, source = self._initial_model(model_or_path)
-        self._active = _ModelVersion(
-            model, 1, source, self.compile_cache.register()
-        )
+        shapes = self.compile_cache.register()
+        self._active = _ModelVersion(model, 1, source, shapes)
 
         self._model_lock = threading.Lock()
         self._reload_lock = threading.Lock()
@@ -314,6 +349,7 @@ class ModelServer:
                 load_updater=False
             )
             self._watched_step = info.step
+            self._last_restore_info = info
             return model, f"checkpoint-step-{info.step}"
         raise ValueError(
             "provide a model, a checkpoint path, or checkpoint_manager="
@@ -322,6 +358,14 @@ class ModelServer:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "ModelServer":
+        # AOT first: executables bundled with the checkpoint install
+        # before warmup, so warmup deserializes instead of compiling
+        # (missing/stale/corrupt artifacts silently leave those
+        # buckets on the JIT path)
+        self._aot_buckets = self._install_aot(
+            self._active.model, self._active.shapes,
+            self._last_restore_info,
+        )
         # eager warmup BEFORE the pool takes traffic: every ladder
         # bucket compiles now, so the first requests never pay an XLA
         # compile inside their deadline budget. Best-effort here — a
@@ -656,6 +700,50 @@ class ModelServer:
         shapes.mark_warmed()
         return n
 
+    def _install_aot(self, model, shapes, info) -> int:
+        """Install AOT-exported forward executables bundled with a
+        checkpoint (manifest ``artifacts`` map) onto ``model`` and
+        pre-mark their shapes compiled in the recompile-guard record.
+        Returns the number installed; 0 — silently — when AOT is off,
+        the model has no bundle, or every artifact is stale/corrupt
+        (those buckets JIT at warmup exactly as without a bundle)."""
+        if (not self.aot or info is None
+                or self.checkpoint_manager is None
+                or getattr(model, "aot_install_output", None) is None):
+            return 0
+        try:
+            blobs = self.checkpoint_manager.load_artifacts(info)
+            if not blobs:
+                return 0
+            from deeplearning4j_tpu.compile.aot import (
+                install_serving_bundle,
+            )
+
+            installed = install_serving_bundle(
+                model, blobs, registry=self.metrics.registry
+            )
+        except Exception:
+            logger.exception(
+                "AOT artifact install failed; serving will JIT-"
+                "compile at warmup instead"
+            )
+            return 0
+        if installed and shapes is not None:
+            # first runs of these shapes are disk loads, not
+            # compiles: keep xla_compiles_total flat for them. The
+            # shape record tracks the (single) feature array's shape,
+            # so unwrap the graph engine's nested 1-tuple keys.
+            shapes.preload([
+                k[0] if k and isinstance(k[0], tuple) else k
+                for k in installed
+            ])
+        if installed:
+            logger.info(
+                "installed %d AOT executable(s) from checkpoint "
+                "step %s", len(installed), info.step,
+            )
+        return len(installed)
+
     def _canary_features(self, model):
         if self.canary is not None:
             return np.asarray(self.canary, np.float32)
@@ -762,12 +850,17 @@ class ModelServer:
         try:
             self._reloading = True  # /readyz flips for the duration
             try:
-                model, source = self._load_for_reload(spec or {})
+                model, source, info = self._load_for_reload(spec or {})
+                shapes = self.compile_cache.register()
+                # AOT before canary/warmup: when the checkpoint
+                # bundles exported executables, both the canary and
+                # the bucket warmup run the deserialized programs —
+                # a reload from a warm bundle performs zero compiles
+                n_aot = self._install_aot(model, shapes, info)
                 self._canary_check(model)
                 # warm every bucket on the ADMIN thread before the
                 # swap: the new version has compiled all its shapes
                 # before it sees its first request
-                shapes = self.compile_cache.register()
                 self._warm_model(model, shapes)
             except _NoReloadSource as e:
                 return 400, error_envelope("no_reload_source", 400,
@@ -786,15 +879,21 @@ class ModelServer:
                 version = self._active.version + 1
                 self._active = _ModelVersion(model, version, source,
                                              shapes)
+            self._aot_buckets = n_aot
             self.metrics.incr("reload_total")
-            return 200, {"status": "reloaded", "version": version,
-                         "model": type(model).__name__,
-                         "source": source}
+            body = {"status": "reloaded", "version": version,
+                    "model": type(model).__name__,
+                    "source": source}
+            if n_aot:  # legacy response shape unless AOT landed
+                body["aot_buckets"] = n_aot
+            return 200, body
         finally:
             self._reloading = False
             self._reload_lock.release()
 
     def _load_for_reload(self, spec: dict):
+        """(model, source, checkpoint_info_or_None) — the info rides
+        along so reload can install the checkpoint's AOT bundle."""
         from deeplearning4j_tpu.util.model_serializer import (
             restore_model,
             restore_model_from_bytes,
@@ -803,7 +902,7 @@ class ModelServer:
         if "path" in spec:
             return (
                 restore_model(spec["path"], load_updater=False),
-                str(spec["path"]),
+                str(spec["path"]), None,
             )
         if "key" in spec:
             if self.store is None:
@@ -813,17 +912,17 @@ class ModelServer:
             data = self.store.read(spec["key"])
             return (
                 restore_model_from_bytes(data, load_updater=False),
-                str(spec["key"]),
+                str(spec["key"]), None,
             )
         if self.checkpoint_manager is not None:
             model, info = self.checkpoint_manager.restore_latest(
                 load_updater=False
             )
-            return model, f"checkpoint-step-{info.step}"
+            return model, f"checkpoint-step-{info.step}", info
         if self._source_path is not None:
             return (
                 restore_model(self._source_path, load_updater=False),
-                self._source_path,
+                self._source_path, None,
             )
         raise _NoReloadSource(
             "no reload source: pass {\"path\": ...} / {\"key\": ...} "
@@ -970,6 +1069,14 @@ class ModelServer:
             }
         else:
             out["batching"] = {"enabled": False}
+        from deeplearning4j_tpu.compile.persistent import cache_stats
+
+        out["compile"] = {
+            "persistent_cache_dir": self.compile_cache_dir,
+            "aot_enabled": self.aot,
+            "aot_buckets_installed": self._aot_buckets,
+            **cache_stats(),
+        }
         return out
 
     # -- request validation ---------------------------------------------

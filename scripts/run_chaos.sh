@@ -30,8 +30,13 @@ python scripts/lint_metrics.py
 #                                  storms surface as DL4JFaultException;
 #                                  guarded bad-step trajectory
 #                                  equivalence under async dispatch)
+#   tests/test_compile.py        — compile artifacts (corrupted /
+#                                  stale AOT bundles must degrade
+#                                  silently to JIT, never error the
+#                                  request path or the restore)
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest tests/test_resilience.py tests/test_serving.py \
     tests/test_batching.py tests/test_input_pipeline.py \
+    tests/test_compile.py \
     -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"
