@@ -1054,6 +1054,37 @@ def bench_serving(budget_s=None) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_serving_fleet(budget_s=None) -> dict:
+    """Multi-tenant fleet throughput: 4 backend processes (each
+    serving 4 tenant models with a paging budget) behind the
+    ``ServingRouter`` vs 1 backend through the same router path, at
+    the same total concurrency, via the standalone script in fleet
+    mode (subprocess — it spawns the backend fleet). Reports the
+    script's JSON verbatim; the acceptance gates are ``scaling``
+    approaching the process count ON A MULTI-CORE HOST (``cpu_count``
+    rides along — a 1-core box time-shares the processes and honestly
+    reports ~1x) and ``post_warmup_compiles_total`` == 0 across the
+    fleet."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_serving.py",
+    )
+    timeout = 600
+    if budget_s is not None:
+        timeout = max(60, min(timeout, int(budget_s)))
+    out = subprocess.run(
+        [sys.executable, script, "--fleet", "4"],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ,
+             "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE or ""},
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_serving --fleet failed: {out.stderr[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_input_pipeline(budget_s=None) -> dict:
     """Synchronous vs pipelined (prefetch + async dispatch) training
     fit on an iterator with nontrivial host-side batch cost, via the
@@ -1293,6 +1324,12 @@ def _section_table(budget_fn):
          lambda: bench_serving(budget_fn()),
          "batched-vs-solo serving req/s at concurrency 32 "
          "(scripts/bench_serving.py; speedup >= 4 is the gate)"),
+        ("serving_fleet",
+         lambda: bench_serving_fleet(budget_fn()),
+         "multi-tenant fleet: 4 router-fronted backend processes vs "
+         "1, same total concurrency (scripts/bench_serving.py "
+         "--fleet 4; scaling ~ process count on a multi-core host, "
+         "zero post-warmup compiles fleet-wide)"),
         ("input_pipeline",
          lambda: bench_input_pipeline(budget_fn()),
          "pipelined-vs-synchronous training fit steps/sec "

@@ -61,6 +61,8 @@ from deeplearning4j_tpu.resilience import (  # noqa: F401
 # package import. The observability substrate rides the same table.
 _LAZY_IMPORTS = {
     "ModelServer": "deeplearning4j_tpu.serving.server",
+    "ModelRegistry": "deeplearning4j_tpu.serving.registry",
+    "ServingRouter": "deeplearning4j_tpu.serving.router",
     "ServingMetrics": "deeplearning4j_tpu.serving.metrics",
     "error_envelope": "deeplearning4j_tpu.serving.envelope",
     "BucketLadder": "deeplearning4j_tpu.serving.batcher",

@@ -27,7 +27,15 @@ re-exports from here).
   ``/metrics?format=prometheus`` serves text exposition alongside
   the JSON default; pass ``tracer=`` to ``ModelServer`` and one
   trace id follows each request across admission, queue wait, batch
-  assembly, and predict (``deeplearning4j_tpu/observability/``).
+  assembly, and predict (``deeplearning4j_tpu/observability/``);
+- ``registry.py`` — multi-tenant ``ModelRegistry``: N named models
+  per process with per-tenant admission quotas/deadlines and LRU
+  device-memory weight paging (cold tenants evict to host, fault
+  back in at transfer cost — never a compile — with a pin list);
+- ``router.py`` — ``ServingRouter``: thin HTTP front over N server
+  processes; rendezvous-hash placement on model id, least-loaded
+  fallback, ``/readyz``-aware health, and retry-next-backend on
+  503/connection failure (kill a backend mid-load, lose nothing).
 """
 
 from deeplearning4j_tpu.serving.batcher import (  # noqa: F401
@@ -52,6 +60,16 @@ from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
     Histogram,
     Reservoir,
     ServingMetrics,
+)
+from deeplearning4j_tpu.serving.registry import (  # noqa: F401
+    ModelEntry,
+    ModelRegistry,
+    ModelVersion,
+    page_in_model,
+    page_out_model,
+)
+from deeplearning4j_tpu.serving.router import (  # noqa: F401
+    ServingRouter,
 )
 from deeplearning4j_tpu.serving.server import (  # noqa: F401
     MAX_BODY,

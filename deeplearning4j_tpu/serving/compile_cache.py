@@ -85,7 +85,8 @@ class CompileCache:
         return ModelShapes()
 
     def note(self, shapes: Optional[ModelShapes],
-             shape: Tuple[int, ...]) -> str:
+             shape: Tuple[int, ...],
+             model: Optional[str] = None) -> str:
         if shapes is None:
             return WARM
         verdict = shapes.note(tuple(int(d) for d in shape))
@@ -97,17 +98,22 @@ class CompileCache:
             # compile events join the trace stream: a slow request
             # whose trace window brackets an xla.compile event has
             # its explanation in one place
-            self.tracer.event("xla.compile", attrs={
+            attrs = {
                 "shape": [int(d) for d in shape],
                 "verdict": verdict,
-            })
+            }
+            if model is not None:
+                attrs["model"] = model
+            self.tracer.event("xla.compile", attrs=attrs)
         if verdict == POST_WARMUP:
             if self.metrics is not None:
                 self.metrics.incr("post_warmup_compiles_total")
             logger.warning(
-                "post-warmup compile: input shape %s was not covered "
-                "by the warmed bucket ladder — this request paid the "
-                "compilation on the serving path", tuple(shape),
+                "post-warmup compile: input shape %s%s was not "
+                "covered by the warmed bucket ladder — this request "
+                "paid the compilation on the serving path",
+                tuple(shape),
+                f" (model {model!r})" if model is not None else "",
             )
         return verdict
 

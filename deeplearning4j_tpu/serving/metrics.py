@@ -35,6 +35,7 @@ compile counters ``xla_compiles_total`` /
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Optional, Sequence
 
 from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
@@ -64,6 +65,19 @@ COUNTER_HELP = {
     "xla_compiles_total": "forwards on a never-seen shape",
     "post_warmup_compiles_total": "ladder escapes (recompile guard)",
     "warmup_predicts_total": "eager bucket warmup forwards",
+    "quota_rejected_total": "503: a tenant exceeded its own quota",
+}
+
+# per-tenant mirrors of the request-outcome counters, labeled by
+# model name so one /metrics scrape reads every tenant's health. The
+# unlabeled process totals above are unchanged (dashboards and the
+# admission bound keep their meaning); these fan the same events out
+# per model. scripts/lint_metrics.py reads this table too.
+MODEL_COUNTER_HELP = {
+    "model_requests_total": "per-model: requests routed to the tenant",
+    "model_predictions_total": "per-model: successful predicts",
+    "model_shed_total": "per-model: 503s (quota / queue / draining)",
+    "model_deadline_timeout_total": "per-model: 504 deadline exceeded",
 }
 
 
@@ -111,6 +125,33 @@ class ServingMetrics:
             "inflight", help="admitted requests not yet answered"
         )._default()
         self.inflight = 0  # admitted, response not yet written
+        # per-tenant labeled families ("model" label). Instruments
+        # resolve lazily per tenant and cache in a plain dict — the
+        # hot path pays one dict get after the first request
+        self._model_counters = {
+            name: self.registry.counter(
+                name, help=MODEL_COUNTER_HELP[name], labels=("model",)
+            )
+            for name in MODEL_COUNTER_HELP
+        }
+        self._model_latency = self.registry.summary(
+            "model_latency_ms", reservoir_size=reservoir_size,
+            help="per-model end-to-end latency (ms), recent window",
+            labels=("model",),
+        )
+        self._model_occupancy = (
+            self.registry.histogram(
+                "model_batch_occupancy_rows", occupancy_buckets,
+                help="per-model valid rows per batched dispatch",
+                labels=("model",),
+            )
+            if occupancy_buckets else None
+        )
+        self._model_cache: dict = {}
+        # completion timestamps feed the adaptive Retry-After: the
+        # drain rate is completions-per-second over this window
+        self._completions: "deque[float]" = deque(maxlen=128)
+        self._completions_lock = threading.Lock()
 
     def incr(self, name: str, n: int = 1) -> None:
         if not self.registry.enabled:
@@ -129,7 +170,8 @@ class ServingMetrics:
         if self.registry.enabled:
             self._queue_delay.observe(seconds * 1000.0)
 
-    def record_batch(self, n_valid: int, bucket: int) -> None:
+    def record_batch(self, n_valid: int, bucket: int,
+                     model: Optional[str] = None) -> None:
         """One batched dispatch: ``n_valid`` real rows padded to
         ``bucket``. Occupancy is recorded in rows (the histogram's
         boundaries are the ladder), fill ratio rides in the mean."""
@@ -138,6 +180,59 @@ class ServingMetrics:
         self._counters["batches_total"].inc()
         if self._occupancy is not None:
             self._occupancy.observe(n_valid)
+        if model is not None and self._model_occupancy is not None:
+            self._model_instrument(
+                self._model_occupancy, model
+            ).observe(n_valid)
+
+    # -- per-tenant ("model" label) instruments -------------------------
+
+    def _model_instrument(self, family, model: str):
+        key = (family.name, model)
+        inst = self._model_cache.get(key)
+        if inst is None:
+            inst = family.labels(model)
+            self._model_cache[key] = inst
+        return inst
+
+    def incr_model(self, name: str, model: str, n: int = 1) -> None:
+        if not self.registry.enabled:
+            self._model_counters[name]  # unknown names still KeyError
+            return
+        self._model_instrument(self._model_counters[name], model).inc(n)
+
+    def get_model(self, name: str, model: str) -> int:
+        return self._model_instrument(
+            self._model_counters[name], model
+        ).value
+
+    def record_model_latency(self, model: str, seconds: float) -> None:
+        if self.registry.enabled:
+            self._model_instrument(self._model_latency, model).observe(
+                seconds * 1000.0
+            )
+
+    # -- drain rate (adaptive Retry-After input) ------------------------
+
+    def note_completion(self, now: float) -> None:
+        """One request left the system (answered, not shed at the
+        door). The recent completion rate IS the drain rate a shed
+        client should pace its retry by — exact even in no-op
+        registry mode, like the admission bound."""
+        with self._completions_lock:
+            self._completions.append(now)
+
+    def drain_rate(self) -> Optional[float]:
+        """Completions per second over the recent window; None until
+        two completions exist (callers fall back to the static
+        knob)."""
+        with self._completions_lock:
+            if len(self._completions) < 2:
+                return None
+            span = self._completions[-1] - self._completions[0]
+            if span <= 0:
+                return None
+            return (len(self._completions) - 1) / span
 
     # NB: inflight accounting below is the ADMISSION BOUND, not
     # telemetry — it stays exact in no-op mode; only the gauge
@@ -176,4 +271,21 @@ class ServingMetrics:
         out["queue_delay_ms"] = self._queue_delay.snapshot()
         if self._occupancy is not None:
             out["batch_occupancy_rows"] = self._occupancy.snapshot()
+        models = self.model_snapshot()
+        if models:
+            out["models"] = models
+        return out
+
+    def model_snapshot(self) -> dict:
+        """{model: {counter values + latency quantiles}} — per-tenant
+        p50/p99 from one scrape."""
+        out: dict = {}
+        for name, fam in self._model_counters.items():
+            for inst in fam.children():
+                model = inst.label_values[0]
+                out.setdefault(model, {})[name] = inst.value
+        for inst in self._model_latency.children():
+            out.setdefault(inst.label_values[0], {})[
+                "latency_ms"
+            ] = inst.snapshot()
         return out

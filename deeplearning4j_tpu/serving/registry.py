@@ -1,0 +1,442 @@
+"""Multi-tenant model registry + LRU device-memory weight paging.
+
+One serving process, N named models. The TensorFlow-paper deployment
+story (PAPERS.md) is many models/versions sharing one accelerator:
+the fleet's working set exceeds device memory, so weights page —
+cold models' parameters live in host memory and fault back onto the
+device on demand, exactly like an OS page cache with a pin list for
+the tenants that must never miss.
+
+- ``ModelEntry``: one tenant — its current ``ModelVersion`` (the
+  same immutable snapshot object ``server.py`` always swapped on hot
+  reload, so in-flight requests still finish on the version they
+  started with), its admission quota and deadline override, its
+  optional per-model bucket ladder, and its paging state
+  (``device``/``host`` residency, parameter bytes, LRU timestamp).
+- ``ModelRegistry``: the name -> entry map plus the paging policy.
+  ``touch(entry)`` brackets every forward: it bumps the LRU clock,
+  faults the weights back in when evicted (measured in
+  ``weight_pagein_ms``), and marks the entry *executing* so the
+  evictor never pages a model out from under a running forward.
+  ``max_device_models`` / ``max_device_bytes`` bound the resident
+  set; the victim is always the least-recently-used unpinned idle
+  entry.
+
+Paging moves ONLY the weights (``params`` + ``state`` pytrees):
+device -> host is ``jax.device_get`` into numpy, host -> device is
+``jax.device_put`` back. Shapes and dtypes never change, so the
+jitted executables (and any AOT-installed ones, ``compile/aot.py``)
+stay valid across a page-out/page-in cycle — a fault-in costs one
+transfer, never a compile, and outputs are bitwise identical
+(``tests/test_fleet.py`` asserts both).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+DEVICE = "device"
+HOST = "host"
+
+# paging moves these model attributes (pytrees of arrays); anything
+# else a model carries (conf, updater defs, jit caches) stays put
+_PAGEABLE_ATTRS = ("params", "state")
+
+
+class ModelVersion:
+    """One immutable (model, version) pair. Workers snapshot the
+    reference at predict start, so an atomic swap never changes the
+    model under an in-flight request. ``shapes`` is this version's
+    compile-cache record (the set of input shapes it has executed,
+    warmed over the bucket ladder before the version takes
+    traffic)."""
+
+    __slots__ = ("model", "version", "source", "shapes")
+
+    def __init__(self, model, version: int, source: str, shapes=None):
+        self.model = model
+        self.version = version
+        self.source = source
+        self.shapes = shapes
+
+
+def _tree_device_bytes(model) -> int:
+    """Bytes of pageable weight arrays currently on ``model``."""
+    import jax
+
+    total = 0
+    for attr in _PAGEABLE_ATTRS:
+        tree = getattr(model, attr, None)
+        if tree is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(tree):
+            total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
+def page_out_model(model) -> int:
+    """Move the model's weight pytrees device -> host (numpy).
+    Returns the bytes moved. Models with no pageable arrays (stubs)
+    move 0 bytes and are otherwise untouched."""
+    import jax
+
+    moved = 0
+    for attr in _PAGEABLE_ATTRS:
+        tree = getattr(model, attr, None)
+        if tree is None:
+            continue
+
+        def to_host(leaf):
+            nonlocal moved
+            if isinstance(leaf, jax.Array):
+                moved += int(leaf.nbytes)
+                return np.asarray(jax.device_get(leaf))
+            return leaf
+
+        setattr(model, attr, jax.tree_util.tree_map(to_host, tree))
+    return moved
+
+
+def page_in_model(model) -> int:
+    """Move the model's weight pytrees host -> device. Returns the
+    bytes moved. Blocks until the transfer completes so the measured
+    fault-in latency is the real transfer cost, not an async
+    enqueue."""
+    import jax
+
+    moved = 0
+    trees = []
+    for attr in _PAGEABLE_ATTRS:
+        tree = getattr(model, attr, None)
+        if tree is None:
+            continue
+
+        def to_dev(leaf):
+            nonlocal moved
+            if isinstance(leaf, np.ndarray):
+                moved += int(leaf.nbytes)
+                return jax.device_put(leaf)
+            return leaf
+
+        new = jax.tree_util.tree_map(to_dev, tree)
+        setattr(model, attr, new)
+        trees.append(new)
+    if moved:
+        jax.block_until_ready(trees)
+    return moved
+
+
+class ModelEntry:
+    """One tenant: current version + admission policy + paging state.
+    Residency/LRU fields are guarded by the owning registry's lock;
+    the admission counter has its own (it is touched on the handler
+    fast path, never during paging)."""
+
+    __slots__ = ("name", "current", "quota", "deadline", "pinned",
+                 "ladder", "source_path", "resident", "nbytes",
+                 "last_used", "executing", "inflight", "_adm_lock")
+
+    def __init__(self, name: str, current: ModelVersion, *,
+                 quota: Optional[int] = None,
+                 deadline: Optional[float] = None,
+                 pinned: bool = False, ladder=None,
+                 source_path: Optional[str] = None):
+        self.name = name
+        self.current = current
+        self.quota = quota
+        self.deadline = deadline
+        self.pinned = pinned
+        self.ladder = ladder
+        self.source_path = source_path
+        self.resident = DEVICE
+        self.nbytes = 0
+        self.last_used = 0.0
+        self.executing = 0   # forwards running now (evictor skips >0)
+        self.inflight = 0    # admitted, not yet answered (quota bound)
+        self._adm_lock = threading.Lock()
+
+    # -- per-tenant admission (the quota bound) -------------------------
+
+    def admit(self) -> bool:
+        """Count one request against this tenant's quota; False sheds
+        it. ``quota=None`` means the tenant only shares the global
+        bound."""
+        with self._adm_lock:
+            if self.quota is not None and self.inflight >= self.quota:
+                return False
+            self.inflight += 1
+            return True
+
+    def exit_admission(self) -> None:
+        with self._adm_lock:
+            self.inflight -= 1
+
+
+class ModelRegistry:
+    """Name -> ``ModelEntry`` map + the LRU weight-paging policy.
+
+    ``max_device_models`` / ``max_device_bytes`` bound the
+    device-resident set (None = unbounded: nothing ever pages, the
+    single-tenant behavior). Pinned entries never page out. All
+    residency transitions happen under one lock; ``touch``/
+    ``release`` bracket forwards so a model is never paged out while
+    executing.
+    """
+
+    def __init__(self, *, max_device_models: Optional[int] = None,
+                 max_device_bytes: Optional[int] = None,
+                 metrics_registry=None,
+                 clock=time.monotonic):
+        if max_device_models is not None and max_device_models < 1:
+            raise ValueError("max_device_models must be >= 1")
+        self.max_device_models = max_device_models
+        self.max_device_bytes = max_device_bytes
+        self._entries: Dict[str, ModelEntry] = {}
+        self._default_name: Optional[str] = None
+        self._lock = threading.RLock()
+        self._clock = clock
+        reg = metrics_registry
+        self._pagein_total = reg.counter(
+            "weight_pagein_total",
+            help="paging: cold-model fault-ins (host -> device)",
+        ) if reg is not None else None
+        self._evict_total = reg.counter(
+            "weight_evict_total",
+            help="paging: LRU weight evictions (device -> host)",
+        ) if reg is not None else None
+        self._pagein_ms = reg.summary(
+            "weight_pagein_ms",
+            help="paging: measured fault-in transfer latency",
+        ) if reg is not None else None
+        self._pageout_ms = reg.summary(
+            "weight_pageout_ms",
+            help="paging: measured eviction transfer latency",
+        ) if reg is not None else None
+        self._resident_models = reg.gauge(
+            "device_resident_models",
+            help="paging: models with device-resident weights",
+        ) if reg is not None else None
+        self._resident_bytes = reg.gauge(
+            "device_resident_bytes",
+            help="paging: bytes of device-resident weights",
+        ) if reg is not None else None
+
+    # -- membership -----------------------------------------------------
+
+    def add(self, name: str, current: ModelVersion, *,
+            quota: Optional[int] = None,
+            deadline: Optional[float] = None,
+            pinned: bool = False, ladder=None,
+            source_path: Optional[str] = None,
+            default: bool = False) -> ModelEntry:
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"model {name!r} already registered")
+            entry = ModelEntry(
+                name, current, quota=quota, deadline=deadline,
+                pinned=pinned, ladder=ladder, source_path=source_path,
+            )
+            entry.nbytes = _tree_device_bytes(current.model)
+            entry.last_used = self._clock()
+            self._entries[name] = entry
+            if default or self._default_name is None:
+                self._default_name = name
+            self._publish_gauges()
+            return entry
+
+    def entry(self, name: Optional[str] = None) -> ModelEntry:
+        """Resolve a tenant by name (None = the default tenant).
+        Raises ``KeyError`` with the known names for the 404 path."""
+        with self._lock:
+            if name is None:
+                name = self._default_name
+            e = self._entries.get(name)
+            if e is None:
+                raise KeyError(
+                    f"unknown model {name!r}; serving "
+                    f"{sorted(self._entries)}"
+                )
+            return e
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    @property
+    def default_name(self) -> Optional[str]:
+        return self._default_name
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def pin(self, name: str, pinned: bool = True) -> None:
+        """(Un)pin a tenant. Pinning faults the weights in NOW —
+        a pinned tenant must never pay a miss on the request path."""
+        with self._lock:
+            entry = self.entry(name)
+            entry.pinned = pinned
+            if pinned:
+                self._ensure_resident(entry)
+                self._enforce_budget(protect=entry)
+
+    # -- the forward bracket --------------------------------------------
+
+    def touch(self, entry: ModelEntry) -> Optional[float]:
+        """Called just before a forward on ``entry``: bump the LRU
+        clock, mark it executing (evictor-proof), and fault the
+        weights in when paged out. Returns the fault-in milliseconds
+        (None = was already resident). Pair with ``release``."""
+        with self._lock:
+            entry.last_used = self._clock()
+            entry.executing += 1
+            try:
+                ms = self._ensure_resident(entry)
+                if ms is not None:
+                    # the fault-in may have pushed the resident set
+                    # over budget: evict coldest idle entries
+                    self._enforce_budget(protect=entry)
+            except BaseException:
+                entry.executing -= 1  # a failed fault-in must not
+                raise                 # wedge the entry as "executing"
+            return ms
+
+    def release(self, entry: ModelEntry) -> None:
+        with self._lock:
+            entry.executing -= 1
+
+    def swap(self, entry: ModelEntry, new_version: ModelVersion) -> None:
+        """Atomic hot-reload swap for one tenant. The new weights are
+        device-resident (restore + warmup just ran them)."""
+        with self._lock:
+            entry.current = new_version
+            entry.resident = DEVICE
+            entry.nbytes = _tree_device_bytes(new_version.model)
+            entry.last_used = self._clock()
+            self._enforce_budget(protect=entry)
+            self._publish_gauges()
+
+    # -- paging policy (all under self._lock) ---------------------------
+
+    def _ensure_resident(self, entry: ModelEntry) -> Optional[float]:
+        if entry.resident == DEVICE:
+            return None
+        t0 = time.perf_counter()
+        page_in_model(entry.current.model)
+        ms = (time.perf_counter() - t0) * 1000.0
+        entry.resident = DEVICE
+        if self._pagein_total is not None:
+            self._pagein_total.inc()
+            self._pagein_ms.observe(ms)
+        logger.info("paged model %r in (%d bytes, %.2f ms)",
+                    entry.name, entry.nbytes, ms)
+        self._publish_gauges()
+        return ms
+
+    def _evict(self, entry: ModelEntry) -> None:
+        t0 = time.perf_counter()
+        page_out_model(entry.current.model)
+        ms = (time.perf_counter() - t0) * 1000.0
+        entry.resident = HOST
+        if self._evict_total is not None:
+            self._evict_total.inc()
+            self._pageout_ms.observe(ms)
+        logger.info("evicted model %r to host (%d bytes, %.2f ms)",
+                    entry.name, entry.nbytes, ms)
+
+    def _resident_set(self) -> List[ModelEntry]:
+        return [e for e in self._entries.values()
+                if e.resident == DEVICE]
+
+    def _over_budget(self) -> bool:
+        res = self._resident_set()
+        if (self.max_device_models is not None
+                and len(res) > self.max_device_models):
+            return True
+        if (self.max_device_bytes is not None
+                and sum(e.nbytes for e in res) > self.max_device_bytes):
+            return True
+        return False
+
+    def _enforce_budget(self,
+                        protect: Optional[ModelEntry] = None) -> int:
+        """Evict least-recently-used unpinned idle entries until the
+        resident set fits the budget. Returns evictions performed.
+        Stops (over budget, logged) when every remaining candidate is
+        pinned, executing, or the protected entry — correctness over
+        the budget, never a forward on half-paged weights."""
+        evicted = 0
+        while self._over_budget():
+            victims = [
+                e for e in self._resident_set()
+                if not e.pinned and e.executing == 0 and e is not protect
+            ]
+            if not victims:
+                logger.warning(
+                    "weight paging over budget but every resident "
+                    "model is pinned or executing; not evicting"
+                )
+                break
+            self._evict(min(victims, key=lambda e: e.last_used))
+            evicted += 1
+        if evicted:
+            self._publish_gauges()
+        return evicted
+
+    def enforce_budget(self) -> int:
+        """Public entry point (used after start()-time warmup, which
+        intentionally runs every tenant once through the device)."""
+        with self._lock:
+            return self._enforce_budget()
+
+    def _publish_gauges(self) -> None:
+        if self._resident_models is None:
+            return
+        res = self._resident_set()
+        self._resident_models.set(len(res))
+        self._resident_bytes.set(sum(e.nbytes for e in res))
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``/metrics`` paging block + per-tenant states."""
+        with self._lock:
+            res = self._resident_set()
+            now = self._clock()
+            return {
+                "max_device_models": self.max_device_models,
+                "max_device_bytes": self.max_device_bytes,
+                "device_resident_models": len(res),
+                "device_resident_bytes": sum(e.nbytes for e in res),
+                "weight_pagein_total": (
+                    self._pagein_total.value
+                    if self._pagein_total is not None else 0
+                ),
+                "weight_evict_total": (
+                    self._evict_total.value
+                    if self._evict_total is not None else 0
+                ),
+                "weight_pagein_ms": (
+                    self._pagein_ms.snapshot()
+                    if self._pagein_ms is not None else None
+                ),
+                "models": {
+                    e.name: {
+                        "version": e.current.version,
+                        "resident": e.resident,
+                        "nbytes": e.nbytes,
+                        "pinned": e.pinned,
+                        "quota": e.quota,
+                        "deadline": e.deadline,
+                        "inflight": e.inflight,
+                        "idle_s": round(max(now - e.last_used, 0.0), 3),
+                    }
+                    for e in self._entries.values()
+                },
+            }

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import queue
 import threading
 import time
@@ -86,10 +87,21 @@ from deeplearning4j_tpu.serving.envelope import (
     read_request_body,
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.registry import (
+    ModelEntry,
+    ModelRegistry,
+    ModelVersion,
+)
 
 logger = logging.getLogger(__name__)
 
 MAX_BODY = 64 * 1024 * 1024
+
+# adaptive Retry-After clamp: a shed client should pace by observed
+# queue drain, never told "come back immediately" nor parked longer
+# than any queue this tier is allowed to build
+RETRY_AFTER_MIN = 0.05
+RETRY_AFTER_MAX = 5.0
 
 
 def _feature_dim(model) -> Optional[int]:
@@ -104,20 +116,9 @@ def _feature_dim(model) -> Optional[int]:
     return None
 
 
-class _ModelVersion:
-    """One immutable (model, version) pair. Workers snapshot the
-    reference at predict start, so an atomic swap never changes the
-    model under an in-flight request. ``shapes`` is this version's
-    compile-cache record (the set of input shapes it has executed,
-    warmed over the bucket ladder before the version takes traffic)."""
-
-    __slots__ = ("model", "version", "source", "shapes")
-
-    def __init__(self, model, version: int, source: str, shapes=None):
-        self.model = model
-        self.version = version
-        self.source = source
-        self.shapes = shapes
+# the immutable (model, version) snapshot moved to registry.py with
+# the multi-tenant registry; the name stays importable from here
+_ModelVersion = ModelVersion
 
 
 class _NoReloadSource(ValueError):
@@ -142,9 +143,11 @@ class _WorkItem:
     __slots__ = ("features", "deadline", "done", "response", "lock",
                  "started", "cancelled", "timed_out", "rows",
                  "squeeze", "enqueued_at", "span", "queue_span",
-                 "assembly_span")
+                 "assembly_span", "entry")
 
-    def __init__(self, features, deadline: Deadline):
+    def __init__(self, features, deadline: Deadline,
+                 entry: Optional[ModelEntry] = None):
+        self.entry = entry  # the tenant this predict belongs to
         # trace handoff: the handler thread sets ``span`` (the
         # request's root) and ``queue_span`` before enqueueing; the
         # drain thread ends the queue span and parents its batch/
@@ -185,8 +188,18 @@ class ModelServer:
         GET  /healthz       liveness: process up
         GET  /readyz        readiness: routable (flips under stress)
         GET  /metrics       counters + latency quantiles (JSON)
-        POST /predict       {"features": [[...]]} -> {"output": ...}
-        POST /admin/reload  {} | {"path": ...} | {"key": ...}
+        GET  /models        per-tenant registry + paging states
+        POST /predict       {"features": [[...]], "model": name?}
+        POST /admin/reload  {} | {"path"|"key": ..., "model": name?}
+
+    Multi-tenant mode: ``models={name: model | path | spec-dict}``
+    serves N named models from this one process. Each tenant gets
+    its own admission quota (``{"quota": k}`` — overload sheds 503
+    ``tenant_quota`` against the tenant's own bound, never its
+    neighbors'), deadline override, optional bucket ladder, and
+    paging state; ``max_device_models`` / ``max_device_bytes``
+    LRU-page cold tenants' weights to host memory (``registry.py``),
+    faulted back in on demand at transfer cost — never a compile.
 
     ``model_or_path`` may be a model instance, a checkpoint zip path,
     or None with ``checkpoint_manager=`` (restores the latest
@@ -237,7 +250,10 @@ class ModelServer:
                  batch_workers: int = 1,
                  tracer: Optional[Tracer] = None,
                  compile_cache=True,
-                 aot: bool = True):
+                 aot: bool = True,
+                 models: Optional[dict] = None,
+                 max_device_models: Optional[int] = None,
+                 max_device_bytes: Optional[int] = None):
         if workers < 1:
             raise ValueError("workers must be >= 1")
         if queue_depth < 0:
@@ -303,9 +319,29 @@ class ModelServer:
         self._source_path: Optional[str] = None
         self._watched_step: Optional[int] = None
         self._last_restore_info = None  # CheckpointInfo when manager-sourced
-        model, source = self._initial_model(model_or_path)
-        shapes = self.compile_cache.register()
-        self._active = _ModelVersion(model, 1, source, shapes)
+        # multi-tenant registry: the single-model constructor path
+        # becomes the "default" tenant; ``models=`` adds named
+        # tenants (instance | checkpoint path | spec dict with
+        # quota/deadline/pinned/max_batch_size overrides). The
+        # paging budget (max_device_models / max_device_bytes)
+        # LRU-evicts cold tenants' weights to host memory.
+        self.model_registry = ModelRegistry(
+            max_device_models=max_device_models,
+            max_device_bytes=max_device_bytes,
+            metrics_registry=self.metrics.registry,
+        )
+        if (model_or_path is not None
+                or self.checkpoint_manager is not None
+                or not models):
+            model, source = self._initial_model(model_or_path)
+            self.model_registry.add(
+                "default",
+                _ModelVersion(model, 1, source,
+                              self.compile_cache.register()),
+                source_path=self._source_path, default=True,
+            )
+        for name, spec in (models or {}).items():
+            self._add_model(name, spec)
 
         self._model_lock = threading.Lock()
         self._reload_lock = threading.Lock()
@@ -325,7 +361,13 @@ class ModelServer:
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
-    # back-compat: the pre-hardening server exposed ``.model``
+    # back-compat: the pre-hardening server exposed ``.model``, and
+    # the single-tenant tier exposed ``._active`` — both now resolve
+    # through the default tenant's entry
+    @property
+    def _active(self) -> ModelVersion:
+        return self.model_registry.entry().current
+
     @property
     def model(self):
         return self._active.model
@@ -333,6 +375,53 @@ class ModelServer:
     @property
     def model_version(self) -> int:
         return self._active.version
+
+    def _add_model(self, name: str, spec) -> ModelEntry:
+        """Register one named tenant. ``spec`` is a model instance, a
+        checkpoint zip path, or a dict: ``{"model": ... | "path":
+        ..., "quota": int, "deadline": s, "pinned": bool,
+        "max_batch_size": int | "ladder": [...]}`` — quota/deadline
+        default to the server-wide knobs, the ladder to the shared
+        one."""
+        opts = {}
+        source_path = None
+        if isinstance(spec, dict):
+            opts = spec
+            spec = opts.get("model", opts.get("path"))
+            if spec is None:
+                raise ValueError(
+                    f"model {name!r}: spec dict needs a 'model' "
+                    "instance or a 'path'"
+                )
+        if isinstance(spec, str):
+            from deeplearning4j_tpu.util.model_serializer import (
+                restore_model,
+            )
+
+            source_path = spec
+            model = restore_model(spec, load_updater=False)
+        else:
+            model = spec
+        ladder = None
+        if opts.get("ladder") is not None:
+            ladder = BucketLadder(opts["ladder"])
+        elif opts.get("max_batch_size") is not None:
+            ladder = BucketLadder(None, opts["max_batch_size"])
+        return self.model_registry.add(
+            name,
+            _ModelVersion(model, 1, source_path or type(model).__name__,
+                          self.compile_cache.register()),
+            quota=opts.get("quota"),
+            deadline=opts.get("deadline"),
+            pinned=bool(opts.get("pinned", False)),
+            ladder=ladder,
+            source_path=source_path,
+        )
+
+    def _ladder_for(self, entry: ModelEntry) -> Optional[BucketLadder]:
+        if self.batcher is None:
+            return None
+        return entry.ladder or self.batcher.ladder
 
     def _initial_model(self, model_or_path):
         if isinstance(model_or_path, str):
@@ -366,19 +455,28 @@ class ModelServer:
             self._active.model, self._active.shapes,
             self._last_restore_info,
         )
-        # eager warmup BEFORE the pool takes traffic: every ladder
-        # bucket compiles now, so the first requests never pay an XLA
-        # compile inside their deadline budget. Best-effort here — a
-        # faulty model/transform must keep surfacing as per-request
-        # 500 envelopes, not kill start() (at reload() the same
-        # failure DOES fail the reload and keeps the old version)
-        try:
-            self._warm_model(self._active.model, self._active.shapes)
-        except Exception:
-            logger.exception(
-                "bucket warmup failed; serving unwarmed (requests "
-                "will surface the fault per-request)"
-            )
+        # eager warmup BEFORE the pool takes traffic: every tenant's
+        # ladder buckets compile now, so the first requests never pay
+        # an XLA compile inside their deadline budget. Best-effort
+        # here — a faulty model/transform must keep surfacing as
+        # per-request 500 envelopes, not kill start() (at reload()
+        # the same failure DOES fail the reload and keeps the old
+        # version)
+        for name in self.model_registry.names():
+            entry = self.model_registry.entry(name)
+            try:
+                self._warm_model(entry.current.model,
+                                 entry.current.shapes,
+                                 self._ladder_for(entry))
+            except Exception:
+                logger.exception(
+                    "bucket warmup failed for model %r; serving "
+                    "unwarmed (requests will surface the fault "
+                    "per-request)", name,
+                )
+        # warmup ran every tenant through the device on purpose (the
+        # executables must exist); now page the over-budget tail out
+        self.model_registry.enforce_budget()
         for i in range(self.batch_workers):
             t = threading.Thread(
                 target=self._worker_loop, daemon=True,
@@ -452,9 +550,12 @@ class ModelServer:
             item.started = True
         if item.queue_span is not None:
             item.queue_span.end()  # idempotent; batch path ends first
+        entry = item.entry or self.model_registry.entry()
         if item.deadline.expired():
             # expired while queued: report without touching the model
             self.metrics.incr("deadline_timeout_total")
+            self.metrics.incr_model("model_deadline_timeout_total",
+                                    entry.name)
             item.finish(504, deadline_envelope(
                 item.deadline, "deadline expired while queued",
             ))
@@ -467,16 +568,24 @@ class ModelServer:
                 retry_after=round(self.breaker.retry_after(), 3),
             ), {"Retry-After": self._retry_after_header()})
             return
-        mv = self._active  # snapshot: reloads swap for later requests
+        # the forward bracket: bump the tenant's LRU clock, fault its
+        # weights in when paged out, and hold the executing mark so
+        # the evictor cannot page it out mid-forward
+        pagein_ms = self.model_registry.touch(entry)
+        mv = entry.current  # snapshot: reloads swap for later requests
         pspan = self.tracer.start_span(
             "serving.predict", parent=item.span,
-            attrs={"mode": "solo", "model_version": mv.version},
+            attrs={"mode": "solo", "model": entry.name,
+                   "model_version": mv.version},
         )
+        if pagein_ms is not None:
+            pspan.set_attr("weight_pagein_ms", round(pagein_ms, 3))
         try:
             feats = item.features
             if self.transform is not None:
                 feats = self.transform(feats)
-            self.compile_cache.note(mv.shapes, np.shape(feats))
+            self.compile_cache.note(mv.shapes, np.shape(feats),
+                                    model=entry.name)
             out = mv.model.output(feats)
             out = np.asarray(
                 out[0] if isinstance(out, (list, tuple)) else out
@@ -494,12 +603,17 @@ class ModelServer:
                 error_id=eid,
             ))
             return
+        finally:
+            self.model_registry.release(entry)
         pspan.end()
         self.breaker.record_success()
         body = {"output": out.tolist(), "model_version": mv.version}
+        if len(self.model_registry) > 1:
+            body["model"] = entry.name
         if self.output_classes and out.ndim == 2:
             body["classes"] = out.argmax(axis=1).tolist()
         self.metrics.incr("predictions_total")
+        self.metrics.incr_model("model_predictions_total", entry.name)
         if not item.finish(200, body):
             self.metrics.incr("abandoned_total")
 
@@ -510,9 +624,9 @@ class ModelServer:
         the solo path, transform per request, then pack what remains
         into bucket-padded chunks and run ONE forward per chunk."""
         now = time.monotonic()
-        mv = self._active  # one snapshot for the whole batch
         ready: List[tuple] = []
         for item in items:
+            entry = item.entry or self.model_registry.entry()
             with item.lock:
                 if item.cancelled:
                     continue
@@ -528,6 +642,8 @@ class ModelServer:
                 # dropped BEFORE stacking: never pads a dead request
                 # into a live batch
                 self.metrics.incr("deadline_timeout_total")
+                self.metrics.incr_model("model_deadline_timeout_total",
+                                        entry.name)
                 self.metrics.incr("batch_expired_total")
                 item.assembly_span.end("timeout")
                 item.finish(504, deadline_envelope(
@@ -535,7 +651,7 @@ class ModelServer:
                     "deadline expired while coalescing",
                 ))
                 continue
-            if item.rows > self.batcher.ladder.max:
+            if item.rows > self._ladder_for(entry).max:
                 # wider than the largest bucket: solo path, own compile
                 self.metrics.incr("solo_fallback_total")
                 item.assembly_span.set_attr(
@@ -570,20 +686,24 @@ class ModelServer:
             ready.append((item, feats))
         if not ready:
             return
-        # group by trailing shape + dtype: only same-width requests can
-        # share a stacked forward (width varies only when the model
-        # declares no n_in for parse_features to enforce)
+        # group by tenant + trailing shape + dtype: only same-model,
+        # same-width requests can share a stacked forward (width
+        # varies only when the model declares no n_in for
+        # parse_features to enforce)
         groups: dict = {}
         for item, feats in ready:
-            key = (feats.shape[1:], feats.dtype.str)
-            groups.setdefault(key, []).append((item, feats))
-        for pairs in groups.values():
-            for chunk in fill_chunks(pairs, self.batcher.ladder.max):
-                self._predict_chunk(mv, chunk)
+            entry = item.entry or self.model_registry.entry()
+            key = (entry.name, feats.shape[1:], feats.dtype.str)
+            groups.setdefault(key, (entry, []))[1].append((item, feats))
+        for entry, pairs in groups.values():
+            ladder = self._ladder_for(entry)
+            for chunk in fill_chunks(pairs, ladder.max):
+                self._predict_chunk(entry, ladder, chunk)
 
-    def _predict_chunk(self, mv: _ModelVersion, chunk) -> None:
-        """ONE padded forward for a chunk of (item, features) pairs,
-        sliced back out and completed per request."""
+    def _predict_chunk(self, entry: ModelEntry, ladder: BucketLadder,
+                       chunk) -> None:
+        """ONE padded forward for a chunk of (item, features) pairs
+        of one tenant, sliced back out and completed per request."""
         for item, _ in chunk:
             if item.assembly_span is not None:
                 item.assembly_span.end()
@@ -599,23 +719,30 @@ class ModelServer:
                 item.finish(503, body, headers)
             return
         n_valid = sum(int(f.shape[0]) for _, f in chunk)
-        bucket = self.batcher.ladder.bucket_for(n_valid)
+        bucket = ladder.bucket_for(n_valid)
+        pagein_ms = self.model_registry.touch(entry)
+        mv = entry.current  # snapshot: reloads swap for later requests
         pspans = [
             self.tracer.start_span(
                 "serving.predict", parent=item.span,
                 attrs={"mode": "batched", "bucket": bucket,
                        "n_valid": n_valid, "chunk_size": len(chunk),
+                       "model": entry.name,
                        "model_version": mv.version},
             )
             for item, _ in chunk
         ]
+        if pagein_ms is not None and pspans:
+            pspans[0].set_attr("weight_pagein_ms",
+                               round(pagein_ms, 3))
         try:
             stacked = (
                 chunk[0][1] if len(chunk) == 1
                 else np.concatenate([f for _, f in chunk], axis=0)
             )
             padded = pad_rows(stacked, bucket)
-            self.compile_cache.note(mv.shapes, padded.shape)
+            self.compile_cache.note(mv.shapes, padded.shape,
+                                    model=entry.name)
             out = self._padded_forward(mv.model, padded, n_valid)
         except Exception as e:
             self.breaker.record_failure()
@@ -633,14 +760,19 @@ class ModelServer:
             for item, _ in chunk:
                 item.finish(500, body)
             return
+        finally:
+            self.model_registry.release(entry)
         for sp in pspans:
             sp.end()
         self.breaker.record_success()
-        self.metrics.record_batch(n_valid, bucket)
+        self.metrics.record_batch(n_valid, bucket, entry.name)
         self.metrics.incr("batched_predictions_total", len(chunk))
         self.metrics.incr("predictions_total", len(chunk))
+        self.metrics.incr_model("model_predictions_total", entry.name,
+                                len(chunk))
         off = 0
         abandoned = 0
+        multi = len(self.model_registry) > 1
         for item, feats in chunk:
             rows = int(feats.shape[0])
             o = out[off:off + rows]
@@ -648,6 +780,8 @@ class ModelServer:
             if item.squeeze:
                 o = o[0]
             body = {"output": o.tolist(), "model_version": mv.version}
+            if multi:
+                body["model"] = entry.name
             if self.output_classes and o.ndim == 2:
                 body["classes"] = o.argmax(axis=1).tolist()
             if not item.finish(200, body):
@@ -671,13 +805,15 @@ class ModelServer:
         out = out[0] if isinstance(out, (list, tuple)) else out
         return np.asarray(out)[:n_valid]
 
-    def _warm_model(self, model, shapes) -> int:
+    def _warm_model(self, model, shapes, ladder=None) -> int:
         """Eagerly run every ladder bucket through the padded forward
         so all steady-state executables exist BEFORE the model takes
         traffic. Returns the number of warmup forwards (0 when
         batching is off or the input width is unknowable)."""
         if self.batcher is None:
             return 0
+        if ladder is None:
+            ladder = self.batcher.ladder
         feats = self._canary_features(model)
         if feats is None:
             logger.info(
@@ -691,7 +827,7 @@ class ModelServer:
         if feats.ndim == 1:
             feats = feats[None, :]
         n = 0
-        for b in self.batcher.ladder.buckets:
+        for b in ladder.buckets:
             padded = pad_rows(feats[:b], b)
             self.compile_cache.note(shapes, padded.shape)
             self._padded_forward(model, padded, padded.shape[0])
@@ -752,30 +888,64 @@ class ModelServer:
             return None
         return np.zeros((1, n_in), np.float32)
 
+    def retry_after_value(self) -> float:
+        """Adaptive Retry-After: how long until a retry would find a
+        slot, estimated as queue depth over the observed drain rate
+        (recent completions per second), clamped to
+        [``RETRY_AFTER_MIN``, min(``RETRY_AFTER_MAX``, knob)]. Before
+        any completion exists (cold start, wedged pool) the knob is
+        the answer — it remains the upper bound, never the constant.
+        """
+        cap = min(RETRY_AFTER_MAX, self.retry_after)
+        cap = max(cap, RETRY_AFTER_MIN)
+        rate = self.metrics.drain_rate()
+        if rate is None or rate <= 0:
+            return cap
+        est = self._queue.qsize() / rate
+        return min(cap, max(RETRY_AFTER_MIN, est))
+
     def _retry_after_header(self) -> str:
-        return str(max(1, int(round(self.retry_after))))
+        # HTTP Retry-After is integer seconds: round the adaptive
+        # value up so the header never understates the JSON body's
+        # precise ``retry_after`` float
+        return str(max(1, int(math.ceil(self.retry_after_value()))))
 
     # -- admission (called from handler threads) ------------------------
 
-    def submit(self, features) -> "tuple[int, dict, dict]":
+    def submit(self, features,
+               model: Optional[str] = None) -> "tuple[int, dict, dict]":
         """Admit one predict through the bounded pool and wait for its
-        result under the request deadline. Returns
+        result under the request deadline. ``model`` routes to a
+        named tenant (None = the default). Returns
         ``(status, body, headers)``. One root span brackets the whole
         request; the admission decision, queue wait, batch assembly,
         and predict are children sharing its trace id."""
+        try:
+            entry = self.model_registry.entry(model)
+        except KeyError:
+            self.metrics.incr("client_error_total")
+            return 404, error_envelope(
+                "model_not_found", 404,
+                f"no model named {model!r}",
+                models=self.model_registry.names(),
+            ), {}
+        started = time.monotonic()
         shape = np.shape(features)
         root = self.tracer.start_span("serving.request", attrs={
             "rows": int(shape[0]) if len(shape) >= 2 else 1,
+            "model": entry.name,
         })
         adm = self.tracer.start_span("serving.admission",
                                      parent=root)
+        self.metrics.incr_model("model_requests_total", entry.name)
         if self._draining:
             self.metrics.incr("shed_total")
+            self.metrics.incr_model("model_shed_total", entry.name)
             adm.set_attr("outcome", "draining").end("shed")
             root.set_attr("status_code", 503).end("shed")
             return 503, error_envelope(
                 "draining", 503, "server is draining; not admitting",
-                retry_after=self.retry_after,
+                retry_after=round(self.retry_after_value(), 3),
             ), {"Retry-After": self._retry_after_header()}
         if self.breaker.state == OPEN:
             # fail fast at admission: no queue slot for a doomed call
@@ -787,19 +957,38 @@ class ModelServer:
                 "model circuit is open; failing fast",
                 retry_after=round(self.breaker.retry_after(), 3),
             ), {"Retry-After": self._retry_after_header()}
-        # admission bound: at most workers + queue_depth requests in
-        # the system (executing + queued); the excess is shed NOW
-        if not self.metrics.try_enter(self.workers + self.queue_depth):
+        # per-tenant quota FIRST: one tenant at 10x its quota sheds
+        # against its own bound and never consumes global slots its
+        # neighbors are entitled to
+        if not entry.admit():
             self.metrics.incr("shed_total")
+            self.metrics.incr("quota_rejected_total")
+            self.metrics.incr_model("model_shed_total", entry.name)
+            adm.set_attr("outcome", "tenant_quota").end("shed")
+            root.set_attr("status_code", 503).end("shed")
+            return 503, error_envelope(
+                "tenant_quota", 503,
+                "model admission quota exceeded",
+                model=entry.name, quota=entry.quota,
+                retry_after=round(self.retry_after_value(), 3),
+            ), {"Retry-After": self._retry_after_header()}
+        # global admission bound: at most workers + queue_depth
+        # requests in the system (executing + queued); excess sheds NOW
+        if not self.metrics.try_enter(self.workers + self.queue_depth):
+            entry.exit_admission()
+            self.metrics.incr("shed_total")
+            self.metrics.incr_model("model_shed_total", entry.name)
             adm.set_attr("outcome", "shed").end("shed")
             root.set_attr("status_code", 503).end("shed")
             return 503, error_envelope(
                 "shed", 503,
                 "worker pool and queue are full",
-                retry_after=self.retry_after,
+                retry_after=round(self.retry_after_value(), 3),
             ), {"Retry-After": self._retry_after_header()}
         adm.set_attr("outcome", "admitted").end()
-        item = _WorkItem(features, Deadline.after(self.deadline))
+        deadline = (entry.deadline if entry.deadline is not None
+                    else self.deadline)
+        item = _WorkItem(features, Deadline.after(deadline), entry)
         item.span = root
         item.queue_span = self.tracer.start_span("serving.queue",
                                                  parent=root)
@@ -808,12 +997,13 @@ class ModelServer:
                 self._queue.put_nowait(item)
             except queue.Full:  # unreachable: sized to the bound
                 self.metrics.incr("shed_total")
+                self.metrics.incr_model("model_shed_total", entry.name)
                 item.queue_span.end("shed")
                 root.set_attr("status_code", 503).end("shed")
                 return 503, error_envelope(
                     "shed", 503,
                     "worker pool and queue are full",
-                    retry_after=self.retry_after,
+                    retry_after=round(self.retry_after_value(), 3),
                 ), {"Retry-After": self._retry_after_header()}
             remaining = item.deadline.remaining()
             finished = item.done.wait(
@@ -826,6 +1016,8 @@ class ModelServer:
                         item.cancelled = True
                         item.queue_span.end("timeout")
                 self.metrics.incr("deadline_timeout_total")
+                self.metrics.incr_model("model_deadline_timeout_total",
+                                        entry.name)
                 root.set_attr("status_code", 504).end("timeout")
                 return 504, deadline_envelope(item.deadline), {}
             code = item.response[0]
@@ -834,14 +1026,30 @@ class ModelServer:
             )
             return item.response
         finally:
+            entry.exit_admission()
             self.metrics.exit()
+            now = time.monotonic()
+            self.metrics.note_completion(now)
+            self.metrics.record_model_latency(entry.name,
+                                              now - started)
 
     # -- hot reload -----------------------------------------------------
 
     def reload(self, spec: Optional[dict] = None) -> "tuple[int, dict]":
         """Restore a new model version (off the worker pool), canary-
-        validate it, and swap atomically. A failure at any stage keeps
-        the current version serving. Returns ``(status, body)``."""
+        validate it, and swap atomically. ``spec`` may name a tenant
+        (``{"model": name}``, default tenant otherwise); a failure at
+        any stage keeps that tenant's current version serving — and
+        never touches the others. Returns ``(status, body)``."""
+        spec = dict(spec or {})
+        name = spec.pop("model", None)
+        try:
+            entry = self.model_registry.entry(name)
+        except KeyError:
+            return 404, error_envelope(
+                "model_not_found", 404, f"no model named {name!r}",
+                models=self.model_registry.names(),
+            )
         if not self._reload_lock.acquire(blocking=False):
             return 409, error_envelope(
                 "reload_in_progress", 409,
@@ -850,18 +1058,19 @@ class ModelServer:
         try:
             self._reloading = True  # /readyz flips for the duration
             try:
-                model, source, info = self._load_for_reload(spec or {})
+                model, source, info = self._load_for_reload(spec, entry)
                 shapes = self.compile_cache.register()
                 # AOT before canary/warmup: when the checkpoint
                 # bundles exported executables, both the canary and
                 # the bucket warmup run the deserialized programs —
                 # a reload from a warm bundle performs zero compiles
                 n_aot = self._install_aot(model, shapes, info)
-                self._canary_check(model)
+                self._canary_check(model, self._ladder_for(entry))
                 # warm every bucket on the ADMIN thread before the
                 # swap: the new version has compiled all its shapes
                 # before it sees its first request
-                self._warm_model(model, shapes)
+                self._warm_model(model, shapes,
+                                 self._ladder_for(entry))
             except _NoReloadSource as e:
                 return 400, error_envelope("no_reload_source", 400,
                                            str(e))
@@ -876,14 +1085,18 @@ class ModelServer:
                     "serving", error_id=eid,
                 )
             with self._model_lock:
-                version = self._active.version + 1
-                self._active = _ModelVersion(model, version, source,
-                                             shapes)
+                version = entry.current.version + 1
+                self.model_registry.swap(
+                    entry,
+                    _ModelVersion(model, version, source, shapes),
+                )
             self._aot_buckets = n_aot
             self.metrics.incr("reload_total")
             body = {"status": "reloaded", "version": version,
                     "model": type(model).__name__,
                     "source": source}
+            if name is not None:
+                body["name"] = entry.name
             if n_aot:  # legacy response shape unless AOT landed
                 body["aot_buckets"] = n_aot
             return 200, body
@@ -891,9 +1104,12 @@ class ModelServer:
             self._reloading = False
             self._reload_lock.release()
 
-    def _load_for_reload(self, spec: dict):
+    def _load_for_reload(self, spec: dict, entry: ModelEntry):
         """(model, source, checkpoint_info_or_None) — the info rides
-        along so reload can install the checkpoint's AOT bundle."""
+        along so reload can install the checkpoint's AOT bundle. The
+        checkpoint manager and constructor path only back the DEFAULT
+        tenant; named tenants reload from an explicit spec or the
+        path they were registered from."""
         from deeplearning4j_tpu.util.model_serializer import (
             restore_model,
             restore_model_from_bytes,
@@ -914,29 +1130,33 @@ class ModelServer:
                 restore_model_from_bytes(data, load_updater=False),
                 str(spec["key"]), None,
             )
-        if self.checkpoint_manager is not None:
+        is_default = entry.name == self.model_registry.default_name
+        if is_default and self.checkpoint_manager is not None:
             model, info = self.checkpoint_manager.restore_latest(
                 load_updater=False
             )
             return model, f"checkpoint-step-{info.step}", info
-        if self._source_path is not None:
+        source_path = entry.source_path or (
+            self._source_path if is_default else None
+        )
+        if source_path is not None:
             return (
-                restore_model(self._source_path, load_updater=False),
-                self._source_path, None,
+                restore_model(source_path, load_updater=False),
+                source_path, None,
             )
         raise _NoReloadSource(
             "no reload source: pass {\"path\": ...} / {\"key\": ...} "
             "or construct the server with checkpoint_manager="
         )
 
-    def _canary_check(self, model) -> None:
+    def _canary_check(self, model, ladder=None) -> None:
         """One predict on the candidate BEFORE it takes traffic — a
         restorable-but-broken checkpoint must fail the reload, not the
         next thousand user requests. With micro-batching on, the
         canary runs through the SAME bucketed padded path traffic
-        uses (padded to the smallest bucket that fits), so a canary
-        pass proves the shapes production requests will execute, not
-        just a bespoke 1-row program."""
+        uses (padded to the smallest bucket of the TENANT's ladder
+        that fits), so a canary pass proves the shapes production
+        requests will execute, not just a bespoke 1-row program."""
         feats = self._canary_features(model)
         if feats is None:
             return  # shape unknown and no canary provided: skip
@@ -944,10 +1164,12 @@ class ModelServer:
             feats = self.transform(feats)
         feats = np.asarray(feats, np.float32)
         if self.batcher is not None:
+            if ladder is None:
+                ladder = self.batcher.ladder
             if feats.ndim == 1:
                 feats = feats[None, :]
             rows = int(feats.shape[0])
-            bucket = self.batcher.ladder.bucket_for(rows)
+            bucket = ladder.bucket_for(rows)
             if bucket is not None:
                 out = self._padded_forward(
                     model, pad_rows(feats, bucket), rows
@@ -1008,11 +1230,25 @@ class ModelServer:
     # -- health / metrics -----------------------------------------------
 
     def health(self) -> dict:
-        return {
+        out = {
             "status": "ok",
             "model": type(self._active.model).__name__,
             "version": self._active.version,
         }
+        if len(self.model_registry) > 1:
+            out["models"] = self.model_registry.names()
+        return out
+
+    def models_snapshot(self) -> dict:
+        """``GET /models``: per-tenant registry + paging states, with
+        each tenant's counter/latency view merged in."""
+        stats = self.model_registry.stats()
+        per_model = self.metrics.model_snapshot()
+        for name, block in stats["models"].items():
+            if name in per_model:
+                block["metrics"] = per_model[name]
+        stats["default"] = self.model_registry.default_name
+        return stats
 
     def readiness(self) -> "tuple[int, dict]":
         reasons = []
@@ -1058,6 +1294,8 @@ class ModelServer:
         out["breaker"] = self.breaker.snapshot()
         out["model_version"] = self._active.version
         out["draining"] = self._draining
+        out["retry_after"] = round(self.retry_after_value(), 3)
+        out["paging"] = self.model_registry.stats()
         if self.batcher is not None:
             out["batching"] = {
                 "enabled": True,
@@ -1081,11 +1319,13 @@ class ModelServer:
 
     # -- request validation ---------------------------------------------
 
-    def parse_features(self, data: bytes):
-        """Body bytes -> float32 feature array, or raise
-        ``HttpBodyError`` with the right 4xx envelope: 400 for
-        malformed payloads, 422 for well-formed-but-shape-invalid
-        features (expected vs got in the body)."""
+    def parse_predict(self, data: bytes):
+        """Body bytes -> ``(model_name_or_None, float32 features)``,
+        or raise ``HttpBodyError`` with the right envelope: 400 for
+        malformed payloads, 404 for an unknown ``"model"``, 422 for
+        well-formed-but-shape-invalid features (expected vs got in
+        the body). Width validates against the TARGET tenant's
+        model."""
         try:
             payload = json.loads(data)
         except (ValueError, UnicodeDecodeError) as e:
@@ -1097,6 +1337,19 @@ class ModelServer:
                 "bad_request", 400,
                 'body must be a JSON object with a "features" key',
             ))
+        name = payload.get("model")
+        if name is not None and not isinstance(name, str):
+            raise HttpBodyError(400, error_envelope(
+                "bad_request", 400,
+                '"model" must be a string when present',
+            ))
+        try:
+            entry = self.model_registry.entry(name)
+        except KeyError:
+            raise HttpBodyError(404, error_envelope(
+                "model_not_found", 404, f"no model named {name!r}",
+                models=self.model_registry.names(),
+            )) from None
         try:
             feats = np.asarray(payload["features"], np.float32)
         except (ValueError, TypeError):
@@ -1112,7 +1365,7 @@ class ModelServer:
                 "features must be a non-empty 1-d or 2-d array",
                 expected="[n, d]", got=list(feats.shape),
             ))
-        n_in = _feature_dim(self._active.model)
+        n_in = _feature_dim(entry.current.model)
         if n_in is not None and feats.shape[-1] != n_in:
             raise HttpBodyError(422, error_envelope(
                 "invalid_features", 422,
@@ -1121,7 +1374,11 @@ class ModelServer:
                           else 1, n_in],
                 got=list(feats.shape),
             ))
-        return feats
+        return name, feats
+
+    def parse_features(self, data: bytes):
+        """Back-compat wrapper: features only, default tenant."""
+        return self.parse_predict(data)[1]
 
 
 def _make_handler(server: ModelServer):
@@ -1171,6 +1428,9 @@ def _make_handler(server: ModelServer):
                 else:  # JSON stays the default
                     self._json(server.metrics_snapshot())
                 return
+            if route == "/models":
+                self._json(server.models_snapshot())
+                return
             self._json(error_envelope("not_found", 404, "not found"),
                        404)
 
@@ -1180,12 +1440,12 @@ def _make_handler(server: ModelServer):
                 started = time.monotonic()
                 try:
                     data = read_request_body(self, MAX_BODY)
-                    feats = server.parse_features(data)
+                    name, feats = server.parse_predict(data)
                 except HttpBodyError as e:
                     server.metrics.incr("client_error_total")
                     self._json(e.envelope, e.code)
                     return
-                code, body, headers = server.submit(feats)
+                code, body, headers = server.submit(feats, model=name)
                 server.metrics.record_latency(
                     time.monotonic() - started
                 )

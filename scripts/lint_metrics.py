@@ -3,8 +3,8 @@
 
 Every metric name registered in ``deeplearning4j_tpu/`` (via
 ``registry.counter/gauge/histogram/summary("name", ...)`` calls, or
-via the ``COUNTER_HELP``/``COUNTERS`` name tables in
-``serving/metrics.py``) must appear in the ARCHITECTURE.md signal
+via the ``COUNTER_HELP``/``MODEL_COUNTER_HELP``/``COUNTERS`` name
+tables in ``serving/metrics.py``) must appear in the ARCHITECTURE.md signal
 catalog (the table between the ``metric-catalog`` markers), and vice
 versa — so the catalog an operator builds dashboards from cannot
 silently drift from what the code actually exports.
@@ -29,7 +29,8 @@ PACKAGE = REPO / "deeplearning4j_tpu"
 DOC = REPO / "docs" / "ARCHITECTURE.md"
 
 REGISTER_METHODS = {"counter", "gauge", "histogram", "summary"}
-NAME_TABLE_TARGETS = {"COUNTER_HELP", "COUNTERS"}
+NAME_TABLE_TARGETS = {"COUNTER_HELP", "COUNTERS",
+                      "MODEL_COUNTER_HELP"}
 CATALOG_BEGIN = "<!-- metric-catalog:begin -->"
 CATALOG_END = "<!-- metric-catalog:end -->"
 

@@ -34,9 +34,15 @@ python scripts/lint_metrics.py
 #                                  stale AOT bundles must degrade
 #                                  silently to JIT, never error the
 #                                  request path or the restore)
+#   tests/test_fleet.py          — serving fleet (SIGKILL one backend
+#                                  process under router load: zero
+#                                  request loss via retries, backend
+#                                  restarts warm from the shared
+#                                  persistent compile cache and
+#                                  rejoins on the next health poll)
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest tests/test_resilience.py tests/test_serving.py \
     tests/test_batching.py tests/test_input_pipeline.py \
-    tests/test_compile.py \
+    tests/test_compile.py tests/test_fleet.py \
     -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"
