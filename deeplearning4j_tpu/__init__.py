@@ -71,6 +71,11 @@ _LAZY_IMPORTS = {
     "enable_persistent_cache": "deeplearning4j_tpu.compile",
     "export_serving_bundle": "deeplearning4j_tpu.compile",
     "install_serving_bundle": "deeplearning4j_tpu.compile",
+    "ContinualTrainer": "deeplearning4j_tpu.loop",
+    "ShadowScorer": "deeplearning4j_tpu.loop",
+    "Promoter": "deeplearning4j_tpu.loop",
+    "PromotionGates": "deeplearning4j_tpu.loop",
+    "PromotionJournal": "deeplearning4j_tpu.loop",
     "MetricsRegistry": "deeplearning4j_tpu.observability",
     "Tracer": "deeplearning4j_tpu.observability",
     "JsonlSink": "deeplearning4j_tpu.observability",

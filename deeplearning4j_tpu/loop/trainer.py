@@ -1,0 +1,177 @@
+"""Continual training: stream in, versioned checkpoints out.
+
+``ContinualTrainer`` closes the producer half of the continuous-
+learning loop: it consumes a streaming ``DataSetIterator`` (or any
+iterable of ``DataSet``s) through the existing fit machinery — either
+an engine's ``fit_minibatch`` or a ``DistributedTrainer``'s (prefetch
+and async dispatch compose exactly as in a batch fit) — and publishes
+a versioned checkpoint through ``CheckpointManager`` every
+``publish_every`` optimizer steps, with the serving AOT bundle
+attached when ``aot_buckets`` is set (``compile.aot.
+export_serving_bundle``), so a promotion never pays an XLA compile.
+
+Crash-safety is inherited, not reinvented: checkpoints are atomic +
+CRC-manifested, and ``resume()`` restores the newest restorable
+version (params, updater state, step counter) so a trainer killed
+mid-epoch — prefetch runahead and all — replays the *identical*
+trajectory the uninterrupted run would have taken
+(``tests/test_resilience.py`` asserts this bitwise, with prefetch and
+artifacts attached).
+
+The publish cadence is step-based, not time-based, on purpose: a
+resumed trainer re-publishes the same step numbers it would have
+published uninterrupted, so the promoter downstream sees one
+consistent version line regardless of how many times the trainer
+died.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Iterable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ContinualTrainer:
+    """Incrementally fit a model from a stream and publish versioned
+    checkpoints.
+
+    ``model`` is a ``MultiLayerNetwork``/``ComputationGraph``;
+    ``trainer`` (optional) a ``DistributedTrainer`` wrapping the same
+    model — steps then run through its sharded fit path.
+    ``artifact_fn`` overrides the AOT exporter (tests inject stub
+    blobs; the default exports the serving bundle for
+    ``aot_buckets``). ``journal`` (a ``PromotionJournal``) wires the
+    retention contract: steps the journal references are never
+    pruned.
+    """
+
+    def __init__(self, model, manager, *, publish_every: int = 8,
+                 trainer=None, aot_buckets=None,
+                 artifact_fn: Optional[Callable] = None,
+                 feature_shape=None, journal=None, registry=None):
+        if publish_every < 1:
+            raise ValueError("publish_every must be >= 1")
+        self.model = model
+        self.manager = manager
+        self.trainer = trainer
+        self.publish_every = int(publish_every)
+        self.aot_buckets = list(aot_buckets) if aot_buckets else None
+        self.feature_shape = feature_shape
+        self._artifact_fn = artifact_fn
+        self.last_published = None  # CheckpointInfo of newest publish
+        if journal is not None:
+            # retention contract: pruning must never delete a step the
+            # promotion journal still references (rollback target!)
+            manager.protect = journal.referenced_steps
+        if registry is None:
+            from deeplearning4j_tpu.observability.metrics import (
+                default_registry,
+            )
+
+            registry = default_registry()
+        self._m_steps = registry.counter(
+            "loop_train_steps_total",
+            help="loop: optimizer steps consumed from the stream",
+        )._default()
+        self._m_published = registry.counter(
+            "loop_published_total",
+            help="loop: versioned checkpoints published",
+        )._default()
+        self._m_published_step = registry.gauge(
+            "loop_published_step",
+            help="loop: step of the newest published checkpoint",
+        )._default()
+
+    # -- resume ---------------------------------------------------------
+
+    def resume(self, load_updater: bool = True) -> int:
+        """Restore the newest restorable checkpoint into the model (or
+        through the distributed trainer, which re-places params onto
+        its mesh) and return the restored step; 0 when the store is
+        empty (fresh start)."""
+        if self.manager.latest_step() is None:
+            return 0
+        target = self.trainer if self.trainer is not None else self.model
+        step = target.resume(self.manager,
+                             load_updater=load_updater)
+        self.last_published = next(
+            (i for i in self.manager.available() if i.step == step),
+            None,
+        )
+        logger.info("continual trainer resumed at step %d", step)
+        return step
+
+    # -- publish --------------------------------------------------------
+
+    def _artifacts(self) -> Optional[dict]:
+        if self._artifact_fn is not None:
+            return self._artifact_fn(self.model)
+        if not self.aot_buckets:
+            return None
+        from deeplearning4j_tpu.compile.aot import export_serving_bundle
+
+        return export_serving_bundle(
+            self.model, self.aot_buckets,
+            feature_shape=self.feature_shape,
+        )
+
+    def publish(self) -> "CheckpointInfo":
+        """Checkpoint the model at its current step, AOT bundle
+        attached. Export failures degrade to a bundle-less publish
+        (the consumer then JITs — a lost bundle costs a compile,
+        never a version)."""
+        artifacts = None
+        try:
+            artifacts = self._artifacts()
+        except Exception:
+            logger.warning(
+                "AOT export failed at step %d; publishing without a "
+                "bundle", int(self.model.iteration_count),
+                exc_info=True,
+            )
+        info = self.manager.save(self.model, artifacts=artifacts)
+        self.last_published = info
+        self._m_published.inc()
+        self._m_published_step.set(info.step)
+        logger.info("published checkpoint step %d (%d artifacts)",
+                    info.step, len(info.artifacts))
+        return info
+
+    # -- the stream loop ------------------------------------------------
+
+    def run(self, stream: Iterable, max_steps: Optional[int] = None,
+            publish_trailing: bool = True) -> int:
+        """Consume ``stream`` (a ``DataSetIterator`` or any iterable
+        of minibatches), fitting one optimizer step per batch and
+        publishing every ``publish_every`` steps. Returns the number
+        of steps consumed THIS call. ``max_steps`` bounds the call
+        (tests and budget-boxed demos); ``publish_trailing`` also
+        publishes a final partial window so a drained stream never
+        strands unpublished progress."""
+        fit = (self.trainer.fit_minibatch if self.trainer is not None
+               else self.model.fit_minibatch)
+        consumed = 0
+        for ds in self._iter(stream):
+            fit(ds)
+            consumed += 1
+            self._m_steps.inc()
+            if self.model.iteration_count % self.publish_every == 0:
+                self.publish()
+            if max_steps is not None and consumed >= max_steps:
+                break
+        if publish_trailing and consumed and (
+            self.last_published is None
+            or self.last_published.step < self.model.iteration_count
+        ):
+            self.publish()
+        return consumed
+
+    @staticmethod
+    def _iter(stream):
+        if hasattr(stream, "has_next") and hasattr(stream, "next"):
+            while stream.has_next():
+                yield stream.next()
+        else:
+            yield from stream

@@ -143,7 +143,7 @@ class CheckpointManager:
     """
 
     def __init__(self, directory, keep_last: int = 3,
-                 prefix: str = "checkpoint"):
+                 prefix: str = "checkpoint", protect=None):
         if keep_last < 1:
             raise ValueError("keep_last must be >= 1")
         if not re.fullmatch(r"[A-Za-z0-9._]+", prefix):
@@ -155,6 +155,11 @@ class CheckpointManager:
         self.directory.mkdir(parents=True, exist_ok=True)
         self.keep_last = keep_last
         self.prefix = prefix
+        # retention guard: a callable returning step numbers that
+        # pruning must NEVER delete, consulted at each save — the
+        # promotion journal wires ``journal.referenced_steps`` here so
+        # a rollback target outlives the keep_last window
+        self.protect = protect
 
     # -- naming ---------------------------------------------------------
 
@@ -219,7 +224,18 @@ class CheckpointManager:
 
     def _prune(self) -> None:
         versions = self.available()
+        protected: set = set()
+        if self.protect is not None:
+            try:
+                protected = {int(s) for s in self.protect()}
+            except Exception:
+                # a broken guard must fail SAFE: protect everything
+                logger.warning("checkpoint protect callable failed; "
+                               "skipping pruning", exc_info=True)
+                return
         for info in versions[:-self.keep_last]:
+            if info.step in protected:
+                continue  # journal-referenced: never delete
             names = [info.file, self._manifest_name(info.step)]
             names.extend(
                 a.get("file") for a in info.artifacts.values()
@@ -252,9 +268,20 @@ class CheckpointManager:
         out.sort(key=lambda i: i.step)
         return out
 
-    def last_step(self) -> Optional[int]:
+    def list_steps(self) -> List[int]:
+        """Step numbers of every manifested version, ascending — the
+        public enumeration the promoter/shadow loop uses instead of
+        touching manifest internals."""
+        return [info.step for info in self.available()]
+
+    def latest_step(self) -> Optional[int]:
+        """Newest manifested step, or None when the store is empty."""
         versions = self.available()
         return versions[-1].step if versions else None
+
+    def last_step(self) -> Optional[int]:
+        """Back-compat alias of ``latest_step``."""
+        return self.latest_step()
 
     def verify(self, info: CheckpointInfo) -> bool:
         """CRC + size + zip-structure check without restoring."""

@@ -58,6 +58,7 @@ COUNTER_HELP = {
     "abandoned_total": "worker finished after caller's 504",
     "reload_total": "successful hot swaps",
     "reload_failure_total": "failed reload attempts (old kept)",
+    "reload_skipped_total": "no-op reloads (step already serving)",
     "batches_total": "batched dispatches executed",
     "batched_predictions_total": "requests answered via a batch",
     "solo_fallback_total": "requests too wide for the ladder",

@@ -40,6 +40,7 @@ import hashlib
 import http.client
 import json
 import logging
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -118,14 +119,25 @@ class ServingRouter:
     def __init__(self, backends, host: str = "127.0.0.1",
                  port: int = 0, *,
                  health_interval: float = 0.25,
+                 health_jitter: float = 0.2,
+                 probe_timeout: float = 2.0,
                  request_timeout: float = 30.0,
                  retries: Optional[int] = None,
                  spread_after: int = 8,
+                 seed: int = 0,
                  registry: Optional[MetricsRegistry] = None):
         if not backends:
             raise ValueError("router needs at least one backend")
+        if not 0.0 <= health_jitter < 1.0:
+            raise ValueError("health_jitter must be in [0, 1)")
         self.backends = [_Backend(*_parse_backend(b)) for b in backends]
         self.health_interval = health_interval
+        self.health_jitter = health_jitter
+        self.probe_timeout = probe_timeout
+        # seeded jitter: N routers polling the same backends must not
+        # synchronize their /readyz probes into one thundering herd —
+        # each waits interval * (1 ± jitter), deterministic per seed
+        self._jitter_rng = random.Random(seed)
         self.request_timeout = request_timeout
         self.retries = (retries if retries is not None
                         else len(self.backends))
@@ -195,8 +207,18 @@ class ServingRouter:
 
     # -- health ---------------------------------------------------------
 
+    def _next_interval(self) -> float:
+        """Jittered poll interval: ``health_interval * (1 ± jitter)``
+        from the seeded RNG, so a fleet of routers decorrelates its
+        probe times deterministically."""
+        if self.health_jitter <= 0.0:
+            return self.health_interval
+        spread = self.health_jitter * (2.0 * self._jitter_rng.random()
+                                       - 1.0)
+        return self.health_interval * (1.0 + spread)
+
     def _health_loop(self) -> None:
-        while not self._stop.wait(self.health_interval):
+        while not self._stop.wait(self._next_interval()):
             try:
                 self.check_health()
             except Exception:
@@ -204,20 +226,26 @@ class ServingRouter:
 
     def check_health(self) -> int:
         """One poll of every backend's ``/readyz``; returns the
-        healthy count."""
+        healthy count. A probe timeout — the backend accepted the
+        connection but never answered within ``probe_timeout`` — is
+        treated exactly like a connection failure: immediately
+        unhealthy, no benefit of the doubt until a probe succeeds."""
         n = 0
         for b in self.backends:
             ok = False
             try:
                 conn = http.client.HTTPConnection(
-                    b.host, b.port, timeout=2.0
+                    b.host, b.port, timeout=self.probe_timeout
                 )
                 try:
                     conn.request("GET", "/readyz")
                     ok = conn.getresponse().status == 200
                 finally:
                     conn.close()
-            except OSError:
+            except (OSError, http.client.HTTPException):
+                # covers refused connections, socket timeouts
+                # (TimeoutError is an OSError), and torn/invalid
+                # responses from a wedged backend alike
                 ok = False
             b.healthy = ok
             self._healthy_gauge.labels(b.address).set(1 if ok else 0)

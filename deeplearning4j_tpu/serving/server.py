@@ -319,6 +319,11 @@ class ModelServer:
         self._source_path: Optional[str] = None
         self._watched_step: Optional[int] = None
         self._last_restore_info = None  # CheckpointInfo when manager-sourced
+        # continuous-learning hook (loop/shadow.py): when set, every
+        # successful default-tenant forward is offered to the scorer
+        # AFTER the client responses complete — candidate results are
+        # never returned to clients, and observe() never raises
+        self.shadow = None
         # multi-tenant registry: the single-model constructor path
         # becomes the "default" tenant; ``models=`` adds named
         # tenants (instance | checkpoint path | spec dict with
@@ -417,6 +422,27 @@ class ModelServer:
             ladder=ladder,
             source_path=source_path,
         )
+
+    def set_shadow(self, scorer) -> None:
+        """(Un)install a shadow scorer (``loop.ShadowScorer`` or any
+        object with ``observe(features, live_output, live_ms)``).
+        Atomic attribute swap; in-flight forwards finish against
+        whichever scorer they snapshotted."""
+        self.shadow = scorer
+
+    def _offer_shadow(self, entry: ModelEntry, feats, out,
+                      live_ms: float) -> None:
+        """Mirror one successful live forward to the shadow scorer —
+        after the live responses completed, default tenant only,
+        faults logged and swallowed (the live path is done; nothing
+        here may affect it)."""
+        sh = self.shadow
+        if sh is None or entry.name != self.model_registry.default_name:
+            return
+        try:
+            sh.observe(feats, out, live_ms)
+        except Exception:
+            logger.exception("shadow observe failed (ignored)")
 
     def _ladder_for(self, entry: ModelEntry) -> Optional[BucketLadder]:
         if self.batcher is None:
@@ -586,10 +612,12 @@ class ModelServer:
                 feats = self.transform(feats)
             self.compile_cache.note(mv.shapes, np.shape(feats),
                                     model=entry.name)
+            fwd_t0 = time.perf_counter()
             out = mv.model.output(feats)
             out = np.asarray(
                 out[0] if isinstance(out, (list, tuple)) else out
             )
+            fwd_ms = (time.perf_counter() - fwd_t0) * 1000.0
         except Exception as e:
             self.breaker.record_failure()
             eid = error_id_for(e)
@@ -616,6 +644,7 @@ class ModelServer:
         self.metrics.incr_model("model_predictions_total", entry.name)
         if not item.finish(200, body):
             self.metrics.incr("abandoned_total")
+        self._offer_shadow(entry, feats, out, fwd_ms)
 
     # -- micro-batch drain path -----------------------------------------
 
@@ -743,7 +772,9 @@ class ModelServer:
             padded = pad_rows(stacked, bucket)
             self.compile_cache.note(mv.shapes, padded.shape,
                                     model=entry.name)
+            fwd_t0 = time.perf_counter()
             out = self._padded_forward(mv.model, padded, n_valid)
+            fwd_ms = (time.perf_counter() - fwd_t0) * 1000.0
         except Exception as e:
             self.breaker.record_failure()
             eid = error_id_for(e)
@@ -788,6 +819,7 @@ class ModelServer:
                 abandoned += 1
         if abandoned:
             self.metrics.incr("abandoned_total", abandoned)
+        self._offer_shadow(entry, stacked, out[:n_valid], fwd_ms)
 
     def _padded_forward(self, model, padded, n_valid: int):
         """Run the model on a bucket-padded batch and return the valid
@@ -1038,11 +1070,19 @@ class ModelServer:
     def reload(self, spec: Optional[dict] = None) -> "tuple[int, dict]":
         """Restore a new model version (off the worker pool), canary-
         validate it, and swap atomically. ``spec`` may name a tenant
-        (``{"model": name}``, default tenant otherwise); a failure at
-        any stage keeps that tenant's current version serving — and
-        never touches the others. Returns ``(status, body)``."""
+        (``{"model": name}``, default tenant otherwise) or pin a
+        checkpoint version (``{"step": N}``, manager-backed default
+        tenant); a failure at any stage keeps that tenant's current
+        version serving — and never touches the others.
+
+        Reloading the checkpoint step that is ALREADY serving is a
+        counted no-op (``reload_skipped_total``, ``200 skipped``)
+        instead of a full canary + warmup cycle — a polling promoter
+        must not churn the server. ``{"force": true}`` overrides.
+        Returns ``(status, body)``."""
         spec = dict(spec or {})
         name = spec.pop("model", None)
+        force = bool(spec.pop("force", False))
         try:
             entry = self.model_registry.entry(name)
         except KeyError:
@@ -1056,6 +1096,22 @@ class ModelServer:
                 "another reload is already running",
             )
         try:
+            # idempotence: resolve the target checkpoint step WITHOUT
+            # restoring anything; already serving it -> counted no-op
+            # (never re-runs canary/warmup, never bumps the version)
+            if not force:
+                target = self._reload_target_step(spec, entry)
+                if (target is not None
+                        and target == self._watched_step):
+                    self.metrics.incr("reload_skipped_total")
+                    body = {"status": "skipped",
+                            "step": int(target),
+                            "version": entry.current.version,
+                            "reason": "already serving this "
+                                      "checkpoint step"}
+                    if name is not None:
+                        body["name"] = entry.name
+                    return 200, body
             self._reloading = True  # /readyz flips for the duration
             try:
                 model, source, info = self._load_for_reload(spec, entry)
@@ -1091,6 +1147,9 @@ class ModelServer:
                     _ModelVersion(model, version, source, shapes),
                 )
             self._aot_buckets = n_aot
+            if info is not None:  # manager-sourced: step now serving
+                self._watched_step = info.step
+                self._last_restore_info = info
             self.metrics.incr("reload_total")
             body = {"status": "reloaded", "version": version,
                     "model": type(model).__name__,
@@ -1104,6 +1163,23 @@ class ModelServer:
             self._reloading = False
             self._reload_lock.release()
 
+    def _reload_target_step(self, spec: dict,
+                            entry: ModelEntry) -> Optional[int]:
+        """The checkpoint step ``spec`` would load, resolvable without
+        restoring — None when the source is not step-addressable
+        (path/key/instance reloads never skip)."""
+        if "path" in spec or "key" in spec:
+            return None
+        if (entry.name != self.model_registry.default_name
+                or self.checkpoint_manager is None):
+            return None
+        if "step" in spec:
+            try:
+                return int(spec["step"])
+            except (TypeError, ValueError):
+                return None
+        return self.checkpoint_manager.latest_step()
+
     def _load_for_reload(self, spec: dict, entry: ModelEntry):
         """(model, source, checkpoint_info_or_None) — the info rides
         along so reload can install the checkpoint's AOT bundle. The
@@ -1115,6 +1191,27 @@ class ModelServer:
             restore_model_from_bytes,
         )
 
+        if "step" in spec:
+            # a specific published version (the promoter's path: the
+            # candidate under promotion may no longer be the newest)
+            if self.checkpoint_manager is None:
+                raise _NoReloadSource(
+                    "reload by step requires the server's "
+                    "checkpoint_manager="
+                )
+            step = int(spec["step"])
+            info = next(
+                (i for i in self.checkpoint_manager.available()
+                 if i.step == step), None,
+            )
+            if info is None:
+                raise _NoReloadSource(
+                    f"no checkpoint at step {step} in the store"
+                )
+            model = self.checkpoint_manager.restore(
+                info, load_updater=False
+            )
+            return model, f"checkpoint-step-{step}", info
         if "path" in spec:
             return (
                 restore_model(spec["path"], load_updater=False),

@@ -39,10 +39,25 @@ python scripts/lint_metrics.py
 #                                  request loss via retries, backend
 #                                  restarts warm from the shared
 #                                  persistent compile cache and
-#                                  rejoins on the next health poll)
+#                                  rejoins on the next health poll;
+#                                  wedged-backend /readyz probe
+#                                  timeouts mark unhealthy instantly)
+#   tests/test_loop.py           — continuous-learning loop, four
+#                                  storms: kill the trainer mid-epoch
+#                                  (bitwise resume, with prefetch +
+#                                  artifacts in test_resilience.py),
+#                                  corrupt the candidate checkpoint
+#                                  (quarantined; live keeps serving),
+#                                  fail the canary (rejected; old
+#                                  version untouched), SIGKILL
+#                                  mid-promotion (journal recovery
+#                                  rolls the half-applied promotion
+#                                  forward) — plus the traffic-shift
+#                                  regression rollback with zero XLA
+#                                  compiles, counter-asserted
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest tests/test_resilience.py tests/test_serving.py \
     tests/test_batching.py tests/test_input_pipeline.py \
-    tests/test_compile.py tests/test_fleet.py \
+    tests/test_compile.py tests/test_fleet.py tests/test_loop.py \
     -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

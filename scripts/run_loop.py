@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Budget-boxed end-to-end continuous-learning loop demo.
+
+One process exercises the whole production story under live traffic:
+
+1. **train → publish**: a ``ContinualTrainer`` fits a stream and
+   publishes versioned checkpoints with AOT serving bundles attached;
+2. **serve**: a ``ModelServer`` boots from the newest checkpoint
+   (deserializing the bundle) while closed-loop traffic threads drive
+   ``/predict`` continuously — every admitted request must be
+   answered (zero dropped in-flight requests, end to end);
+3. **shadow → canary → promote**: the ``Promoter`` discovers the next
+   published version, mirrors live traffic to it, clears the gates,
+   and swaps it in via the canary-validated hot reload — with a
+   **simulated SIGKILL landing right after the ``canarying`` journal
+   write**; a fresh promoter recovers from the journal and rolls the
+   half-applied promotion forward;
+4. **inject regression → auto-rollback**: a candidate carrying a
+   dead-feature time bomb (identical outputs on current traffic,
+   divergent once the traffic distribution shifts) sails through
+   shadowing, gets promoted — then the traffic shifts, probation
+   catches the divergence against the previous version's retained
+   snapshot, and the promoter rolls back with ZERO XLA compiles
+   (counter-asserted: the snapshot still carries its executables);
+5. **quarantine**: a corrupt candidate checkpoint is quarantined
+   while the live version keeps serving.
+
+Prints ONE JSON verdict line (always — a budget overrun or crash
+prints a partial verdict with ``"pass": false``). Knobs:
+``LOOP_BUDGET_S`` (default 240), ``LOOP_SEED`` (default 7).
+"""
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BUDGET_S = int(os.environ.get("LOOP_BUDGET_S", "240"))
+SEED = int(os.environ.get("LOOP_SEED", "7"))
+BUCKETS = (1, 2, 4, 8)
+DEAD_FEATURE = 3  # zero in baseline traffic; the regression flips it
+
+
+def build_net(seed=SEED):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(0.01).updater("ADAM")
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def stream(rng, n, batch=8):
+    from deeplearning4j_tpu.datasets.api import (
+        DataSet, ListDataSetIterator,
+    )
+
+    out = []
+    for _ in range(n):
+        x = rng.randn(batch, 4).astype(np.float32)
+        x[:, DEAD_FEATURE] = 0.0  # training matches baseline traffic
+        y = np.eye(3)[rng.randint(0, 3, batch)].astype(np.float32)
+        out.append(DataSet(features=x, labels=y))
+    return ListDataSetIterator(out)
+
+
+class Traffic:
+    """Closed-loop in-process load: N threads submitting seeded
+    2-row predicts; ``shifted`` flips the dead feature live."""
+
+    def __init__(self, server, threads=3):
+        self.server = server
+        self.shifted = False
+        self.codes = {}
+        self.dropped = 0  # submit() raised / returned nothing
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._loop, args=(i,), daemon=True)
+            for i in range(threads)
+        ]
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def _loop(self, i):
+        rng = np.random.RandomState(SEED * 100 + i)
+        while not self._stop.is_set():
+            feats = rng.randn(2, 4).astype(np.float32)
+            feats[:, DEAD_FEATURE] = (
+                rng.randn(2).astype(np.float32) * 8.0
+                if self.shifted else 0.0
+            )
+            try:
+                code, body, _ = self.server.submit(feats)
+                if not isinstance(code, int):
+                    raise RuntimeError("no status")
+            except Exception:
+                code = -1
+            with self._lock:
+                if code == -1:
+                    self.dropped += 1
+                else:
+                    self.codes[code] = self.codes.get(code, 0) + 1
+            time.sleep(0.002)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self.codes), self.dropped
+
+
+def wait_for(pred, timeout, tick=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return False
+
+
+def main() -> dict:
+    from deeplearning4j_tpu.loop import (
+        ContinualTrainer,
+        Promoter,
+        PromotionGates,
+        PromotionJournal,
+        SimulatedKill,
+    )
+    from deeplearning4j_tpu.resilience import CheckpointManager
+    from deeplearning4j_tpu.serving.server import ModelServer
+
+    verdict = {"pass": False, "phases": []}
+    rng = np.random.RandomState(SEED)
+    workdir = tempfile.mkdtemp(prefix="dl4j-loop-")
+    manager = CheckpointManager(workdir, keep_last=4)
+    journal = PromotionJournal(os.path.join(workdir,
+                                            "promotion-journal.json"))
+    net = build_net()
+    trainer = ContinualTrainer(
+        net, manager, publish_every=4, aot_buckets=BUCKETS,
+        journal=journal,
+    )
+
+    # phase 1: train + publish v1/v2 (steps 4, 8), AOT attached
+    t0 = time.monotonic()
+    trainer.run(stream(rng, 8))
+    verdict["phases"].append({"train_publish": manager.list_steps(),
+                              "s": round(time.monotonic() - t0, 2)})
+
+    server = ModelServer(
+        checkpoint_manager=manager, workers=2, queue_depth=32,
+        max_batch_size=max(BUCKETS), aot=True,
+    ).start()
+    gates = PromotionGates(
+        min_shadow_requests=16, min_agreement=0.5,
+        probation_requests=16, probation_min_agreement=0.8,
+        probation_min_seconds=2.0, max_error_rate=0.02,
+    )
+    traffic = Traffic(server).start()
+    try:
+        promoter = Promoter(server, manager, journal, gates=gates,
+                            seed=SEED)
+        promoter.recover()
+
+        # phase 2: good candidate (step 12) + SIGKILL mid-promotion
+        trainer.run(stream(rng, 4))
+        promoter.fail_after_journal = "canarying"
+        killed = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                promoter.poll()
+            except SimulatedKill:
+                killed = True
+                break
+            time.sleep(0.05)
+        # the "fresh process": a new promoter over the same journal
+        promoter2 = Promoter(server, manager, journal, gates=gates,
+                             seed=SEED)
+        promoter2.recover()  # rolls the half-applied promotion forward
+        sealed = wait_for(
+            lambda: (promoter2.poll() == "promoted"
+                     and not journal.read().get("probation")), 60,
+        )
+        snap = promoter2.snapshot()
+        verdict["phases"].append({
+            "promotion": {
+                "sigkill_injected": killed,
+                "recovered_and_sealed": sealed,
+                "promoted_step": snap["promoted_step"],
+                "journal_recoveries": snap["journal_recoveries"],
+            }
+        })
+
+        # phase 3: the regression candidate — identical on today's
+        # traffic (dead feature is zero), divergent once it shifts
+        bomb_src, info = manager.restore_latest(load_updater=False)
+        w = np.array(bomb_src.params["0"]["W"])
+        w[DEAD_FEATURE, :] = np.where(
+            np.arange(w.shape[1]) % 2 == 0, 40.0, -40.0
+        )
+        bomb_src.params["0"]["W"] = w
+        bomb_src.iteration_count = info.step + 1
+        manager.save(bomb_src)
+        bomb_step = info.step + 1
+
+        promoted_bomb = wait_for(
+            lambda: (promoter2.poll() == "promoted"
+                     and journal.read().get("promoted_step")
+                     == bomb_step), 60,
+        )
+        compiles_before = server.metrics.get("xla_compiles_total")
+        traffic.shifted = True  # the distribution shift goes live
+        rolled_back = wait_for(
+            lambda: promoter2.poll() == "rolled_back", 60,
+        )
+        time.sleep(0.5)  # post-rollback traffic on the old snapshot
+        compiles_after = server.metrics.get("xla_compiles_total")
+        feats = np.zeros((2, 4), np.float32)
+        code, body, _ = server.submit(feats)
+        snap = promoter2.snapshot()
+        verdict["phases"].append({
+            "rollback": {
+                "bomb_promoted": promoted_bomb,
+                "rolled_back": rolled_back,
+                "serving_after": code == 200,
+                "promoted_step_after": snap["promoted_step"],
+                "xla_compiles_during_rollback":
+                    compiles_after - compiles_before,
+                "rollbacks": snap["rollbacks"],
+            }
+        })
+        traffic.shifted = False
+
+        # phase 4: corrupt candidate → quarantine, live unaffected
+        trainer.run(stream(rng, 4))
+        bad = manager.available()[-1]
+        zpath = os.path.join(workdir, bad.file)
+        with open(zpath, "r+b") as f:
+            f.write(b"corrupt!")
+        q = wait_for(lambda: promoter2.poll() == "quarantined", 30)
+        code, _, _ = server.submit(np.zeros((1, 4), np.float32))
+        snap = promoter2.snapshot()
+        verdict["phases"].append({
+            "quarantine": {"quarantined": q,
+                           "still_serving": code == 200,
+                           "count": snap["quarantined"]},
+        })
+
+        traffic.stop()
+        codes, dropped = traffic.snapshot()
+        metrics = server.metrics_snapshot()
+        verdict["requests"] = {
+            "codes": codes,
+            "dropped": dropped,
+            "server_errors": metrics["server_error_total"],
+            "deadline_timeouts": metrics["deadline_timeout_total"],
+        }
+        verdict["loop"] = {
+            "promotions": snap["promotions"],
+            "rollbacks": snap["rollbacks"],
+            "rejected": snap["rejected"],
+            "quarantined": snap["quarantined"],
+            "journal_recoveries": snap["journal_recoveries"],
+            "reload_skipped": metrics["reload_skipped_total"],
+            "journal_state": journal.read().get("state"),
+        }
+        ok_codes = all(c == 200 for c in codes)
+        verdict["pass"] = bool(
+            killed and sealed
+            and promoted_bomb and rolled_back and q
+            and snap["promotions"] >= 2
+            and snap["rollbacks"] >= 1
+            and snap["journal_recoveries"] >= 1
+            and dropped == 0 and ok_codes
+            and metrics["server_error_total"] == 0
+            and verdict["phases"][2]["rollback"]
+                ["xla_compiles_during_rollback"] == 0
+        )
+    finally:
+        try:
+            traffic.stop()
+        except Exception:
+            pass
+        server.stop(drain_timeout=2)
+    return verdict
+
+
+if __name__ == "__main__":
+    verdict = {"pass": False, "error": "budget exceeded",
+               "budget_s": BUDGET_S}
+
+    def _alarm(signum, frame):
+        raise TimeoutError("loop demo budget exceeded")
+
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(BUDGET_S)
+    try:
+        verdict = main()
+    except TimeoutError:
+        pass
+    except Exception as e:  # partial verdict, never a bare trace
+        verdict = {"pass": False,
+                   "error": f"{type(e).__name__}: {e}"}
+    finally:
+        signal.alarm(0)
+        print(json.dumps(verdict, default=str))
+    sys.exit(0 if verdict.get("pass") else 1)

@@ -268,7 +268,7 @@ fresh, _ = mgr.restore_latest(load_updater=False)
 want = np.asarray(fresh.output(pad_rows(feats, 4)))[:3]
 bitwise = bool(np.array_equal(
     np.asarray(body["output"], np.float32), want.astype(np.float32)))
-rcode, rbody = srv.reload({})
+rcode, rbody = srv.reload({"force": True})  # same step would no-op
 code2, _, _ = srv.submit(feats)
 snap2 = srv.metrics_snapshot()
 out = {

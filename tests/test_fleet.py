@@ -559,6 +559,57 @@ class TestRouter:
         finally:
             r.stop()
 
+    def test_health_poll_jitter_is_seeded(self):
+        """N routers must not synchronize their /readyz probes: each
+        jitters its poll interval from a seeded RNG — deterministic
+        per seed, decorrelated across seeds, inside the ±jitter
+        band."""
+        a = ServingRouter(["127.0.0.1:1"], seed=CHAOS_SEED,
+                          health_interval=0.25, health_jitter=0.2)
+        b = ServingRouter(["127.0.0.1:1"], seed=CHAOS_SEED,
+                          health_interval=0.25, health_jitter=0.2)
+        c = ServingRouter(["127.0.0.1:1"], seed=CHAOS_SEED + 1,
+                          health_interval=0.25, health_jitter=0.2)
+        flat = ServingRouter(["127.0.0.1:1"], health_jitter=0.0)
+        try:
+            seq_a = [a._next_interval() for _ in range(8)]
+            seq_b = [b._next_interval() for _ in range(8)]
+            seq_c = [c._next_interval() for _ in range(8)]
+            assert seq_a == seq_b          # same seed replays
+            assert seq_a != seq_c          # different seed differs
+            assert len(set(seq_a)) > 1     # actually jitters
+            for v in seq_a:
+                assert 0.25 * 0.8 <= v <= 0.25 * 1.2
+            assert flat._next_interval() == flat.health_interval
+        finally:
+            for r in (a, b, c, flat):
+                r.stop()
+        with pytest.raises(ValueError):
+            ServingRouter(["127.0.0.1:1"], health_jitter=1.5)
+
+    @pytest.mark.chaos
+    def test_readyz_probe_timeout_marks_unhealthy(self):
+        """A backend that ACCEPTS the connection but never answers
+        /readyz (wedged process) is exactly as dead as one refusing
+        connections: the poll times out and the backend drops out of
+        candidate order immediately."""
+        import socket
+
+        wedge = socket.socket()
+        wedge.bind(("127.0.0.1", 0))
+        wedge.listen(8)  # accepts, never reads or answers
+        port = wedge.getsockname()[1]
+        r = ServingRouter([f"127.0.0.1:{port}"], probe_timeout=0.2)
+        try:
+            t0 = time.monotonic()
+            assert r.check_health() == 0
+            assert time.monotonic() - t0 < 2.0  # timed out, not hung
+            assert not r.backends[0].healthy
+            assert r.candidates("m") == []
+        finally:
+            r.stop()
+            wedge.close()
+
     def test_forwards_and_relays_envelopes(self):
         s = _stub_server()
         r = ServingRouter([f"127.0.0.1:{s.port}"]).start()

@@ -1,0 +1,681 @@
+"""Continuous-learning loop tests (tier-1, CPU-only): the promotion
+journal, ContinualTrainer publish/resume, shadow scoring through the
+serving tier, reload idempotence, and the promoter state machine —
+including the four chaos storms ``scripts/run_chaos.sh`` registers:
+kill-the-trainer (see also ``tests/test_resilience.py``), corrupt the
+candidate checkpoint, fail the canary, and SIGKILL mid-promotion with
+journal recovery. Rollback re-installs the previous version's
+retained snapshot with zero XLA compiles (counter-asserted here).
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.loop import (
+    ContinualTrainer,
+    Promoter,
+    PromotionGates,
+    PromotionJournal,
+    ShadowScorer,
+    SimulatedKill,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.resilience import CheckpointManager
+from deeplearning4j_tpu.serving.server import ModelServer
+
+CHAOS_SEED = int(os.environ.get("DL4J_TPU_CHAOS_SEED", "1337"))
+DEAD = 3  # feature column the regression bomb keys on
+
+
+def simple_net(seed=7):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(0.05).updater("ADAM")
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def batches(rng, n_batches=8, batch=8, dead_zero=True):
+    out = []
+    for _ in range(n_batches):
+        x = rng.randn(batch, 4).astype(np.float32)
+        if dead_zero:
+            x[:, DEAD] = 0.0
+        y = np.eye(3)[rng.randint(0, 3, batch)].astype(np.float32)
+        out.append(DataSet(features=x, labels=y))
+    return out
+
+
+def feats(rng, rows=2, shifted=False):
+    x = rng.randn(rows, 4).astype(np.float32)
+    x[:, DEAD] = (rng.randn(rows).astype(np.float32) * 8.0
+                  if shifted else 0.0)
+    return x
+
+
+def make_server(manager, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("aot", False)  # keep jaxlib's executable
+    # deserializer out of the long-lived suite process (PR-6 rule);
+    # real AOT install is exercised by scripts/run_loop.py + the
+    # subprocess tests in test_compile.py
+    return ModelServer(checkpoint_manager=manager, **kw).start()
+
+
+def fast_gates(**kw):
+    kw.setdefault("min_shadow_requests", 3)
+    kw.setdefault("min_agreement", 0.5)
+    kw.setdefault("probation_requests", 2)
+    kw.setdefault("probation_min_seconds", 0.0)
+    return PromotionGates(**kw)
+
+
+def drive(server, rng, n=4, shifted=False):
+    """n sequential predicts; every response must be 200. The shadow
+    mirror runs just AFTER each response completes, so wait for the
+    installed scorer (if any) to have seen these requests before the
+    caller polls the gates."""
+    sh = server.shadow
+    base = sh.snapshot()["requests"] if sh is not None else 0
+    for _ in range(n):
+        code, body, _ = server.submit(feats(rng, shifted=shifted))
+        assert code == 200, body
+    if sh is not None:
+        deadline = time.monotonic() + 10
+        while (sh.snapshot()["requests"] < base + n
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+
+
+# -- promotion journal --------------------------------------------------
+
+
+def test_journal_roundtrip_and_history(tmp_path):
+    j = PromotionJournal(tmp_path / "j.json")
+    assert j.read()["state"] == "idle"  # missing file = empty
+    j.write("shadowing", candidate_step=12, previous_step=8)
+    j.write("canarying", gates_passed=True)
+    doc = j.read()
+    assert doc["state"] == "canarying" and doc["gates_passed"]
+    assert doc["candidate_step"] == 12 and doc["previous_step"] == 8
+    assert [h["state"] for h in doc["history"]] == [
+        "shadowing", "canarying",
+    ]
+    with pytest.raises(ValueError):
+        j.write("exploded")
+
+
+def test_journal_corrupt_reads_empty(tmp_path):
+    p = tmp_path / "j.json"
+    p.write_text("{torn")
+    j = PromotionJournal(p)
+    assert j.read()["state"] == "idle"
+    j.write("promoted", promoted_step=4)  # and writes recover it
+    assert j.read()["promoted_step"] == 4
+
+
+def test_journal_referenced_and_skip_steps(tmp_path):
+    j = PromotionJournal(tmp_path / "j.json")
+    j.write("shadowing", candidate_step=12, previous_step=8,
+            promoted_step=8)
+    assert j.referenced_steps() == [12, 8]
+    j.write("rolled_back", rejected_steps=[12])
+    j.write("quarantined", quarantined_steps=[16])
+    j.write("quarantined", quarantined_steps=[16])  # merge, not dup
+    assert sorted(j.skip_steps()) == [12, 16]
+    assert j.read()["quarantined_steps"] == [16]
+
+
+# -- checkpoint store satellites ----------------------------------------
+
+
+def test_checkpoint_list_and_latest_step(rng, tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last=10)
+    assert mgr.list_steps() == [] and mgr.latest_step() is None
+    net = simple_net()
+    for ds in batches(rng, 3):
+        net.fit_minibatch(ds)
+        mgr.save(net)
+    assert mgr.list_steps() == [1, 2, 3]
+    assert mgr.latest_step() == 3 == mgr.last_step()
+
+
+def test_prune_never_deletes_journal_referenced_step(rng, tmp_path):
+    j = PromotionJournal(tmp_path / "j.json")
+    mgr = CheckpointManager(tmp_path / "ckpts", keep_last=2,
+                            protect=j.referenced_steps)
+    net = simple_net()
+    net.fit_minibatch(batches(rng, 1)[0])
+    mgr.save(net)
+    j.write("promoted", promoted_step=1, previous_step=1)
+    for ds in batches(rng, 4):
+        net.fit_minibatch(ds)
+        mgr.save(net)
+    # keep_last=2 would have pruned step 1; the journal reference
+    # (the rollback target!) protects it
+    assert mgr.list_steps() == [1, 4, 5]
+    j.write("promoted", promoted_step=5, previous_step=4)
+    net.fit_minibatch(batches(rng, 1)[0])
+    mgr.save(net)
+    assert 1 not in mgr.list_steps()  # released once dereferenced
+
+
+# -- continual trainer --------------------------------------------------
+
+
+def test_continual_trainer_publish_cadence(rng, tmp_path):
+    reg = MetricsRegistry()
+    net = simple_net()
+    ct = ContinualTrainer(
+        net, CheckpointManager(tmp_path, keep_last=10),
+        publish_every=3, registry=reg,
+        artifact_fn=lambda m: {"stub": b"blob"},
+    )
+    consumed = ct.run(ListDataSetIterator(batches(rng, 7)))
+    assert consumed == 7
+    assert ct.manager.list_steps() == [3, 6, 7]  # trailing published
+    assert ct.last_published.step == 7
+    assert ct.last_published.artifacts["stub"]["size"] == 4
+    assert reg.get("loop_published_total").value == 3
+    assert reg.get("loop_train_steps_total").value == 7
+
+
+@pytest.mark.chaos
+def test_continual_trainer_kill_resume_bitwise(rng, tmp_path):
+    import conftest
+
+    data = batches(rng, 8)
+
+    full = simple_net()
+    for ds in data:
+        full.fit_minibatch(ds)
+
+    victim = simple_net()
+    ct = ContinualTrainer(victim, CheckpointManager(tmp_path),
+                          publish_every=2)
+    ct.run(ListDataSetIterator(data), max_steps=5)
+    del victim, ct  # the kill (steps 1..5 ran; step 4 published;
+    # trailing publish covered step 5)
+
+    survivor = simple_net()
+    ct2 = ContinualTrainer(survivor, CheckpointManager(tmp_path),
+                           publish_every=2)
+    step = ct2.resume()
+    assert step == 5
+    ct2.run(ListDataSetIterator(data[step:]))
+    assert survivor.iteration_count == full.iteration_count
+    conftest.assert_params_match(full, survivor)
+
+
+# -- shadow scorer ------------------------------------------------------
+
+
+def test_shadow_identical_model_full_agreement(rng):
+    net = simple_net()
+    reg = MetricsRegistry()
+    sc = ShadowScorer(net, fraction=1.0, seed=CHAOS_SEED,
+                      registry=reg)
+    for _ in range(4):
+        x = feats(rng)
+        sc.observe(x, np.asarray(net.output(x)), live_ms=1.0)
+    snap = sc.snapshot()
+    assert snap["shadowed"] == 4 and snap["agreement"] == 1.0
+    assert snap["errors"] == 0
+    assert reg.get("shadow_predicts_total").value == 4
+    assert len(sc.samples()) > 0
+
+
+def test_shadow_detects_disagreement_and_never_raises(rng):
+    class Hostile:
+        def output(self, x):
+            raise RuntimeError("shadow fault")
+
+    live = simple_net(seed=1)
+    other = simple_net(seed=2)
+    sc = ShadowScorer(other, fraction=1.0, seed=CHAOS_SEED)
+    x = feats(rng, rows=8)
+    out = np.asarray(live.output(x))
+    sc.observe(x, out)
+    assert sc.snapshot()["agreement"] is not None
+    bad = ShadowScorer(Hostile(), fraction=1.0, seed=CHAOS_SEED)
+    bad.observe(x, out)  # must not raise
+    assert bad.snapshot()["errors"] == 1
+    nan = ShadowScorer(simple_net(), fraction=1.0, seed=CHAOS_SEED)
+    nan.observe(x, np.full_like(out, np.nan))  # live non-finite
+    assert nan.snapshot()["live_nonfinite"] == 1
+
+
+@pytest.mark.chaos
+def test_shadow_sampling_is_seeded(rng):
+    net = simple_net()
+    x = feats(rng)
+    out = np.asarray(net.output(x))
+
+    def run():
+        sc = ShadowScorer(net, fraction=0.5, seed=CHAOS_SEED)
+        for _ in range(20):
+            sc.observe(x, out)
+        return sc.snapshot()["shadowed"]
+
+    a, b = run(), run()
+    assert a == b and 0 < a < 20  # same seed, same mirror schedule
+
+
+def test_server_mirrors_to_shadow_results_unchanged(rng, tmp_path):
+    net = simple_net()
+    net.iteration_count = 1
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(net)
+    s = make_server(mgr)
+    try:
+        x = feats(rng)
+        want = s.submit(x)[1]["output"]
+        sc = ShadowScorer(simple_net(seed=99), fraction=1.0,
+                          seed=CHAOS_SEED)
+        s.set_shadow(sc)
+        code, body, _ = s.submit(x)
+        assert code == 200
+        # shadow outputs never reach the client: the live answer is
+        # identical with and without the scorer installed
+        assert body["output"] == want
+        # the mirror runs AFTER the response completes (that is the
+        # "never returned to clients" contract): give the worker a
+        # beat to observe
+        deadline = time.monotonic() + 5
+        while (sc.snapshot()["shadowed"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert sc.snapshot()["shadowed"] == 1
+        s.set_shadow(None)
+        s.submit(x)
+        time.sleep(0.05)
+        assert sc.snapshot()["shadowed"] == 1  # uninstalled = silent
+    finally:
+        s.stop(drain_timeout=1)
+
+
+# -- reload idempotence + reload-by-step --------------------------------
+
+
+def test_reload_same_step_is_counted_noop(rng, tmp_path):
+    net = simple_net()
+    net.iteration_count = 1
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(net)
+    s = make_server(mgr)
+    try:
+        warmups = s.metrics.get("warmup_predicts_total")
+        code, body = s.reload({})
+        assert code == 200 and body["status"] == "skipped"
+        assert body["step"] == 1
+        assert s.model_version == 1  # no version churn
+        assert s.metrics.get("reload_skipped_total") == 1
+        assert s.metrics.get("reload_total") == 0
+        # the whole point: canary + warmup did NOT re-run
+        assert s.metrics.get("warmup_predicts_total") == warmups
+        # force overrides the no-op (operator escape hatch)
+        code, body = s.reload({"force": True})
+        assert code == 200 and body["status"] == "reloaded"
+        assert s.model_version == 2
+        # a NEW step reloads normally
+        net.iteration_count = 2
+        mgr.save(net)
+        code, body = s.reload({})
+        assert code == 200 and body["status"] == "reloaded"
+        assert s._watched_step == 2
+    finally:
+        s.stop(drain_timeout=1)
+
+
+def test_reload_skip_over_http(rng, tmp_path):
+    net = simple_net()
+    net.iteration_count = 1
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(net)
+    s = make_server(mgr)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{s.port}/admin/reload", data=b"{}"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["status"] == "skipped"
+    finally:
+        s.stop(drain_timeout=1)
+
+
+def test_reload_specific_step(rng, tmp_path):
+    net = simple_net()
+    mgr = CheckpointManager(tmp_path, keep_last=10)
+    net.iteration_count = 1
+    mgr.save(net)
+    net.fit_minibatch(batches(rng, 1)[0])
+    mgr.save(net)
+    s = make_server(mgr)  # boots the newest (step 2)
+    try:
+        assert s._watched_step == 2
+        code, body = s.reload({"step": 1})
+        assert code == 200 and body["source"] == "checkpoint-step-1"
+        assert s._watched_step == 1
+        code, body = s.reload({"step": 1})  # same step: no-op
+        assert body["status"] == "skipped"
+        code, body = s.reload({"step": 77})
+        assert code == 400  # no such version
+    finally:
+        s.stop(drain_timeout=1)
+
+
+# -- promoter: happy path ----------------------------------------------
+
+
+def test_promoter_promotes_and_seals(rng, tmp_path):
+    mgr = CheckpointManager(tmp_path / "c", keep_last=10)
+    journal = PromotionJournal(tmp_path / "j.json")
+    net = simple_net()
+    ct = ContinualTrainer(net, mgr, publish_every=4, journal=journal)
+    ct.run(ListDataSetIterator(batches(rng, 4)))
+    s = make_server(mgr)
+    try:
+        pr = Promoter(s, mgr, journal, gates=fast_gates(), seed=CHAOS_SEED)
+        assert pr.recover() == "idle"
+        ct.run(ListDataSetIterator(batches(rng, 4)))  # candidate: step 8
+        assert pr.poll() == "shadowing"
+        assert s.shadow is not None
+        drive(s, rng, n=4)
+        assert pr.poll() == "promoted"  # gates -> canary -> swap
+        doc = journal.read()
+        assert doc["promoted_step"] == 8 and doc["probation"]
+        assert s._watched_step == 8 and s.model_version == 2
+        drive(s, rng, n=3)
+        assert pr.poll() == "promoted"
+        assert not journal.read()["probation"]  # sealed
+        assert s.shadow is None
+        snap = pr.snapshot()
+        assert snap["promotions"] == 1 and snap["rollbacks"] == 0
+        assert pr.poll() == "promoted"  # steady state: no churn
+        assert s.metrics.get("reload_total") == 1
+    finally:
+        s.stop(drain_timeout=1)
+
+
+def test_promoter_rejects_disagreeing_candidate(rng, tmp_path):
+    mgr = CheckpointManager(tmp_path / "c", keep_last=10)
+    journal = PromotionJournal(tmp_path / "j.json")
+    net = simple_net(seed=1)
+    net.iteration_count = 1
+    mgr.save(net)
+    s = make_server(mgr)
+    try:
+        pr = Promoter(s, mgr, journal,
+                      gates=fast_gates(min_agreement=0.999),
+                      seed=CHAOS_SEED)
+        stranger = simple_net(seed=42)  # unrelated weights
+        stranger.iteration_count = 2
+        mgr.save(stranger)
+        assert pr.poll() == "shadowing"
+        # disagreement accumulates over live traffic...
+        for _ in range(8):
+            s.submit(feats(rng, rows=4))
+        state = pr.poll()
+        if state == "shadowing":  # seeds could agree on tiny windows
+            for _ in range(16):
+                s.submit(feats(rng, rows=4))
+            state = pr.poll()
+        assert state == "rolled_back"
+        doc = journal.read()
+        assert 2 in doc["rejected_steps"]
+        assert s.model_version == 1  # live never changed
+        assert pr.snapshot()["rejected"] == 1
+        assert pr.poll() == "rolled_back"  # judged: not re-shadowed
+    finally:
+        s.stop(drain_timeout=1)
+
+
+# -- chaos storms -------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_corrupt_candidate_quarantined_live_serving(rng, tmp_path):
+    """Storm: the trainer publishes a candidate whose zip is torn
+    (preemption mid-upload shape). The promoter quarantines it; the
+    live version keeps serving; the NEXT good candidate promotes."""
+    mgr = CheckpointManager(tmp_path / "c", keep_last=10)
+    journal = PromotionJournal(tmp_path / "j.json")
+    net = simple_net()
+    ct = ContinualTrainer(net, mgr, publish_every=4, journal=journal)
+    ct.run(ListDataSetIterator(batches(rng, 4)))
+    s = make_server(mgr)
+    try:
+        pr = Promoter(s, mgr, journal, gates=fast_gates(),
+                      seed=CHAOS_SEED)
+        ct.run(ListDataSetIterator(batches(rng, 4)))  # step 8
+        bad = mgr.available()[-1]
+        zpath = mgr.directory / bad.file
+        zpath.write_bytes(zpath.read_bytes()[:64])  # the torn tail
+        assert pr.poll() == "quarantined"
+        assert pr.snapshot()["quarantined"] == 1
+        assert 8 in journal.read()["quarantined_steps"]
+        drive(s, rng, n=2)  # live keeps serving
+        assert s.model_version == 1
+        assert pr.poll() == "quarantined"  # not retried
+        ct.run(ListDataSetIterator(batches(rng, 4)))  # step 12, good
+        assert pr.poll() == "shadowing"
+        drive(s, rng, n=4)
+        assert pr.poll() == "promoted"
+        assert journal.read()["promoted_step"] == 12
+    finally:
+        s.stop(drain_timeout=1)
+
+
+@pytest.mark.chaos
+def test_canary_fail_keeps_old_version(rng, tmp_path):
+    """Storm: a restorable-but-poisoned candidate (non-finite on the
+    canary) must fail the swap, not the next thousand requests — at
+    the reload level AND through the promoter (rejected at shadow
+    warmup, before any client traffic touches it)."""
+    mgr = CheckpointManager(tmp_path / "c", keep_last=10)
+    net = simple_net()
+    net.iteration_count = 1
+    mgr.save(net)
+    poisoned = simple_net()
+    poisoned.params["1"]["b"] = np.full_like(
+        np.asarray(poisoned.params["1"]["b"]), np.inf
+    )
+    poisoned.iteration_count = 2
+    mgr.save(poisoned)
+    s = make_server(mgr)  # boot restores newest -> canary on start?
+    try:
+        # the server booted on the poisoned newest; demote explicitly
+        code, body = s.reload({"step": 1, "force": True})
+        assert code == 200
+        # reload-level canary failure
+        code, body = s.reload({"step": 2})
+        assert code == 503
+        assert body["error"]["status"] == "reload_failed"
+        assert s._watched_step == 1  # old version still serving
+        drive(s, rng, n=2)
+        # promoter-level: the same candidate is rejected before
+        # shadowing (warmup forward is non-finite)
+        journal = PromotionJournal(tmp_path / "j.json")
+        journal.write("promoted", promoted_step=1, previous_step=1)
+        pr = Promoter(s, mgr, journal, gates=fast_gates(),
+                      seed=CHAOS_SEED)
+        assert pr.poll() == "rolled_back"
+        assert 2 in journal.read()["rejected_steps"]
+        assert pr.snapshot()["rejected"] == 1
+        assert s.model_version >= 2 and s._watched_step == 1
+    finally:
+        s.stop(drain_timeout=1)
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_promotion_recovers_from_journal(rng, tmp_path):
+    """Storm: the promoter dies right after journaling ``canarying``
+    (gates passed, swap not yet issued) — the worst instant. A fresh
+    promoter must roll the promotion FORWARD from the journal to a
+    consistent serving state, exactly once."""
+    mgr = CheckpointManager(tmp_path / "c", keep_last=10)
+    journal = PromotionJournal(tmp_path / "j.json")
+    net = simple_net()
+    ct = ContinualTrainer(net, mgr, publish_every=4, journal=journal)
+    ct.run(ListDataSetIterator(batches(rng, 4)))
+    s = make_server(mgr)
+    try:
+        pr = Promoter(s, mgr, journal, gates=fast_gates(),
+                      seed=CHAOS_SEED)
+        ct.run(ListDataSetIterator(batches(rng, 4)))  # step 8
+        pr.fail_after_journal = "canarying"
+        assert pr.poll() == "shadowing"
+        drive(s, rng, n=4)
+        with pytest.raises(SimulatedKill):
+            pr.poll()
+        assert journal.state == "canarying"  # the split instant
+        assert s.model_version == 1          # swap never happened
+        # "new process": fresh promoter over the same journal
+        pr2 = Promoter(s, mgr, journal, gates=fast_gates(),
+                       seed=CHAOS_SEED)
+        assert pr2.recover() == "promoted"   # rolled forward
+        assert journal.read()["promoted_step"] == 8
+        assert s._watched_step == 8 and s.model_version == 2
+        assert pr2.snapshot()["journal_recoveries"] == 1
+        drive(s, rng, n=3)
+        pr2.poll()
+        assert not journal.read()["probation"]  # sealed normally
+    finally:
+        s.stop(drain_timeout=1)
+
+
+@pytest.mark.chaos
+def test_rollback_reinstalls_snapshot_zero_compiles(rng, tmp_path):
+    """Storm: a candidate identical on today's traffic but divergent
+    under a distribution shift is promoted, the shift lands during
+    probation, and the promoter rolls back by re-installing the
+    previous version's retained snapshot — ZERO XLA compiles
+    (counter-asserted: the snapshot still carries its warmed
+    executables) and every request during the transition answered."""
+    mgr = CheckpointManager(tmp_path / "c", keep_last=10)
+    journal = PromotionJournal(tmp_path / "j.json")
+    net = simple_net()
+    ct = ContinualTrainer(net, mgr, publish_every=4, journal=journal)
+    ct.run(ListDataSetIterator(batches(rng, 4)))
+    s = make_server(mgr)
+    try:
+        pr = Promoter(
+            s, mgr, journal,
+            gates=fast_gates(probation_requests=100,
+                             probation_min_agreement=0.9),
+            seed=CHAOS_SEED,
+        )
+        # the bomb: step-4 weights + a huge dead-feature row — equal
+        # outputs while feature DEAD stays 0, divergent once it moves
+        bomb, info = mgr.restore_latest(load_updater=False)
+        w = np.array(bomb.params["0"]["W"])
+        w[DEAD, :] = np.where(np.arange(w.shape[1]) % 2 == 0,
+                              40.0, -40.0)
+        bomb.params["0"]["W"] = w
+        bomb.iteration_count = info.step + 1
+        mgr.save(bomb)
+
+        base_version = s.model_version
+        assert pr.poll() == "shadowing"
+        drive(s, rng, n=4)              # baseline traffic: agreement 1
+        assert pr.poll() == "promoted"  # bomb takes traffic
+        assert s.model_version == base_version + 1
+        entry = s.model_registry.entry()
+        promoted_obj = entry.current
+        compiles = s.metrics.get("xla_compiles_total")
+
+        drive(s, rng, n=6, shifted=True)  # the shift goes live
+        assert pr.poll() == "rolled_back"
+        doc = journal.read()
+        assert doc["promoted_step"] == 4  # back on the old version
+        assert info.step + 1 in doc["rejected_steps"]
+        assert entry.current is not promoted_obj  # snapshot swapped
+        assert pr.snapshot()["rollbacks"] == 1
+
+        drive(s, rng, n=4)               # post-rollback traffic
+        drive(s, rng, n=2, shifted=True)  # old version shrugs it off
+        assert s.metrics.get("xla_compiles_total") == compiles
+        assert s.metrics.get("server_error_total") == 0
+        assert pr.poll() == "rolled_back"  # bomb not re-promoted
+        # the retained pre-promotion snapshot object IS serving again
+        assert s.model_version == base_version
+    finally:
+        s.stop(drain_timeout=1)
+
+
+def test_recover_demotes_unvetted_boot(rng, tmp_path):
+    """A fresh server boots from the NEWEST checkpoint — which may be
+    an unvetted candidate. recover() restores the journal's promoted
+    step so evaluation starts from a consistent base."""
+    mgr = CheckpointManager(tmp_path / "c", keep_last=10)
+    journal = PromotionJournal(tmp_path / "j.json")
+    net = simple_net()
+    ct = ContinualTrainer(net, mgr, publish_every=4, journal=journal)
+    ct.run(ListDataSetIterator(batches(rng, 8)))  # steps 4, 8
+    journal.write("promoted", promoted_step=4, previous_step=4,
+                  probation=False)
+    s = make_server(mgr)  # boots step 8 (newest)
+    try:
+        assert s._watched_step == 8
+        pr = Promoter(s, mgr, journal, gates=fast_gates(),
+                      seed=CHAOS_SEED)
+        pr.recover()
+        assert s._watched_step == 4  # demoted to the promoted step
+        assert pr.snapshot()["journal_recoveries"] == 1
+        assert pr.poll() == "shadowing"  # step 8 re-enters as candidate
+    finally:
+        s.stop(drain_timeout=1)
+
+
+@pytest.mark.chaos
+def test_recover_rearms_probation(rng, tmp_path):
+    """SIGKILL during probation: the previous version's in-memory
+    snapshot died with the process, but its checkpoint is journal-
+    protected — recovery restores it, re-arms the reversed shadow,
+    and a regression found after the restart still rolls back."""
+    mgr = CheckpointManager(tmp_path / "c", keep_last=10)
+    journal = PromotionJournal(tmp_path / "j.json")
+    net = simple_net()
+    ct = ContinualTrainer(net, mgr, publish_every=4, journal=journal)
+    ct.run(ListDataSetIterator(batches(rng, 4)))
+    bomb, info = mgr.restore_latest(load_updater=False)
+    w = np.array(bomb.params["0"]["W"])
+    w[DEAD, :] = 40.0
+    bomb.params["0"]["W"] = w
+    bomb.iteration_count = info.step + 1
+    mgr.save(bomb)
+    # journal says: bomb promoted, probation open (the pre-kill state)
+    journal.write("promoted", candidate_step=5, previous_step=4,
+                  promoted_step=5, probation=True)
+    s = make_server(mgr)  # fresh process serves the newest (the bomb)
+    try:
+        pr = Promoter(
+            s, mgr, journal,
+            gates=fast_gates(probation_requests=100,
+                             probation_min_agreement=0.9),
+            seed=CHAOS_SEED,
+        )
+        assert pr.recover() == "promoted"
+        assert s.shadow is not None  # probation re-armed
+        assert pr.snapshot()["journal_recoveries"] == 1
+        drive(s, rng, n=6, shifted=True)  # regression manifests now
+        assert pr.poll() == "rolled_back"
+        assert journal.read()["promoted_step"] == 4
+        drive(s, rng, n=2)
+    finally:
+        s.stop(drain_timeout=1)

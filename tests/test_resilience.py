@@ -445,6 +445,75 @@ def test_kill_resume_mid_epoch_with_prefetch(rng, tmp_path):
     assert_updater_state_match(full, survivor)
 
 
+@pytest.mark.chaos
+def test_kill_resume_continual_trainer_prefetch_artifacts(rng, tmp_path):
+    """The continuous-learning loop's producer half under the same
+    storm: a ``ContinualTrainer`` streams through a PrefetchIterator
+    (sharded placement on the worker thread) over a
+    ``DistributedTrainer``, publishing every 2 steps WITH side
+    artifacts attached to each manifest, dies mid-epoch, and a fresh
+    trainer resumes from the newest published version to the
+    identical trajectory bitwise. Artifacts are stub bytes here on
+    purpose: the manifest/publish path is what this exercises, and
+    real AOT blobs must not ride the long-lived suite process (see
+    tests/test_compile.py's subprocess rule); scripts/run_loop.py
+    attaches real bundles end to end."""
+    from deeplearning4j_tpu.datasets.prefetch import PrefetchIterator
+    from deeplearning4j_tpu.loop import ContinualTrainer
+    from deeplearning4j_tpu.parallel import (
+        DistributedTrainer, build_mesh,
+    )
+
+    conftest.require_devices(2)
+    data = batches(rng, n_batches=8, batch=16)
+
+    # uninterrupted pipelined run: N steps
+    full = simple_net()
+    tr_full = DistributedTrainer(full, mesh=build_mesh())
+    tr_full.fit(ListDataSetIterator(data), epochs=1, prefetch=2)
+
+    def stub_artifacts(model):
+        return {"aot-output-b4": b"stub-executable-bytes"}
+
+    mgr = CheckpointManager(tmp_path, keep_last=10)
+    victim = simple_net()
+    tr_victim = DistributedTrainer(victim, mesh=build_mesh())
+    ct = ContinualTrainer(victim, mgr, publish_every=2,
+                          trainer=tr_victim,
+                          artifact_fn=stub_artifacts)
+    pf = PrefetchIterator(
+        ListDataSetIterator(data), queue_depth=4,
+        placement=tr_victim.place_minibatch,
+    )
+    consumed = ct.run(pf, max_steps=3)
+    assert consumed == 3
+    pf.shutdown()  # the kill: queued runahead dies with the worker
+    del victim, tr_victim, ct
+
+    # published versions carry the artifacts in their manifests
+    infos = CheckpointManager(tmp_path).available()
+    assert [i.step for i in infos] == [2, 3]  # cadence + trailing
+    assert all("aot-output-b4" in i.artifacts for i in infos)
+
+    survivor = simple_net()
+    tr = DistributedTrainer(survivor, mesh=build_mesh())
+    ct2 = ContinualTrainer(survivor, CheckpointManager(tmp_path),
+                           publish_every=2, trainer=tr,
+                           artifact_fn=stub_artifacts)
+    step = ct2.resume()
+    assert step == 3
+    pf2 = PrefetchIterator(
+        ListDataSetIterator(data[step:]), queue_depth=4,
+        placement=tr.place_minibatch,
+    )
+    ct2.run(pf2)
+    pf2.shutdown()
+
+    assert survivor.iteration_count == full.iteration_count
+    conftest.assert_params_match(full, survivor)
+    assert_updater_state_match(full, survivor)
+
+
 def test_fit_resume_from_kwarg(rng, tmp_path):
     data = batches(rng, n_batches=4)
     mgr = CheckpointManager(tmp_path)
