@@ -1140,6 +1140,41 @@ def bench_aot_compile(budget_s=None) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _bench_transforms(section: str, budget_s=None) -> dict:
+    """``compile_vs_depth`` / ``remat_memory`` via the standalone
+    transform A/B script (scripts/bench_transforms.py — every
+    measurement is a cold subprocess with the compile cache DISABLED,
+    so the reported compiles are real even when this bench child
+    shares the persistent cache). Gates: >=2x compile-time reduction
+    at depth 64 with scan-over-layers; >=1.5x max-fitting batch (or
+    equivalent temp-bytes reduction) with remat on."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_transforms.py",
+    )
+    timeout = 560
+    if budget_s is not None:
+        timeout = max(60, min(timeout, int(budget_s)))
+    cmd = [sys.executable, script, "--section", section,
+           "--budget-s", str(timeout - 20)]
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_transforms {section} failed: {out.stderr[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def bench_compile_vs_depth(budget_s=None) -> dict:
+    return _bench_transforms("compile_vs_depth", budget_s)
+
+
+def bench_remat_memory(budget_s=None) -> dict:
+    return _bench_transforms("remat_memory", budget_s)
+
+
 def bench_observability(iters=300, windows=5) -> dict:
     """Overhead of the observability substrate on the two hot paths.
 
@@ -1343,6 +1378,17 @@ def _section_table(budget_fn):
         ("observability_overhead", bench_observability,
          "instrumented vs uninstrumented predict/train hot paths "
          "(no-op registry/tracer must be <= 5% overhead)"),
+        ("compile_vs_depth",
+         lambda: bench_compile_vs_depth(budget_fn()),
+         "train-step trace+compile wall at transformer depth "
+         "4/16/64, scan-over-layers off vs on "
+         "(scripts/bench_transforms.py; >=2x at depth 64 is the "
+         "gate)"),
+        ("remat_memory",
+         lambda: bench_remat_memory(budget_fn()),
+         "activation working set + max-fitting batch at fixed "
+         "budget, remat off vs on "
+         "(scripts/bench_transforms.py; >=1.5x batch is the gate)"),
     ]
 
 

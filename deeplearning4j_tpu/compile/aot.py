@@ -377,16 +377,19 @@ class AotStepFunction:
         return tuple(int(d) for d in v.shape)
 
     def __call__(self, params, upd_state, state, x, y, mask, fmask,
-                 lrs, t, rng):
+                 lrs, t, rng, *extra):
+        # ``extra`` carries transform state the core step threads
+        # through (the dynamic loss-scale dict) — part of the exported
+        # signature, forwarded verbatim
         if (mask is None and fmask is None
                 and self._key_of(x) == self._x_shape
                 and self._key_of(y) == self._y_shape):
             return self._compiled(params, upd_state, state, x, y,
-                                  mask, fmask, lrs, t, rng)
+                                  mask, fmask, lrs, t, rng, *extra)
         if self._fallback is None:
             self._fallback = self._build_fallback()
         return self._fallback(params, upd_state, state, x, y, mask,
-                              fmask, lrs, t, rng)
+                              fmask, lrs, t, rng, *extra)
 
 
 # -- serving bundle -----------------------------------------------------

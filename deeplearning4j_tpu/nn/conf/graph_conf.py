@@ -391,6 +391,11 @@ class ComputationGraphConfiguration:
     input_types: Optional[Tuple[InputType, ...]] = None
     optimization_algo: str = "STOCHASTIC_GRADIENT_DESCENT"
     max_num_line_search_iterations: int = 5
+    # whole-net transform hints (nn/core.py) — runtime knobs, NOT
+    # serialized (see MultiLayerConfiguration for rationale)
+    scan_layers: bool = False
+    remat: str = "none"  # none | dots_saveable | full
+    loss_scale: Optional[float] = None  # float16 dynamic loss scaling
 
     def topological_order(self) -> List[str]:
         """Kahn ordering of vertex names (reference
@@ -599,6 +604,9 @@ class GraphBuilder:
             max_num_line_search_iterations=getattr(
                 self._parent, "_max_num_line_search_iterations", 5
             ),
+            scan_layers=getattr(self._parent, "_scan_layers", False),
+            remat=getattr(self._parent, "_remat", "none"),
+            loss_scale=getattr(self._parent, "_loss_scale", None),
         )
         if self._input_types is not None:
             conf = _infer_shapes(conf)

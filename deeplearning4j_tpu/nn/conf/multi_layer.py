@@ -71,6 +71,15 @@ class MultiLayerConfiguration:
     optimization_algo: str = "STOCHASTIC_GRADIENT_DESCENT"
     max_num_line_search_iterations: int = 5
     minimize: bool = True
+    # whole-net transform hints (nn/core.py), deliberately NOT
+    # serialized: they change the compiled program, never the model
+    # semantics, so they stay out of the checkpoint/config identity —
+    # a checkpoint trained with scan/remat off restores into a model
+    # running them on (and vice versa). Runtime override:
+    # ``model.set_transforms(...)``.
+    scan_layers: bool = False
+    remat: str = "none"  # none | dots_saveable | full
+    loss_scale: Optional[float] = None  # float16 dynamic loss scaling
 
     # -- serialization (parity: conf JSON is the checkpoint schema) --------
 
@@ -299,6 +308,9 @@ class ListBuilder:
                 self._parent._max_num_line_search_iterations
             ),
             minimize=self._parent._minimize,
+            scan_layers=self._parent._scan_layers,
+            remat=self._parent._remat,
+            loss_scale=self._parent._loss_scale,
         )
 
 
@@ -315,6 +327,9 @@ class NeuralNetConfiguration:
             self._optimization_algo = "STOCHASTIC_GRADIENT_DESCENT"
             self._max_num_line_search_iterations = 5
             self._minimize = True
+            self._scan_layers = False
+            self._remat = "none"
+            self._loss_scale = None
             self._globals: dict = {}
 
         # -- global hyperparameters (each returns self) --------------------
@@ -338,6 +353,28 @@ class NeuralNetConfiguration:
             all-or-nothing FP16 backend switch (which disabled its cuDNN
             helpers entirely, ``ConvolutionLayer.java:163``)."""
             self._compute_dtype = dtype
+            return self
+
+        def scan_layers(self, enabled: bool = True):
+            """Whole-net transform hint: run homogeneous layer runs
+            under one ``lax.scan`` (O(depth) HLO -> O(1); see
+            ``nn/core.py``). Trajectory-neutral; runtime override via
+            ``model.set_transforms``."""
+            self._scan_layers = bool(enabled)
+            return self
+
+        def remat(self, policy: str = "full"):
+            """Whole-net transform hint: activation rematerialization
+            policy (``none | dots_saveable | full``) — trade recompute
+            FLOPs for activation HBM in the backward pass."""
+            self._remat = policy
+            return self
+
+        def loss_scale(self, scale=True):
+            """Dynamic loss scaling for ``compute_data_type("float16")``
+            (True = default 2**15 initial scale; a number sets the
+            initial scale; None/0 disables). bf16 is unaffected."""
+            self._loss_scale = scale
             return self
 
         def optimization_algo(self, algo: str):

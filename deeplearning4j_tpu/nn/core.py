@@ -1,0 +1,1136 @@
+"""The unified functional engine core.
+
+``MultiLayerNetwork`` (sequential stack) and ``ComputationGraph``
+(DAG) used to duplicate every hot path: each carried its own jitted
+train-step builder, scan-fused multi-step, pretrain step, epoch/fit
+drivers, and scan-chunk plumbing — so every performance PR paid its
+tax twice. This module is the single implementation both engines wrap:
+
+- **Pure step builders** (``build_step`` / ``build_multi_step`` /
+  ``build_pretrain_step``): forward -> loss -> ``jax.value_and_grad``
+  -> updater -> (optional) divergence-guard select, telemetry
+  grad-norm, dynamic loss scaling — with params/updater-state/state
+  donation. An engine contributes only a ``score_fn`` closure (its
+  pure forward+loss) and an optional in-jit ``cast`` for the
+  cast-on-device input contract.
+- **Whole-net transforms**, implemented once and applied through the
+  engines' pure forwards:
+
+  * *scan-over-layers* (``detect_layer_runs`` / ``detect_vertex_chains``
+    + ``apply_layer_run``): maximal runs of identical, stateless
+    layers (transformer blocks, repeated dense groups) have their
+    params stacked and the run body traced ONCE under
+    ``jax.lax.scan`` — collapsing O(depth) HLO into O(1), which is
+    what bounds deep-stack compile time (BENCH r05/r06).
+  * *activation rematerialization* (``maybe_remat``): a
+    ``none | dots_saveable | full`` policy via ``jax.checkpoint``
+    that trades recompute FLOPs for activation HBM, unlocking larger
+    batches at fixed peak memory.
+  * *dynamic loss scaling* for ``compute_dtype="float16"``
+    (``loss_scale_state`` + the ``loss_scale`` step mode): the loss
+    is scaled before the backward pass, gradients unscaled after,
+    and a non-finite gradient skips the update in-jit and halves the
+    scale; ``growth_interval`` clean steps double it back. bf16
+    needs none of this (same exponent range as f32) and is unchanged.
+
+- **Fit drivers** (``fit_batches`` / ``fit_epoch_scan`` /
+  ``run_scan_chunk`` / ``fit_epochs_device_cached``): the epoch loop,
+  scan-chunk grouping, async-dispatch window wiring and listener
+  protocol, shared verbatim by both engines.
+
+``scripts/lint_parity.py`` enforces the split: the engine modules may
+not re-grow a ``value_and_grad`` / ``lax.scan`` of their own.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# dtype / device helpers (shared cast-on-device contract)
+# ---------------------------------------------------------------------------
+
+
+def dtype_of(conf):
+    return jnp.dtype(conf.dtype)
+
+
+def compute_dtype_of(conf) -> jnp.dtype:
+    """Forward/backward compute dtype: ``conf.compute_dtype`` when set
+    (mixed precision — bf16/f16 on the MXU with f32 master params),
+    else the storage dtype."""
+    return jnp.dtype(getattr(conf, "compute_dtype", None) or conf.dtype)
+
+
+def cast_floats(tree, dtype):
+    """Cast floating leaves of a pytree to ``dtype`` (ints — embedding
+    indices, native-width inputs — pass through untouched)."""
+    return jax.tree_util.tree_map(
+        lambda a: (
+            a.astype(dtype)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact)
+            else a
+        ),
+        tree,
+    )
+
+
+def to_device(a, dtype):
+    """Convert a host array for the jitted step. Integer inputs (e.g.
+    uint8 one-hot/pixel data) transfer in their native width and are
+    cast to the compute dtype ON DEVICE by the step — 4x less
+    host->device traffic than converting to float32 first. Already-
+    device-resident arrays pass straight through (no host round
+    trip)."""
+    if isinstance(a, jax.Array):
+        return a.astype(dtype) if a.dtype != dtype else a
+    a = np.asarray(a)
+    if a.dtype.kind in ("u", "i") and a.dtype.itemsize <= 2:
+        return jnp.asarray(a)
+    return jnp.asarray(a, dtype)
+
+
+def cast_stacked(a, dtype):
+    """The cast-on-device contract shared by stack_on_device and the
+    prestacked-chunk paths of both engines: narrow integers ride at
+    native width (the step casts on device); everything else casts to
+    the model dtype."""
+    return (
+        a
+        if a.dtype.kind in ("u", "i") and a.dtype.itemsize <= 2
+        else a.astype(dtype)
+    )
+
+
+def stack_on_device(arrs, dtype):
+    """Stack k same-shaped minibatch arrays for a fused dispatch,
+    preserving the cast-on-device contract in ONE place for both
+    engines: already-device arrays stack on device (no host round
+    trip), narrow integer inputs (uint8 pixels/one-hots) keep their
+    native width — the step casts them on device."""
+    if all(isinstance(a, jax.Array) for a in arrs):
+        return cast_stacked(jnp.stack(arrs), dtype)
+    return to_device(np.stack([np.asarray(a) for a in arrs]), dtype)
+
+
+def nbytes(a) -> int:
+    nb = getattr(a, "nbytes", None)
+    return int(nb) if nb is not None else int(np.asarray(a).nbytes)
+
+
+def iter_unchunked(data):
+    """Iterate minibatches, expanding any ChunkedDataSet elements
+    (streamed pipelines may deliver pre-stacked chunks; consumers
+    without a fused path unstack here)."""
+    from deeplearning4j_tpu.datasets.api import ChunkedDataSet
+
+    for d in data:
+        if isinstance(d, ChunkedDataSet):
+            yield from d.to_datasets()
+        else:
+            yield d
+
+
+def reg_penalty(layer, layer_params):
+    """L1/L2 penalty for one layer (reference calcL1/calcL2)."""
+    reg = 0.0
+    if layer.l1 > 0.0 or layer.l2 > 0.0:
+        for pn in layer.regularizable_params():
+            if pn in layer_params:
+                w = layer_params[pn]
+                if layer.l2 > 0.0:
+                    reg = reg + 0.5 * layer.l2 * jnp.sum(w * w)
+                if layer.l1 > 0.0:
+                    reg = reg + layer.l1 * jnp.sum(jnp.abs(w))
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# scan constants (device-resident lr stacks / iteration counter)
+# ---------------------------------------------------------------------------
+
+
+def scan_consts(model, k: int, it0: int):
+    """Device-resident (lr_stack, it0) for a fused k-step dispatch.
+
+    Both are tiny, but through a high-latency host link (e.g. the
+    tunneled-TPU dev setup) transferring the per-layer lr dict —
+    ~n_layers small arrays — EVERY chunk dominated ResNet-50-class
+    dispatch cost. Constant schedules (the common case) repeat the
+    same values every chunk, so the device copy is cached by value;
+    the it0 scalar is reused from the multi-step program's own
+    device-computed ``it0 + k`` output (``note_it0``) so steady-state
+    chunks transfer nothing host-side at all."""
+    rows = [model.updater_def.scheduled_lrs(it0 + i) for i in range(k)]
+    names = list(model.updater_def.settings)
+    key = (k, tuple(
+        tuple(float(r[n]) for n in names) for r in rows
+    ))
+    cache = model._scan_const_cache
+    lr = cache.get(key)
+    if lr is None:
+        if len(cache) >= 64:  # unbounded only for pathological schedules
+            cache.clear()
+        lr = {
+            n: jnp.asarray([r[n] for r in rows], jnp.float32)
+            for n in names
+        }
+        cache[key] = lr
+    if model._it0_shadow == it0 and model._it0_dev is not None:
+        it0_dev = model._it0_dev
+    else:
+        it0_dev = jnp.asarray(it0, jnp.int32)
+    return lr, it0_dev
+
+
+def note_it0(model, it0_dev, host_value: int) -> None:
+    """Record the device-side iteration counter a multi-step program
+    returned, for reuse by the next chunk's ``scan_consts``."""
+    model._it0_dev = it0_dev
+    model._it0_shadow = host_value
+
+
+# ---------------------------------------------------------------------------
+# streaming (rnn_time_step) bookkeeping
+# ---------------------------------------------------------------------------
+
+
+def stream_guard_and_prime(named_layers, rnn_state, stream_steps,
+                           t_new, batch, dtype) -> None:
+    """Shared ``rnn_time_step`` bookkeeping for both engines: raise
+    before a finite streaming cache (KV) would silently wrap, and
+    prime missing streaming state (zero caches / carries).
+    ``named_layers``: (name, layer_conf) pairs."""
+    caps = [
+        lc.stream_capacity() for _, lc in named_layers
+        if lc.streams_state() and lc.stream_capacity()
+    ]
+    if caps and stream_steps + t_new > min(caps):
+        raise ValueError(
+            f"rnn_time_step overflow: {stream_steps} + {t_new} "
+            f"timesteps exceeds the smallest streaming cache "
+            f"({min(caps)}); raise kv_cache or call "
+            "rnn_clear_previous_state()"
+        )
+    for name, lc in named_layers:
+        if (
+            lc.streams_state()
+            and name not in rnn_state
+            and getattr(lc, "init_stream_state", None) is not None
+        ):
+            rnn_state[name] = lc.init_stream_state(batch, dtype)
+
+
+def extract_stream_state(named_layers, new_state, rnn_state) -> None:
+    """Pull each streaming layer's carry keys out of the step's state
+    into the host-held ``rnn_state`` (the reference's stateMap)."""
+    for name, lc in named_layers:
+        if lc.streams_state():
+            rnn_state[name] = {
+                k: new_state[name][k]
+                for k in lc.stream_state_keys()
+                if k in new_state[name]
+            }
+
+
+# ---------------------------------------------------------------------------
+# whole-net transform: activation rematerialization
+# ---------------------------------------------------------------------------
+
+REMAT_POLICIES = ("none", "dots_saveable", "full")
+
+
+def check_remat_policy(policy: str) -> str:
+    if policy not in REMAT_POLICIES:
+        raise ValueError(
+            f"remat policy must be one of {REMAT_POLICIES}, "
+            f"got {policy!r}"
+        )
+    return policy
+
+
+def maybe_remat(fn: Callable, policy: str) -> Callable:
+    """Wrap ``fn`` in ``jax.checkpoint`` per the remat policy:
+    ``"full"`` saves only the inputs (recompute everything in the
+    backward pass), ``"dots_saveable"`` keeps matmul/conv outputs (the
+    MXU results that are expensive to recompute) and drops the cheap
+    elementwise intermediates, ``"none"`` is the identity. The primal
+    forward is untouched — only what the backward pass reads changes —
+    so outputs (and, op-for-op, gradients) match the unwrapped fn."""
+    if policy == "none":
+        return fn
+    if policy == "full":
+        return jax.checkpoint(fn)
+    check_remat_policy(policy)
+    return jax.checkpoint(
+        fn, policy=jax.checkpoint_policies.dots_saveable
+    )
+
+
+# ---------------------------------------------------------------------------
+# whole-net transform: scan-over-layers
+# ---------------------------------------------------------------------------
+
+
+def layer_scan_signature(layer) -> str:
+    """Config identity for run detection: two layers with equal
+    signatures are the SAME program modulo parameter values (the name
+    is display-only)."""
+    from deeplearning4j_tpu.nn.layers.base import layer_to_json
+
+    d = layer_to_json(layer)
+    d.pop("name", None)
+    return json.dumps(d, sort_keys=True, default=str)
+
+
+def scannable_layer(layer) -> bool:
+    """A layer may join a scanned run when its per-step program is
+    self-contained and stateless: no recurrent/TBPTT carry, no loss
+    head, no pretrain phase, no batch statistics, and an empty state
+    pytree (BatchNorm's running stats would have to thread through the
+    scan carry — excluded instead)."""
+    try:
+        return bool(
+            layer.supports_layer_scan() and not layer.init_state()
+        )
+    except Exception:
+        return False
+
+
+def detect_layer_runs(layers, preprocessors=None,
+                      min_run: int = 2) -> List[Tuple[int, int]]:
+    """Maximal runs ``[(start, end))`` of consecutive identical,
+    scannable layers in a sequential stack. A preprocessor on an inner
+    member breaks the run (its reshape is part of the program); one on
+    the head is fine — it applies before the run is entered."""
+    pre = preprocessors or {}
+    runs: List[Tuple[int, int]] = []
+    i, n = 0, len(layers)
+    while i < n:
+        if not scannable_layer(layers[i]):
+            i += 1
+            continue
+        sig = layer_scan_signature(layers[i])
+        j = i + 1
+        while (
+            j < n
+            and j not in pre
+            and scannable_layer(layers[j])
+            and layer_scan_signature(layers[j]) == sig
+        ):
+            j += 1
+        if j - i >= min_run:
+            runs.append((i, j))
+        i = max(j, i + 1)
+    return runs
+
+
+def detect_vertex_chains(conf, topo) -> List[Tuple[int, int]]:
+    """Scan-over-layers for the DAG engine: maximal linear chains
+    ``[(start, end))`` over consecutive TOPO positions where every
+    member is a single-input, preprocessor-less LayerVertex with an
+    identical scannable layer config, each inner member feeds ONLY the
+    next, and no member is an output vertex. (Consecutive topo
+    positions keep the per-layer PRNG fold-in indices a contiguous
+    range, bitwise-matching the unrolled walk.)"""
+    from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
+
+    consumers: Dict[str, int] = {}
+    for name in topo:
+        for s in conf.vertex_inputs.get(name, []):
+            consumers[s] = consumers.get(s, 0) + 1
+
+    def eligible(name: str) -> bool:
+        v = conf.vertices[name]
+        return (
+            isinstance(v, LayerVertex)
+            and v.preprocessor is None
+            and name not in conf.outputs
+            and len(conf.vertex_inputs.get(name, [])) == 1
+            and scannable_layer(v.layer_conf)
+        )
+
+    chains: List[Tuple[int, int]] = []
+    i, n = 0, len(topo)
+    while i < n:
+        if not eligible(topo[i]):
+            i += 1
+            continue
+        sig = layer_scan_signature(conf.vertices[topo[i]].layer_conf)
+        j = i
+        while (
+            j + 1 < n
+            and eligible(topo[j + 1])
+            and tuple(conf.vertex_inputs[topo[j + 1]]) == (topo[j],)
+            and consumers.get(topo[j], 0) == 1
+            and layer_scan_signature(
+                conf.vertices[topo[j + 1]].layer_conf
+            ) == sig
+        ):
+            j += 1
+        if j > i:
+            chains.append((i, j + 1))
+        i = max(j + 1, i + 1)
+    return chains
+
+
+def apply_layer_run(layer, names, params, x, *, train, rng, idx0,
+                    mask=None, remat: str = "none"):
+    """Apply ``len(names)`` identical layers as ONE ``lax.scan`` over
+    their stacked params. The run body is traced once, so the HLO for
+    a depth-d run is O(1) instead of O(d) — the compile-time win. The
+    per-layer PRNG keys are the same ``fold_in(rng, layer_index)``
+    stream the unrolled walk draws, so dropout/DropConnect masks are
+    bitwise identical with the transform on or off."""
+    pnames = list(params[names[0]])
+    stacked = {
+        pn: jnp.stack([params[n][pn] for n in names]) for pn in pnames
+    }
+    k = len(names)
+    rngs = None
+    if rng is not None:
+        rngs = jax.vmap(
+            lambda i: jax.random.fold_in(rng, i)
+        )(idx0 + jnp.arange(k))
+
+    def body(h, per):
+        p, r = per
+        y, _ = layer.apply(p, h, {}, train=train, rng=r, mask=mask)
+        return y, None
+
+    body = maybe_remat(body, remat if train else "none")
+    out, _ = jax.lax.scan(body, x, (stacked, rngs))
+    return out
+
+
+def run_is_ready(names, params, state) -> bool:
+    """Trace-time gate for a detected run: params exist (a run of
+    param-less layers gives the scan nothing to iterate) and no member
+    carries live state (streaming KV caches in ``rnn_time_step`` fall
+    back to the unrolled walk)."""
+    return bool(params.get(names[0])) and all(
+        not state.get(n) for n in names
+    )
+
+
+# ---------------------------------------------------------------------------
+# the sequential pure forward (MultiLayerNetwork's apply)
+# ---------------------------------------------------------------------------
+
+
+def sequential_forward(conf, layer_names, params, state, x, *,
+                       train: bool, rng, upto: Optional[int] = None,
+                       collect: bool = False, fmask=None,
+                       scan_layers: bool = False, remat: str = "none",
+                       runs: Sequence[Tuple[int, int]] = ()):
+    """Pure forward through layers [0, upto]; returns (activation,
+    preout of last executed layer, new_state, [activations]).
+
+    ``fmask``: [batch, time] features mask threaded to recurrent
+    layers (reference ``setLayerMaskArrays``). ``scan_layers``/
+    ``remat``/``runs`` are the whole-net transform knobs — with all
+    off this is exactly the classic unrolled walk."""
+    from deeplearning4j_tpu.nn.conf.preprocessors import ShapeContext
+
+    cdt = compute_dtype_of(conf)
+    if cdt != dtype_of(conf):
+        # mixed precision: master params stay in the storage dtype
+        # (grads flow back through the cast, so the updater applies
+        # them in master precision); compute runs in cdt
+        params = cast_floats(params, cdt)
+        x = cast_floats(x, cdt)
+        fmask = cast_floats(fmask, cdt) if fmask is not None else None
+    t = x.shape[2] if x.ndim == 3 else -1
+    ctx = ShapeContext(batch=x.shape[0], time=t)
+    n = len(conf.layers) if upto is None else upto + 1
+    new_state = dict(state)
+    acts: List[Any] = []
+    preout = None
+    # collect/upto need every per-layer activation — runs disabled
+    run_at = (
+        {s: e for s, e in runs}
+        if scan_layers and not collect and upto is None else {}
+    )
+    rem = remat if train else "none"
+    i = 0
+    while i < n:
+        name = layer_names[i]
+        layer = conf.layers[i]
+        if i in conf.preprocessors:
+            x = conf.preprocessors[i].preprocess(x, ctx)
+        end = run_at.get(i)
+        if end is not None and end <= n:
+            names = layer_names[i:end]
+            if run_is_ready(names, params, state):
+                x = apply_layer_run(
+                    layer, names, params, x, train=train, rng=rng,
+                    idx0=i, mask=fmask, remat=rem,
+                )
+                for rn in names:
+                    new_state[rn] = state.get(rn, {})
+                i = end
+                continue
+        lrng = jax.random.fold_in(rng, i) if rng is not None else None
+        if i == n - 1 and hasattr(layer, "pre_output") and layer.has_loss():
+            xin = layer.maybe_dropout(x, train=train, rng=lrng)
+            # same lrng as apply -> identical DropConnect mask
+            pw = layer.maybe_drop_connect(
+                params[name], train=train, rng=lrng
+            )
+            preout = layer.pre_output(pw, xin)
+
+        def apply_one(p, h, st, *, _layer=layer, _rng=lrng):
+            return _layer.apply(
+                p, h, st, train=train, rng=_rng, mask=fmask
+            )
+
+        if rem != "none" and not layer.has_loss():
+            apply_one = maybe_remat(apply_one, rem)
+        x, st = apply_one(params[name], x, state.get(name, {}))
+        new_state[name] = st
+        if collect:
+            acts.append(x)
+        i += 1
+    return x, preout, new_state, acts
+
+
+def sequential_score(conf, layer_names, params, state, x, labels,
+                     mask, rng, *, train: bool, fmask=None,
+                     scan_layers: bool = False, remat: str = "none",
+                     runs: Sequence[Tuple[int, int]] = ()):
+    """Loss score incl. L1/L2 penalty (reference
+    computeGradientAndScore adds calcL1/calcL2 to the loss). ``mask``
+    is the labels mask (falls back to ``fmask`` for 3-d labels, like
+    the reference's output-layer masking)."""
+    from deeplearning4j_tpu.nn import losses as losses_mod
+
+    out, preout, new_state, _ = sequential_forward(
+        conf, layer_names, params, state, x, train=train, rng=rng,
+        fmask=fmask, scan_layers=scan_layers, remat=remat, runs=runs,
+    )
+    last = conf.layers[-1]
+    if not last.has_loss():
+        raise ValueError(
+            "Last layer has no loss function; use an OutputLayer/LossLayer"
+        )
+    if preout is None:
+        preout = out
+    loss_mask = mask
+    if loss_mask is None and labels.ndim == 3:
+        loss_mask = fmask
+    score = losses_mod.score(
+        last.loss, labels, preout, last.activation, loss_mask, True
+    )
+    reg = 0.0
+    for lname, layer in zip(layer_names, conf.layers):
+        reg = reg + reg_penalty(layer, params[lname])
+    return score + reg, new_state
+
+
+# ---------------------------------------------------------------------------
+# dynamic loss scaling (compute_dtype="float16")
+# ---------------------------------------------------------------------------
+
+DEFAULT_LOSS_SCALE = 2.0 ** 15
+LOSS_SCALE_GROWTH_INTERVAL = 2000
+MAX_LOSS_SCALE = 2.0 ** 24
+
+
+def loss_scale_state(initial: float = DEFAULT_LOSS_SCALE) -> dict:
+    """Device-resident dynamic loss-scale state threaded through the
+    jitted step: current scale, clean steps since the last change,
+    cumulative overflow count (read lazily by telemetry — no per-step
+    host sync)."""
+    return {
+        "scale": jnp.asarray(float(initial), jnp.float32),
+        "good_steps": jnp.asarray(0, jnp.int32),
+        "overflows": jnp.asarray(0, jnp.int32),
+    }
+
+
+def _scale_tree(tree, factor):
+    return jax.tree_util.tree_map(
+        lambda g: (
+            g * factor.astype(g.dtype)
+            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact)
+            else g
+        ),
+        tree,
+    )
+
+
+# ---------------------------------------------------------------------------
+# jitted step builders (ONE implementation for both engines)
+# ---------------------------------------------------------------------------
+
+
+def grad_step(score_fn, params, state, x, labels, mask, fmask, rng,
+              scale=None):
+    """The forward+backward half every step flavor shares:
+    ``((score, new_state), grads)`` of the engine's pure score. With
+    ``scale`` (dynamic loss scaling) the loss is scaled in f32 before
+    the backward pass so small f16 gradients stay representable; the
+    caller unscales."""
+    def loss_fn(p):
+        s, new_state = score_fn(p, state, x, labels, mask, fmask, rng)
+        if scale is not None:
+            s = s.astype(jnp.float32) * scale
+        return s, new_state
+
+    return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+
+def finish_step(updater, grads, score, new_state, params, upd_state,
+                state, lrs, t, *, guarded: bool, telemetry: bool):
+    """The post-gradient half shared by the engine steps AND the
+    distributed trainer's shard_map/GSPMD steps: updater application,
+    optional telemetry grad-norm, optional in-jit divergence-guard
+    select. Returns the step output tuple
+    ``(params, upd_state, state, score[, grad_norm][, ok])``."""
+    from deeplearning4j_tpu.resilience.guard import (
+        divergence_ok,
+        grad_global_norm_sq,
+        select_updates,
+    )
+
+    new_params, new_upd = updater.update(
+        grads, upd_state, params, lrs, t
+    )
+    extras = ()
+    if telemetry:
+        extras = (jnp.sqrt(grad_global_norm_sq(grads)),)
+    if not guarded:
+        return (new_params, new_upd, new_state, score) + extras
+    ok = divergence_ok(score, grads)
+    new_params, new_upd, new_state = select_updates(
+        ok, new_params, params, new_upd, upd_state, new_state, state,
+    )
+    return (new_params, new_upd, new_state, score) + extras + (ok,)
+
+
+def build_step(score_fn, updater, *, cast=None, guarded: bool = False,
+               telemetry: bool = False,
+               loss_scale: bool = False) -> Callable:
+    """ONE jitted SGD train step for both engines.
+
+    ``score_fn(params, state, x, labels, mask, fmask, rng) ->
+    (score, new_state)`` is the engine's pure forward+loss; ``cast``
+    is its in-jit cast-on-device hook (integer inputs ride in native
+    width and cast here). Step output layout:
+    ``params, upd_state, state, score[, grad_norm][, loss_scale_state]
+    [, ok]`` — unpacked by ``apply_step_out``. With ``loss_scale``
+    the step takes the loss-scale state dict as a trailing argument,
+    skips the update in-jit on a non-finite gradient (the overflow
+    probe), and adjusts the scale — no host round trip."""
+    from deeplearning4j_tpu.resilience.guard import (
+        divergence_ok,
+        grad_global_norm_sq,
+        select_updates,
+    )
+
+    def step(params, upd_state, state, x, labels, mask, fmask, lrs, t,
+             rng, *ls):
+        if cast is not None:
+            x, labels, mask, fmask = cast(x, labels, mask, fmask)
+        scale = ls[0]["scale"] if loss_scale else None
+        (score, new_state), grads = grad_step(
+            score_fn, params, state, x, labels, mask, fmask, rng,
+            scale=scale,
+        )
+        tail = ()
+        if loss_scale:
+            inv = 1.0 / scale
+            grads = _scale_tree(grads, inv)
+            score = score * inv
+            finite = jnp.isfinite(grad_global_norm_sq(grads))
+            new_params, new_upd = updater.update(
+                grads, upd_state, params, lrs, t
+            )
+            new_params, new_upd, new_state = select_updates(
+                finite, new_params, params, new_upd, upd_state,
+                new_state, state,
+            )
+            st = ls[0]
+            good = jnp.where(finite, st["good_steps"] + 1, 0)
+            grow = good >= LOSS_SCALE_GROWTH_INTERVAL
+            new_scale = jnp.where(
+                finite,
+                jnp.where(
+                    grow,
+                    jnp.minimum(scale * 2.0, MAX_LOSS_SCALE),
+                    scale,
+                ),
+                jnp.maximum(scale * 0.5, 1.0),
+            )
+            tail = ({
+                "scale": new_scale,
+                "good_steps": jnp.where(grow, 0, good),
+                "overflows": st["overflows"]
+                + (1 - finite.astype(jnp.int32)),
+            },)
+        else:
+            new_params, new_upd = updater.update(
+                grads, upd_state, params, lrs, t
+            )
+        extras = ()
+        if telemetry:
+            extras = (jnp.sqrt(grad_global_norm_sq(grads)),)
+        if not guarded:
+            return (
+                (new_params, new_upd, new_state, score) + extras + tail
+            )
+        ok = divergence_ok(score, grads)
+        new_params, new_upd, new_state = select_updates(
+            ok, new_params, params, new_upd, upd_state,
+            new_state, state,
+        )
+        return (
+            (new_params, new_upd, new_state, score) + extras + tail
+            + (ok,)
+        )
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+def apply_step_out(model, out):
+    """Unpack one core step's output tuple (base 4 fields, plus the
+    optional telemetry grad-norm, loss-scale state, and guard ok flag)
+    into model state; returns ``(score, ok)``."""
+    model.params, model.updater_state, model.state = out[:3]
+    score = out[3]
+    i = 4
+    if getattr(model, "_telemetry_grad_norm", False):
+        model._last_grad_norm = out[i]
+        i += 1
+    if getattr(model, "_loss_scale_active", False):
+        model._loss_scale_state = out[i]
+        i += 1
+    ok = (
+        out[i] if getattr(model, "divergence_guard", None) is not None
+        else None
+    )
+    return score, ok
+
+
+def build_multi_step(score_fn, updater, *, cast,
+                     recurrent_names: Sequence[str] = (),
+                     tbptt: bool = False) -> Callable:
+    """k optimizer steps fused into ONE XLA program via lax.scan.
+
+    The reference dispatches one native-op sequence per minibatch
+    (SURVEY.md §3.1 hot loop); the per-dispatch latency is what bounds
+    small-model throughput on TPU (host->device hop per step).
+    Scanning k steps amortizes it k-fold: per-step PRNG keys and
+    Adam's t are computed on device, lr schedules stay host-side
+    (arbitrary Python) and ride in as a tiny stacked array.
+
+    Standard mode restores the recurrent carry per minibatch
+    (standard-backprop semantics). ``tbptt=True`` instead THREADS the
+    carry through the scan and takes a per-step ``resets`` flag (one
+    0/1 per step) that zeroes the carry at minibatch boundaries, so
+    MANY minibatches' TBPTT chunk stacks ride in a single dispatch
+    (the reference's host-side chunk loop, ``doTruncatedBPTT:1210``,
+    pays a dispatch per chunk)."""
+
+    def body(carry, per_step):
+        params, upd_state, state = carry
+        if tbptt:
+            x, labels, mask, fmask, lrs, t, rng, reset = per_step
+        else:
+            x, labels, mask, fmask, lrs, t, rng = per_step
+        x, labels, mask, fmask = cast(x, labels, mask, fmask)
+        if tbptt:
+            state = dict(state)
+            keep = 1.0 - reset
+            for name in recurrent_names:
+                # reset==1 at a new minibatch's first chunk; v*0 is
+                # bitwise the zeros the primed initial state holds
+                state[name] = {
+                    k2: v * keep.astype(v.dtype)
+                    for k2, v in state[name].items()
+                }
+        (score, new_state), grads = grad_step(
+            score_fn, params, state, x, labels, mask, fmask, rng
+        )
+        new_params, new_upd = updater.update(
+            grads, upd_state, params, lrs, t
+        )
+        if not tbptt:
+            # standard-backprop semantics: recurrent carry resets per
+            # minibatch — keep the carry structure constant by
+            # restoring the empty input entries
+            for name in recurrent_names:
+                new_state[name] = state[name]
+        return (new_params, new_upd, new_state), score
+
+    def multi_step(params, upd_state, state, xs, ys, masks, fmasks,
+                   lr_stack, it0, base_key, *resets):
+        k = jax.tree_util.tree_leaves(xs)[0].shape[0]
+        ts = (it0 + 1 + jnp.arange(k)).astype(jnp.float32)
+        rngs = jax.vmap(
+            lambda i: jax.random.fold_in(base_key, i)
+        )(it0 + jnp.arange(k))
+        (params, upd_state, state), scores = jax.lax.scan(
+            body, (params, upd_state, state),
+            (xs, ys, masks, fmasks, lr_stack, ts, rngs) + resets,
+        )
+        # next chunk's it0, computed on device: the caller keeps it
+        # resident so consecutive chunks transfer no host scalars
+        return params, upd_state, state, scores, it0 + k
+
+    return jax.jit(multi_step, donate_argnums=(0, 1, 2))
+
+
+def build_pretrain_step(layer, name: str, upd_def) -> Callable:
+    """Jitted single-layer pretrain update; takes the layer's input
+    tensor precomputed (the frozen lower stack runs once per batch,
+    not once per optimizer iteration — reference feedForwardToLayer
+    once per batch). Shared verbatim by both engines."""
+
+    def step(lparams, upd_state, xin, lrs, t, rng):
+        def loss_fn(p):
+            return layer.pretrain_loss(p, xin, rng) + reg_penalty(
+                layer, p
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(lparams)
+        new_p, new_upd = upd_def.update(
+            {name: grads}, upd_state, {name: lparams}, lrs, t
+        )
+        return new_p[name], new_upd, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# fit drivers (epoch loop / scan-chunk grouping / device-cached epochs)
+# ---------------------------------------------------------------------------
+
+
+def build_scan_plan(seq, sig_fn, stack_fn, scan_chunk: int):
+    """Group consecutive same-signature minibatches into fused chunks
+    (the same boundaries ``fit_epoch_scan`` produces). Returns a list
+    of ``("chunk", stacked_device_arrays, last_host_batch)`` /
+    ``("single", ds, ds)`` entries, shared by MultiLayerNetwork and
+    ComputationGraph."""
+    plan: List[Any] = []
+    buf: List[Any] = []
+    sig = None
+
+    def flush(batches):
+        if len(batches) == 1:
+            plan.append(("single", batches[0], batches[0]))
+        elif batches:
+            plan.append(("chunk", stack_fn(batches), batches[-1]))
+
+    for ds in seq:
+        s = sig_fn(ds)
+        if buf and (s != sig or len(buf) >= scan_chunk):
+            flush(buf)
+            buf = []
+        sig = s
+        buf.append(ds)
+    flush(buf)
+    return plan
+
+
+def cached_epoch_plan(model, iterator, epochs: int, arrays_of):
+    """Shared eligibility gate + HBM size accounting + plan building
+    for the device-cached multi-epoch fit path (MultiLayerNetwork and
+    ComputationGraph). ``arrays_of(ds)`` yields every array the stacked
+    chunks will hold. Returns the scan plan, or None when the caller
+    must stream (single epoch, iterator input, non-scannable config, or
+    dataset larger than ``model.device_cache_bytes``)."""
+    if (
+        epochs <= 1
+        or not isinstance(iterator, (list, tuple))
+        or len(iterator) == 0
+        or not model._can_scan_steps()
+        or model.scan_chunk <= 1
+    ):
+        return None
+    total = 0
+    for ds in iterator:
+        if not hasattr(ds, "features"):
+            return None
+        for a in arrays_of(ds):
+            if a is not None:
+                total += nbytes(a)
+    if total > model.device_cache_bytes:
+        return None
+    return build_scan_plan(
+        iterator, model._ds_scan_sig, model._stack_chunk,
+        model.scan_chunk,
+    )
+
+
+def _wants_last_features(model) -> bool:
+    fn = getattr(model, "_wants_last_features", None)
+    return bool(fn()) if fn is not None else False
+
+
+def run_scan_chunk(model, stacked) -> None:
+    """One fused k-step dispatch from pre-stacked device arrays
+    ``(x, y, labels_mask, features_mask, k)`` — the same driver for
+    both engines (the arrays are plain arrays for the sequential
+    engine, lists for the DAG engine)."""
+    xs, ys, masks, fmasks, k = stacked
+    it0 = model.iteration_count
+    lr_stack, it0_dev = scan_consts(model, k, it0)
+    if model._jit_multi_step is None:
+        model._jit_multi_step = model._build_multi_step()
+    (
+        model.params, model.updater_state, model.state, scores,
+        it0_next,
+    ) = model._jit_multi_step(
+        model.params, model.updater_state, model.state,
+        xs, ys, masks, fmasks, lr_stack, it0_dev, model._base_key,
+    )
+    note_it0(model, it0_next, it0 + k)
+    model.iteration_count += k
+    model._last_score = scores[-1]
+    if model.listeners:
+        for i in range(k):
+            model._last_score = scores[i]
+            for listener in model.listeners:
+                listener.iteration_done(model, it0 + i + 1)
+        model._last_score = scores[-1]
+
+
+def flush_scan_chunk(model, batches: List[Any]) -> None:
+    if len(batches) == 1:
+        model.fit_minibatch(batches[0])
+        return
+    if _wants_last_features(model):
+        model._last_features = batches[-1].features
+    run_scan_chunk(model, model._stack_chunk(batches))
+
+
+def fit_epoch_scan(model, it) -> int:
+    """Buffer same-shaped minibatches into chunks of
+    ``model.scan_chunk`` and run each chunk as one fused dispatch.
+    ``ChunkedDataSet`` items (pre-stacked [k, b, ...] payloads from
+    an input pipeline) feed the dispatch directly."""
+    from deeplearning4j_tpu.datasets.api import ChunkedDataSet
+
+    model._reset_recurrent_state()  # scan carries empty rnn entries
+    buf: List[Any] = []
+    sig = None
+    n = 0
+    for ds in it:
+        if isinstance(ds, ChunkedDataSet):
+            if buf:
+                flush_scan_chunk(model, buf)
+                buf, sig = [], None
+            model._run_prestacked_chunk(ds)
+            n += ds.k
+            continue
+        s = model._ds_scan_sig(ds)
+        if buf and s != sig:
+            flush_scan_chunk(model, buf)
+            buf = []
+        sig = s
+        buf.append(ds)
+        n += 1
+        if len(buf) >= model.scan_chunk:
+            flush_scan_chunk(model, buf)
+            buf = []
+    if buf:
+        flush_scan_chunk(model, buf)
+    return n
+
+
+def fit_epochs_device_cached(model, iterator, epochs: int, arrays_of,
+                             extra_plan_fn=None) -> bool:
+    """Multi-epoch fit over a materialized dataset with the batches
+    kept HBM-resident across epochs.
+
+    The reference re-reads host data every epoch and re-copies it
+    over PCIe (`MultipleEpochsIterator` + the per-op JNI hop,
+    SURVEY.md §3.1); on TPU the host->device link is the scarce
+    resource, so when the data is a fixed sequence that fits in
+    device memory we transfer each fused chunk ONCE and re-run the
+    scanned train step over the cached arrays every epoch. lr
+    schedules/iteration counts are recomputed per chunk per epoch,
+    so training semantics are identical to the streaming path.
+    Returns False (caller streams as before) when ineligible."""
+    plan = extra_plan_fn(iterator, epochs) if extra_plan_fn else None
+    if plan is None:
+        plan = cached_epoch_plan(model, iterator, epochs, arrays_of)
+    if plan is None:
+        return False
+    for epoch in range(epochs):
+        for listener in model.listeners:
+            if hasattr(listener, "on_epoch_start"):
+                listener.on_epoch_start(model)
+        model._reset_recurrent_state()
+        for kind, item, last in plan:
+            if kind == "chunk":
+                if _wants_last_features(model):
+                    model._last_features = last.features
+                run_scan_chunk(model, item)
+            elif kind == "tbptt":
+                if _wants_last_features(model):
+                    model._last_features = last.features
+                model._run_tbptt_stacked(item)
+            else:
+                model.fit_minibatch(item)
+        for listener in model.listeners:
+            if hasattr(listener, "on_epoch_end"):
+                listener.on_epoch_end(model)
+        model.epoch_count += 1
+    return True
+
+
+def fit_batches(model, iterator, epochs: int) -> None:
+    """The epoch fit loop shared by both engines: optional pretrain,
+    device-cached multi-epoch replay, scan-fused or per-step epochs
+    through an ``AsyncDispatchWindow`` (bounded in-flight dispatch,
+    guard flags collected late), epoch listener hooks, and iterator
+    reset protocol."""
+    if model.params is None:
+        model.init()
+    if model.conf.pretrain and not model._pretrain_done:
+        # reference fit():1064 — layer-wise pretrain before backprop
+        if not hasattr(iterator, "reset") and not isinstance(
+            iterator, (list, tuple)
+        ):
+            iterator = list(iterator)
+        model.pretrain(iterator)
+    if not model.conf.backprop:
+        return
+    if model._fit_epochs_device_cached(iterator, epochs):
+        return
+    from deeplearning4j_tpu.parallel.dispatch import (
+        AsyncDispatchWindow,
+    )
+
+    window = AsyncDispatchWindow(
+        model=model,
+        guard_fn=lambda: getattr(model, "divergence_guard", None),
+        max_in_flight=model.max_in_flight,
+        guard_lag=model.guard_lag,
+    )
+    try:
+        for epoch in range(epochs):
+            for listener in model.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(model)
+            it = iter(iterator)
+            if model._can_scan_steps() and model.scan_chunk > 1:
+                n_batches = fit_epoch_scan(model, it)
+            else:
+                n_batches = 0
+                model._dispatch_window = window
+                try:
+                    for ds in it:
+                        model.fit_minibatch(ds)
+                        n_batches += 1
+                finally:
+                    model._dispatch_window = None
+                window.drain()  # guard aborts surface per epoch
+            if epoch > 0 and n_batches == 0:
+                raise ValueError(
+                    "Iterator yielded no batches after the first "
+                    "epoch — a plain generator cannot be "
+                    "re-iterated; pass a list, a DataSetIterator "
+                    "with reset(), or epochs=1"
+                )
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for listener in model.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(model)
+            model.epoch_count += 1
+    except BaseException:
+        window.abandon()  # keep the original exception
+        raise
+
+
+# ---------------------------------------------------------------------------
+# transform knob plumbing (shared by both engine wrappers)
+# ---------------------------------------------------------------------------
+
+
+def init_transforms(model, conf) -> None:
+    """Seed the model's transform knobs from the (non-serialized)
+    config hints and reset the derived caches. Called from both
+    engines' constructors."""
+    model.scan_layers = bool(getattr(conf, "scan_layers", False))
+    model.remat = check_remat_policy(
+        getattr(conf, "remat", None) or "none"
+    )
+    ls = getattr(conf, "loss_scale", None)
+    model.loss_scale = (
+        DEFAULT_LOSS_SCALE if ls is True else ls
+    )
+    model._layer_runs_cache = None
+    model._loss_scale_state = None
+
+
+def set_transforms(model, scan_layers=None, remat=None,
+                   loss_scale=None) -> None:
+    """Runtime (re)configuration of the whole-net transforms on either
+    engine. ``None`` leaves a knob unchanged; changed knobs invalidate
+    every compiled program that bakes them in. Transforms never change
+    the math — trajectories are bitwise identical with them on or off
+    (tier-1-asserted) — only the compiled program's shape (scan),
+    memory plan (remat), or f16 gradient dynamic range (loss scale)."""
+    changed = False
+    if scan_layers is not None and bool(scan_layers) != model.scan_layers:
+        model.scan_layers = bool(scan_layers)
+        model._layer_runs_cache = None
+        changed = True
+    if remat is not None and check_remat_policy(remat) != model.remat:
+        model.remat = remat
+        changed = True
+    if loss_scale is not None:
+        ls = DEFAULT_LOSS_SCALE if loss_scale is True else (
+            loss_scale or None
+        )
+        if ls != model.loss_scale:
+            model.loss_scale = ls
+            model._loss_scale_state = None
+            changed = True
+    if changed:
+        model._jit_step = None
+        model._jit_multi_step = None
+        model._jit_output = None
+        model._jit_rnn_step = None
+        if hasattr(model, "_jit_tbptt_multi_step"):
+            model._jit_tbptt_multi_step = None
+
+
+def loss_scale_active(model) -> bool:
+    """Dynamic loss scaling engages only for float16 compute (bf16
+    shares f32's exponent range and needs none of it — unchanged)."""
+    return (
+        model.loss_scale is not None
+        and compute_dtype_of(model.conf) == jnp.dtype(jnp.float16)
+    )
+
+
+def ensure_loss_scale_state(model):
+    if model._loss_scale_state is None:
+        model._loss_scale_state = loss_scale_state(model.loss_scale)
+    return model._loss_scale_state
+
+
+def transform_kind_suffix(model) -> str:
+    """AOT artifact-kind suffix for the transform knobs that change
+    the compiled program (loss-scale changes the step's arity, scan/
+    remat its HLO): part of the artifact identity so a stale
+    executable is refused, not mis-dispatched."""
+    parts = []
+    if model.scan_layers:
+        parts.append("scan")
+    if model.remat != "none":
+        parts.append(f"remat:{model.remat}")
+    if getattr(model, "_loss_scale_active", False):
+        parts.append("lossscale")
+    return ("+" + "+".join(parts)) if parts else ""

@@ -7,6 +7,15 @@ TPU-first: the topo walk happens at *trace* time — the whole DAG
 one XLA program per input shape, and the reverse-order backward pass
 is ``jax.grad`` of that program. Multi-output losses sum (reference
 sums output-layer scores).
+
+Like ``MultiLayerNetwork``, this engine is a wrapper over the unified
+functional core (``nn/core.py``): the jitted step builders, scan-fused
+multi-step, pretrain step, fit drivers, and whole-net transforms
+(scan-over-layers on linear vertex chains, activation remat, dynamic
+loss scaling) are implemented there once — only the DAG walk itself is
+engine-specific (``scripts/lint_parity.py`` enforces the split). The
+core also brings the divergence guard and step telemetry to this
+engine, which previously only the sequential engine wired in.
 """
 
 from __future__ import annotations
@@ -17,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.nn import core
 from deeplearning4j_tpu.nn.conf.graph_conf import (
     ComputationGraphConfiguration,
     DuplicateToTimeSeriesVertex,
@@ -71,20 +81,27 @@ class ComputationGraph:
         self._stream_steps = 0  # timesteps consumed vs finite caches
         self._jit_pretrain_steps: Dict[str, Any] = {}
         self._jit_pretrain_inputs: Dict[str, Any] = {}
-        # device-resident scan constants (see multilayer._scan_consts)
+        # device-resident scan constants (see core.scan_consts)
         self._scan_const_cache: Dict[Any, Any] = {}
         self._it0_dev = None
         self._it0_shadow = -1
         self._pretrain_done = False
         self._base_key = jax.random.PRNGKey(conf.seed)
-        # async dispatch knobs (the _fit_batches per-step loop runs
-        # through an AsyncDispatchWindow — the DAG engine's step has
-        # no guard flag, so the window only bounds in-flight steps
-        # and records the step-gap histogram)
+        # resilience.DivergenceGuard — wired through the core step
+        # builder exactly like MultiLayerNetwork (in-jit suppression,
+        # host-side skip/rollback policy)
+        self.divergence_guard = None
+        # observability step telemetry (in-jit grad global norm)
+        self._telemetry_grad_norm = False
+        self._last_grad_norm = None
+        # async dispatch knobs (core.fit_batches runs the per-step
+        # loop through an AsyncDispatchWindow)
         self.max_in_flight = 2
         self.guard_lag = None
         self._dispatch_window = None
         self._last_batch_rows = None  # host int; examples/sec signal
+        # whole-net transform knobs — see core.set_transforms
+        core.init_transforms(self, conf)
 
     @property
     def score_value(self) -> float:
@@ -135,26 +152,72 @@ class ComputationGraph:
         return self
 
     # ------------------------------------------------------------------
+    # whole-net transforms (implemented once in nn/core.py)
+    # ------------------------------------------------------------------
 
-    def _forward_values(self, params, state, inputs: Sequence, *,
-                        train: bool, rng, fmasks=None):
-        """Walk the topo order; returns ({vertex: value}, preouts,
-        new_state). ``fmasks``: per-graph-input [b, t] masks."""
-        from deeplearning4j_tpu.nn.multilayer import (
-            _cast_floats,
-            _compute_dtype_of,
+    def set_transforms(self, scan_layers=None, remat=None,
+                       loss_scale=None) -> "ComputationGraph":
+        """(Re)configure the whole-net transforms — same contract as
+        ``MultiLayerNetwork.set_transforms``. ``scan_layers`` here
+        scans LINEAR CHAINS of identical layer vertices (consecutive
+        topo positions, single consumer each)."""
+        core.set_transforms(self, scan_layers, remat, loss_scale)
+        return self
+
+    @property
+    def _loss_scale_active(self) -> bool:
+        return core.loss_scale_active(self)
+
+    def _active_vertex_chains(self) -> tuple:
+        if self._layer_runs_cache is None:
+            self._layer_runs_cache = tuple(core.detect_vertex_chains(
+                self.conf, self.topo
+            ))
+        return self._layer_runs_cache
+
+    def scan_layer_run_count(self) -> int:
+        """Active scanned vertex chains (telemetry signal)."""
+        return (
+            len(self._active_vertex_chains()) if self.scan_layers else 0
         )
 
+    def set_divergence_guard(self, guard) -> None:
+        """(Un)install a resilience.DivergenceGuard on the train step
+        (in-jit NaN/Inf suppression + host-side skip/rollback) — the
+        core step builder gives the DAG engine the same machinery as
+        the sequential engine."""
+        self.divergence_guard = guard
+        self._jit_step = None
+
+    def enable_step_telemetry(self, enabled: bool = True) -> None:
+        """(Un)install step telemetry: the jitted step additionally
+        returns the gradient global L2 norm (one fused scalar)."""
+        if enabled != self._telemetry_grad_norm:
+            self._telemetry_grad_norm = enabled
+            self._jit_step = None
+
+    # ------------------------------------------------------------------
+
+    def _forward_values(self, params, state, inputs: Sequence, *,
+                        train: bool, rng, fmasks=None,
+                        use_scan: bool = False):
+        """Walk the topo order; returns ({vertex: value}, preouts,
+        new_state). ``fmasks``: per-graph-input [b, t] masks.
+        ``use_scan=True`` (score/output paths, which only read the
+        output vertices) lets detected linear chains of identical
+        layer vertices run under one ``lax.scan`` — their inner
+        values are then not materialized, so callers that need every
+        vertex's activation (``feed_forward``) keep it off."""
         conf = self.conf
-        cdt = _compute_dtype_of(conf)
+        cdt = core.compute_dtype_of(conf)
         if cdt != self._dtype():
             # mixed precision (same contract as MultiLayerNetwork):
             # master params keep the storage dtype, compute runs in cdt
-            params = _cast_floats(params, cdt)
-            inputs = [_cast_floats(x, cdt) for x in inputs]
+            params = core.cast_floats(params, cdt)
+            inputs = [core.cast_floats(x, cdt) for x in inputs]
             if fmasks is not None:
                 fmasks = [
-                    None if m is None else _cast_floats(m, cdt)
+                    None if m is None else core.cast_floats(m, cdt)
                     for m in fmasks
                 ]
         # engine-global shape context for preprocessors: batch/time of
@@ -181,8 +244,36 @@ class ComputationGraph:
         # (reference feedForwardMaskArrays). Time-collapsing vertices
         # (LastTimeStep) clear the mask downstream.
         vmask: Dict[str, Any] = dict(masks)
-        for i, name in enumerate(self.topo):
+        chain_at = (
+            {s: e for s, e in self._active_vertex_chains()}
+            if (use_scan and self.scan_layers) else {}
+        )
+        rem = self.remat if train else "none"
+        i, n_topo = 0, len(self.topo)
+        while i < n_topo:
+            name = self.topo[i]
             v = conf.vertices[name]
+            end = chain_at.get(i)
+            if end is not None:
+                names = self.topo[i:end]
+                if core.run_is_ready(names, params, state):
+                    # scan-over-layers on a linear vertex chain: the
+                    # per-vertex rng indices are the topo positions,
+                    # bitwise-matching the unrolled walk
+                    src = conf.vertex_inputs[name][0]
+                    x = values[src]
+                    mask = vmask.get(src)
+                    out = core.apply_layer_run(
+                        v.layer_conf, names, params, x, train=train,
+                        rng=rng, idx0=i, mask=mask, remat=rem,
+                    )
+                    last = names[-1]
+                    values[last] = out
+                    vmask[last] = mask
+                    for cn in names:
+                        new_state[cn] = state.get(cn, {})
+                    i = end
+                    continue
             vin = [values[s] for s in conf.vertex_inputs[name]]
             in_masks = [
                 vmask.get(s) for s in conf.vertex_inputs[name]
@@ -204,8 +295,17 @@ class ComputationGraph:
                                   rng=lrng, mask=m)
                 vmask[name] = None  # time axis collapsed
             elif isinstance(v, LayerVertex):
-                out, st = v.apply(vparams, vin, vstate, train=train,
-                                  rng=lrng, mask=mask, ctx=gctx)
+                def apply_vertex(p, xs, st, *, _v=v, _rng=lrng,
+                                 _mask=mask):
+                    return _v.apply(p, xs, st, train=train, rng=_rng,
+                                    mask=_mask, ctx=gctx)
+
+                if rem != "none" and not v.layer_conf.has_loss():
+                    # activation remat per vertex (jax.checkpoint):
+                    # the backward pass recomputes this vertex's
+                    # forward instead of keeping its activations
+                    apply_vertex = core.maybe_remat(apply_vertex, rem)
+                out, st = apply_vertex(vparams, vin, vstate)
                 vmask[name] = mask
             else:
                 out, st = v.apply(vparams, vin, vstate, train=train,
@@ -225,6 +325,7 @@ class ComputationGraph:
                     )
                     preouts[name] = layer.pre_output(pw, x)
             values[name] = out
+            i += 1
         return values, preouts, new_state
 
     def _score_pure(self, params, state, inputs, labels, lmasks, rng, *,
@@ -232,7 +333,8 @@ class ComputationGraph:
         from deeplearning4j_tpu.nn import losses as losses_mod
 
         values, preouts, new_state = self._forward_values(
-            params, state, inputs, train=train, rng=rng, fmasks=fmasks
+            params, state, inputs, train=train, rng=rng, fmasks=fmasks,
+            use_scan=True,
         )
         score = 0.0
         for i, out_name in enumerate(self.conf.outputs):
@@ -250,90 +352,50 @@ class ComputationGraph:
         reg = 0.0
         for n in self.layer_vertex_names:
             layer = self.conf.vertices[n].layer_conf
-            if layer.l1 > 0.0 or layer.l2 > 0.0:
-                for pn in layer.regularizable_params():
-                    if pn in params[n]:
-                        w = params[n][pn]
-                        if layer.l2 > 0.0:
-                            reg = reg + 0.5 * layer.l2 * jnp.sum(w * w)
-                        if layer.l1 > 0.0:
-                            reg = reg + layer.l1 * jnp.sum(jnp.abs(w))
+            reg = reg + core.reg_penalty(layer, params[n])
         return score + reg, new_state
 
     # ------------------------------------------------------------------
+    # jitted train step (built by the core)
+    # ------------------------------------------------------------------
+
+    def _score_fn(self):
+        """The engine's contribution to the core step builders (the
+        labels-mask slot carries this engine's per-output lmasks
+        list, the features-mask slot its per-input fmasks list)."""
+        def score_fn(p, state, inputs, labels, lmasks, fmasks, rng):
+            return self._score_pure(
+                p, state, inputs, labels, lmasks, rng, train=True,
+                fmasks=fmasks,
+            )
+        return score_fn
 
     def _build_step(self):
-        updater = self.updater_def
-
-        def step(params, upd_state, state, inputs, labels, lmasks, fmasks,
-                 lrs, t, rng):
-            def loss_fn(p):
-                s, new_state = self._score_pure(
-                    p, state, inputs, labels, lmasks, rng, train=True,
-                    fmasks=fmasks,
-                )
-                return s, new_state
-
-            (score, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
-            new_params, new_upd = updater.update(
-                grads, upd_state, params, lrs, t
-            )
-            return new_params, new_upd, new_state, score
-
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return core.build_step(
+            self._score_fn(), self.updater_def,
+            guarded=self.divergence_guard is not None,
+            telemetry=self._telemetry_grad_norm,
+            loss_scale=self._loss_scale_active,
+        )
 
     def _build_multi_step(self):
-        """k optimizer steps fused into one XLA dispatch via lax.scan
-        (same design as ``MultiLayerNetwork._build_multi_step`` — the
-        per-step host->device transfers of lr/t/rng are what bound
-        small-step throughput)."""
-        updater = self.updater_def
-
         multi_dtype = self._dtype()
 
-        def body(carry, per_step):
-            params, upd_state, state = carry
-            inputs, labels, lmasks, fmasks, lrs, t, rng = per_step
-            cast = lambda v: (  # noqa: E731 — cast-on-device contract
+        def cast(x, labels, mask, fmask):
+            c = lambda v: (  # noqa: E731 — cast-on-device contract
                 None if v is None
                 else [None if a is None else a.astype(multi_dtype)
                       for a in v]
             )
-            inputs, labels = cast(inputs), cast(labels)
-            lmasks, fmasks = cast(lmasks), cast(fmasks)
+            return c(x), c(labels), c(mask), c(fmask)
 
-            def loss_fn(p):
-                s, new_state = self._score_pure(
-                    p, state, inputs, labels, lmasks, rng, train=True,
-                    fmasks=fmasks,
-                )
-                return s, new_state
-
-            (score, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
-            new_params, new_upd = updater.update(
-                grads, upd_state, params, lrs, t
-            )
-            return (new_params, new_upd, new_state), score
-
-        def multi_step(params, upd_state, state, xs, ys, lmasks, fmasks,
-                       lr_stack, it0, base_key):
-            k = xs[0].shape[0]
-            ts = (it0 + 1 + jnp.arange(k)).astype(jnp.float32)
-            rngs = jax.vmap(
-                lambda i: jax.random.fold_in(base_key, i)
-            )(it0 + jnp.arange(k))
-            (params, upd_state, state), scores = jax.lax.scan(
-                body, (params, upd_state, state),
-                (xs, ys, lmasks, fmasks, lr_stack, ts, rngs),
-            )
-            # next chunk's it0 stays device-resident (see _scan_consts)
-            return params, upd_state, state, scores, it0 + k
-
-        return jax.jit(multi_step, donate_argnums=(0, 1, 2))
+        return core.build_multi_step(
+            self._score_fn(), self.updater_def, cast=cast,
+            recurrent_names=[
+                n for n in self.layer_vertex_names
+                if self.conf.vertices[n].layer_conf.is_recurrent()
+            ],
+        )
 
     def _can_scan_steps(self) -> bool:
         return (
@@ -343,6 +405,8 @@ class ComputationGraph:
                 self.conf, "optimization_algo",
                 "STOCHASTIC_GRADIENT_DESCENT",
             ) == "STOCHASTIC_GRADIENT_DESCENT"
+            and self.divergence_guard is None
+            and not self._loss_scale_active
             and not any(
                 self.conf.vertices[n].layer_conf.is_recurrent()
                 for n in self.layer_vertex_names
@@ -356,7 +420,7 @@ class ComputationGraph:
     def _ds_scan_sig(self, ds) -> tuple:
         def sh(v):
             # np.shape, NOT np.asarray(a).shape — asarray would pull
-            # device arrays to host per batch (see multilayer.py)
+            # device arrays to host per batch (see core.py)
             return tuple(
                 None if a is None else tuple(np.shape(a))
                 for a in v
@@ -373,42 +437,12 @@ class ComputationGraph:
                           or getattr(ds, "labels_mask", None))
         return features, labels, fmasks or None, lmasks or None
 
-    def _fit_epoch_scan(self, it) -> int:
-        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
-
-        buf: list = []
-        sig = None
-        n = 0
-        for ds in it:
-            if isinstance(ds, ChunkedDataSet):
-                if buf:
-                    self._flush_scan_chunk(buf)
-                    buf, sig = [], None
-                self._run_prestacked_chunk(ds)
-                n += ds.k
-                continue
-            s = self._ds_scan_sig(ds)
-            if buf and s != sig:
-                self._flush_scan_chunk(buf)
-                buf = []
-            sig = s
-            buf.append(ds)
-            n += 1
-            if len(buf) >= self.scan_chunk:
-                self._flush_scan_chunk(buf)
-                buf = []
-        if buf:
-            self._flush_scan_chunk(buf)
-        return n
-
     def _stack_chunk(self, batches: list):
-        """Stack k same-shaped minibatches into device-resident arrays
-        (integer inputs keep native width; cast on device).
-        Already-device arrays stack ON DEVICE — pulling them back to
-        host first would round-trip the whole chunk over the
-        host<->device link (per-chunk seconds on a tunneled TPU)."""
-        from deeplearning4j_tpu.nn.multilayer import _stack_on_device
-
+        """Stack k same-shaped minibatches into device-resident lists
+        ``(x, y, labels_masks, features_masks, k)`` — the uniform
+        stacked-chunk layout core.run_scan_chunk drives (integer
+        inputs keep native width; already-device arrays stack ON
+        DEVICE — no host round trip)."""
         dtype = self._dtype()
         rows = [self._ds_arrays(b) for b in batches]
 
@@ -418,72 +452,38 @@ class ComputationGraph:
                 return None
             return [
                 None if first[j] is None
-                else _stack_on_device([r[idx][j] for r in rows], dtype)
+                else core.stack_on_device(
+                    [r[idx][j] for r in rows], dtype
+                )
                 for j in range(len(first))
             ]
 
         return (
-            stack_lists(0), stack_lists(1), stack_lists(2),
-            stack_lists(3), len(batches),
+            stack_lists(0), stack_lists(1), stack_lists(3),
+            stack_lists(2), len(batches),
         )
-
-    def _flush_scan_chunk(self, batches: list) -> None:
-        if len(batches) == 1:
-            self.fit_minibatch(batches[0])
-            return
-        self._run_scan_chunk(self._stack_chunk(batches))
 
     def _run_prestacked_chunk(self, ds) -> None:
         """One fused dispatch from a single-input ChunkedDataSet's
-        [k, b, ...] arrays (same dtype contract as _stack_on_device)."""
-        from deeplearning4j_tpu.nn.multilayer import _cast_stacked
-
+        [k, b, ...] arrays (same dtype contract as stack_on_device)."""
         dtype = self._dtype()
 
         def prep(a):
             if a is None:
                 return None
             a = a if isinstance(a, jax.Array) else jnp.asarray(a)
-            return _cast_stacked(a, dtype)
+            return core.cast_stacked(a, dtype)
 
         if ds.k == 1:
             self.fit_minibatch(ds)  # fit_minibatch unstacks
             return
-        self._run_scan_chunk((
+        core.run_scan_chunk(self, (
             [prep(ds.features)], [prep(ds.labels)],
+            None if ds.labels_mask is None else [prep(ds.labels_mask)],
             None if ds.features_mask is None
             else [prep(ds.features_mask)],
-            None if ds.labels_mask is None else [prep(ds.labels_mask)],
             ds.k,
         ))
-
-    def _run_scan_chunk(self, stacked) -> None:
-        from deeplearning4j_tpu.nn.multilayer import (
-            _note_it0,
-            _scan_consts,
-        )
-
-        xs, ys, fmasks, lmasks, k = stacked
-        it0 = self.iteration_count
-        lr_stack, it0_dev = _scan_consts(self, k, it0)
-        if self._jit_multi_step is None:
-            self._jit_multi_step = self._build_multi_step()
-        (
-            self.params, self.updater_state, self.state, scores,
-            it0_next,
-        ) = self._jit_multi_step(
-            self.params, self.updater_state, self.state,
-            xs, ys, lmasks, fmasks, lr_stack, it0_dev, self._base_key,
-        )
-        _note_it0(self, it0_next, it0 + k)
-        self.iteration_count += k
-        self._last_score = scores[-1]
-        if self.listeners:
-            for i in range(k):
-                self._last_score = scores[i]
-                for listener in self.listeners:
-                    listener.iteration_done(self, it0 + i + 1)
-            self._last_score = scores[-1]
 
     # ------------------------------------------------------------------
 
@@ -496,35 +496,21 @@ class ComputationGraph:
 
             mds = MultiDataSet(features=_as_list(data),
                                labels=_as_list(labels))
-            self._fit_batches([mds], epochs)
+            core.fit_batches(self, [mds], epochs)
             return
         if hasattr(data, "features"):
-            self._fit_batches([data], epochs)
+            core.fit_batches(self, [data], epochs)
             return
-        self._fit_batches(data, epochs)
+        core.fit_batches(self, data, epochs)
 
     def _fit_epochs_device_cached(self, iterator, epochs: int) -> bool:
-        """Multi-epoch fit with HBM-resident batches (same design and
-        conditions as ``MultiLayerNetwork._fit_epochs_device_cached``:
-        transfer each fused chunk once, re-run the scanned step every
-        epoch)."""
-        from deeplearning4j_tpu.nn.multilayer import _cached_epoch_plan
-
         def arrays_of(ds):
             for group in self._ds_arrays(ds):
                 yield from group or []
 
-        plan = _cached_epoch_plan(self, iterator, epochs, arrays_of)
-        if plan is None:
-            return False
-        for epoch in range(epochs):
-            for kind, item, _last in plan:
-                if kind == "chunk":
-                    self._run_scan_chunk(item)
-                else:
-                    self.fit_minibatch(item)
-            self.epoch_count += 1
-        return True
+        return core.fit_epochs_device_cached(
+            self, iterator, epochs, arrays_of
+        )
 
     def pretrain(self, data, epochs: int = 1) -> None:
         """Greedy layer-wise unsupervised pretraining of every
@@ -532,9 +518,6 @@ class ComputationGraph:
         order, each on the activations the frozen graph feeds it
         (reference ``ComputationGraph.pretrain``,
         ``ComputationGraph.java:509``)."""
-        from deeplearning4j_tpu.nn.multilayer import _reg_penalty
-        from deeplearning4j_tpu.nn.updaters import MultiLayerUpdaterDef
-
         if self.params is None:
             self.init()
         if hasattr(data, "features"):
@@ -554,21 +537,6 @@ class ComputationGraph:
             upd_def = MultiLayerUpdaterDef({n: layer.updater_settings()})
             upd_state = upd_def.init({n: self.params[n]})
             if n not in self._jit_pretrain_steps:
-                def make_step(n=n, layer=layer, upd_def=upd_def):
-                    def step(lparams, upd_state, xin, lrs, t, rng):
-                        def loss_fn(p):
-                            return layer.pretrain_loss(
-                                p, xin, rng
-                            ) + _reg_penalty(layer, p)
-
-                        loss, grads = jax.value_and_grad(loss_fn)(lparams)
-                        new_p, new_upd = upd_def.update(
-                            {n: grads}, upd_state, {n: lparams}, lrs, t
-                        )
-                        return new_p[n], new_upd, loss
-
-                    return jax.jit(step, donate_argnums=(0, 1))
-
                 def make_input(n=n, v=v):
                     from deeplearning4j_tpu.nn.conf.preprocessors import (
                         ShapeContext,
@@ -588,7 +556,9 @@ class ComputationGraph:
 
                     return jax.jit(input_fn)
 
-                self._jit_pretrain_steps[n] = make_step()
+                self._jit_pretrain_steps[n] = core.build_pretrain_step(
+                    layer, n, upd_def
+                )
                 self._jit_pretrain_inputs[n] = make_input()
             step = self._jit_pretrain_steps[n]
             jit_input = self._jit_pretrain_inputs[n]
@@ -599,8 +569,6 @@ class ComputationGraph:
             # by device_cache_bytes like every other caching path
             xin_cache = None
             if isinstance(data, (list, tuple)):
-                from deeplearning4j_tpu.nn.multilayer import _nbytes
-
                 xin_cache = []
                 cached_bytes = 0
                 for ds in data:
@@ -608,7 +576,7 @@ class ComputationGraph:
                         jnp.asarray(f, dtype)
                         for f in _as_list(ds.features)
                     ])
-                    cached_bytes += _nbytes(xin)
+                    cached_bytes += core.nbytes(xin)
                     if cached_bytes > self.device_cache_bytes:
                         xin_cache = None  # too big: recompute per epoch
                         break
@@ -647,59 +615,10 @@ class ComputationGraph:
                     data.reset()
         self._pretrain_done = True
 
-    def _fit_batches(self, iterator, epochs: int) -> None:
-        if self.params is None:
-            self.init()
-        if self.conf.pretrain and not self._pretrain_done:
-            if not hasattr(iterator, "reset") and not isinstance(
-                iterator, (list, tuple)
-            ):
-                iterator = list(iterator)
-            self.pretrain(iterator)
-        if not self.conf.backprop:
-            return
-        if self._fit_epochs_device_cached(iterator, epochs):
-            return
-        from deeplearning4j_tpu.parallel.dispatch import (
-            AsyncDispatchWindow,
-        )
-
-        window = AsyncDispatchWindow(
-            model=self, max_in_flight=self.max_in_flight,
-            guard_lag=self.guard_lag,
-        )
-        try:
-            for epoch in range(epochs):
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_start"):
-                        listener.on_epoch_start(self)
-                if self._can_scan_steps() and self.scan_chunk > 1:
-                    n = self._fit_epoch_scan(iter(iterator))
-                else:
-                    n = 0
-                    self._dispatch_window = window
-                    try:
-                        for ds in iter(iterator):
-                            self.fit_minibatch(ds)
-                            n += 1
-                    finally:
-                        self._dispatch_window = None
-                    window.drain()
-                if epoch > 0 and n == 0:
-                    raise ValueError(
-                        "Iterator yielded no batches after the first "
-                        "epoch — pass a list or an iterator with "
-                        "reset()"
-                    )
-                if hasattr(iterator, "reset"):
-                    iterator.reset()
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_end"):
-                        listener.on_epoch_end(self)
-                self.epoch_count += 1
-        except BaseException:
-            window.abandon()
-            raise
+    def _step_extra_args(self) -> tuple:
+        if self._loss_scale_active:
+            return (core.ensure_loss_scale_state(self),)
+        return ()
 
     def fit_minibatch(self, ds) -> float:
         from deeplearning4j_tpu.datasets.api import ChunkedDataSet
@@ -752,21 +671,30 @@ class ComputationGraph:
         self._last_batch_rows = int(inputs[0].shape[0])
         score = None
         for _ in range(self.conf.iterations):
+            if self._jit_step is None:
+                # a listener may flip telemetry/guard mid-fit
+                self._jit_step = self._build_step()
             lrs = self.updater_def.scheduled_lrs(self.iteration_count)
             t = jnp.asarray(self.iteration_count + 1, jnp.float32)
             rng = jax.random.fold_in(self._base_key, self.iteration_count)
-            (
-                self.params, self.updater_state, self.state, score,
-            ) = self._jit_step(
+            out = self._jit_step(
                 self.params, self.updater_state, self.state,
                 inputs, labels, lmasks, fmasks,
                 {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
-                t, rng,
+                t, rng, *self._step_extra_args(),
             )
+            guard = self.divergence_guard
+            score, ok = core.apply_step_out(self, out)
             self.iteration_count += 1
             self._last_score = score  # device array; sync deferred
-            if self._dispatch_window is not None:
-                self._dispatch_window.push(score)
+            window = self._dispatch_window
+            if window is not None:
+                window.push(score, ok)
+            elif guard is not None:
+                if bool(ok):  # device sync — the cost of supervision
+                    guard.good_step()
+                else:
+                    guard.bad_step(self)
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count)
             self._reset_recurrent_state()
@@ -776,9 +704,8 @@ class ComputationGraph:
         """Truncated BPTT for the DAG engine: slice every time-bearing
         array into ``tbptt_fwd_length`` chunks and carry recurrent
         state between chunks via the layer-state pytree (reference
-        ``ComputationGraph.doTruncatedBPTT``; MLN analog
-        ``MultiLayerNetwork.doTruncatedBPTT:1210``). Non-time inputs
-        ride along unchanged each chunk."""
+        ``ComputationGraph.doTruncatedBPTT``). Non-time inputs ride
+        along unchanged each chunk."""
         fwd = self.conf.tbptt_fwd_length
         t_lens = {x.shape[2] for x in inputs if x.ndim == 3}
         for group in (labels, lmasks, fmasks):
@@ -825,18 +752,23 @@ class ComputationGraph:
             rng = jax.random.fold_in(
                 self._base_key, self.iteration_count
             )
-            (
-                self.params, self.updater_state, self.state, score,
-            ) = self._jit_step(
+            out = self._jit_step(
                 self.params, self.updater_state, self.state,
                 cut3(inputs, start, end), cut3(labels, start, end),
                 cut_mask(lmasks, start, end),
                 cut_mask(fmasks, start, end),
                 {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
-                t, rng,
+                t, rng, *self._step_extra_args(),
             )
+            guard = self.divergence_guard
+            score, ok = core.apply_step_out(self, out)
             self.iteration_count += 1
             self._last_score = score
+            if guard is not None:
+                if bool(ok):
+                    guard.good_step()
+                else:
+                    guard.bad_step(self)
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count)
         self._reset_recurrent_state()
@@ -857,7 +789,7 @@ class ComputationGraph:
         def out_fn(params, state, inputs, fmasks):
             values, _, _ = self._forward_values(
                 params, state, inputs, train=False, rng=None,
-                fmasks=fmasks,
+                fmasks=fmasks, use_scan=True,
             )
             return [values[n] for n in self.conf.outputs]
         return out_fn
@@ -898,12 +830,16 @@ class ComputationGraph:
             shapes = (shapes,)
         return tuple(tuple(int(d) for d in s) for s in shapes)
 
-    def aot_fingerprint(self, shapes, kind: str = "output") -> str:
+    def _output_kind(self) -> str:
+        return "output" + ("+scan" if self.scan_layers else "")
+
+    def aot_fingerprint(self, shapes, kind: Optional[str] = None) -> str:
         from deeplearning4j_tpu.compile.aot import artifact_fingerprint
 
         return artifact_fingerprint(
             self.conf.to_dict(), self._aot_shape_key(shapes),
-            str(self._dtype()), kind,
+            str(self._dtype()),
+            kind if kind is not None else self._output_kind(),
         )
 
     def aot_export_output(self, shapes, registry=None) -> bytes:
@@ -922,7 +858,7 @@ class ComputationGraph:
         return export_artifact(
             fn, (self.params, self.state, specs),
             fingerprint=self.aot_fingerprint(key),
-            shape=key, kind="output",
+            shape=key, kind=self._output_kind(),
             name="output-" + "+".join(
                 "x".join(str(d) for d in s) for s in key
             ),
@@ -953,6 +889,17 @@ class ComputationGraph:
     def aot_output_shapes(self) -> List[tuple]:
         return list(self._aot_outputs)
 
+    def _step_kind(self) -> str:
+        """AOT kind string for the train step: guard/telemetry flags
+        and whole-net transforms are part of the artifact identity
+        (same scheme as MultiLayerNetwork)."""
+        return (
+            "step"
+            + ("+guard" if self.divergence_guard is not None else "")
+            + ("+telemetry" if self._telemetry_grad_norm else "")
+            + core.transform_kind_suffix(self)
+        )
+
     def aot_export_step(self, ds, registry=None) -> bytes:
         """Serialize the compiled train step specialized to ``ds``'s
         input/label shapes (no masks)."""
@@ -975,9 +922,12 @@ class ComputationGraph:
         return export_artifact(
             self._build_step(),
             (self.params, self.updater_state, self.state, inputs,
-             labels, None, None, lrs, t, rng),
-            fingerprint=self.aot_fingerprint(x_key, kind="step"),
-            shape=x_key, kind="step",
+             labels, None, None, lrs, t, rng)
+            + self._step_extra_args(),
+            fingerprint=self.aot_fingerprint(
+                x_key, kind=self._step_kind()
+            ),
+            shape=x_key, kind=self._step_kind(),
             name="step-" + "+".join(
                 "x".join(str(d) for d in s) for s in x_key
             ),
@@ -1004,7 +954,7 @@ class ComputationGraph:
         fn = load_artifact(
             artifact,
             expected_fingerprint=self.aot_fingerprint(
-                x_key, kind="step"
+                x_key, kind=self._step_kind()
             ),
             registry=registry,
         )
@@ -1058,7 +1008,9 @@ class ComputationGraph:
 
     def feed_forward(self, *inputs, train: bool = False) -> Dict[str, Any]:
         """Activations of EVERY vertex by name (reference
-        ``ComputationGraph.feedForward`` returns the activation map)."""
+        ``ComputationGraph.feedForward`` returns the activation map) —
+        scan-over-layers stays off here so inner chain members'
+        values are materialized."""
         if self.params is None:
             self.init()
         dtype = self._dtype()
@@ -1099,16 +1051,11 @@ class ComputationGraph:
         t_new = max(
             (int(x.shape[2]) for x in arr if x.ndim == 3), default=1
         )
-        from deeplearning4j_tpu.nn.multilayer import (
-            _extract_stream_state,
-            _stream_guard_and_prime,
-        )
-
         named = [
             (n, self.conf.vertices[n].layer_conf)
             for n in self.layer_vertex_names
         ]
-        _stream_guard_and_prime(
+        core.stream_guard_and_prime(
             named, self._rnn_state, self._stream_steps, t_new,
             int(arr[0].shape[0]) if arr else 1, dtype,
         )
@@ -1123,7 +1070,7 @@ class ComputationGraph:
                 return [values[n] for n in self.conf.outputs], new_state
             self._jit_rnn_step = jax.jit(rnn_step)
         outs, new_state = self._jit_rnn_step(self.params, merged, arr)
-        _extract_stream_state(named, new_state, self._rnn_state)
+        core.extract_stream_state(named, new_state, self._rnn_state)
         self._stream_steps += t_new
         return [o[:, :, 0] if squeeze and o.ndim == 3 else o
                 for o in outs]

@@ -265,6 +265,20 @@ class LayerSpec:
             out[k] = jnp.where(m, w / keep, 0.0)
         return out
 
+    def supports_layer_scan(self) -> bool:
+        """True when this layer may join a scan-over-layers run
+        (``nn/core.py``): its per-step program must be self-contained —
+        no recurrent/TBPTT carry, no loss head, no pretrain phase, no
+        cross-example batch statistics. Layers with non-empty
+        ``init_state`` are additionally excluded at detection time
+        (their state would have to thread through the scan carry)."""
+        return not (
+            self.is_recurrent()
+            or self.has_loss()
+            or self.is_pretrainable()
+            or self.uses_batch_statistics()
+        )
+
     def updater_settings(self) -> UpdaterSettings:
         return UpdaterSettings(
             updater=self.updater,

@@ -16,254 +16,46 @@ TPU-first redesign of the reference's imperative engine:
   (``params_flat``/``set_params_flat``).
 - Backprop (``calcBackpropGradients:1134``) does not exist as code:
   ``jax.grad`` differentiates the same forward used for inference.
-- TBPTT (``doTruncatedBPTT:1210``) arrives with the recurrent stack:
-  the time axis is chunked host-side and RNN carry state is threaded
-  through the jitted step.
+
+This class is a thin wrapper around the unified functional core
+(``nn/core.py``): the pure forward/score, the jitted step builders,
+the scan-fused multi-step, the fit drivers, and the whole-net
+transforms (scan-over-layers, activation remat, dynamic loss scaling)
+are all implemented there ONCE and shared with ``ComputationGraph``
+(enforced by ``scripts/lint_parity.py``).
 """
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.nn import core
 from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.preprocessors import ShapeContext
 from deeplearning4j_tpu.nn.updaters import MultiLayerUpdaterDef
 
-
-def _dtype_of(conf: MultiLayerConfiguration):
-    return jnp.dtype(conf.dtype)
-
-
-def _to_device(a, dtype):
-    """Convert a host array for the jitted step. Integer inputs (e.g.
-    uint8 one-hot/pixel data) transfer in their native width and are
-    cast to the compute dtype ON DEVICE by the step — 4x less
-    host->device traffic than converting to float32 first. Already-
-    device-resident arrays pass straight through (no host round
-    trip)."""
-    if isinstance(a, jax.Array):
-        return a.astype(dtype) if a.dtype != dtype else a
-    a = np.asarray(a)
-    if a.dtype.kind in ("u", "i") and a.dtype.itemsize <= 2:
-        return jnp.asarray(a)
-    return jnp.asarray(a, dtype)
-
-
-def _compute_dtype_of(conf) -> jnp.dtype:
-    """Forward/backward compute dtype: ``conf.compute_dtype`` when set
-    (mixed precision — bf16 on the MXU with f32 master params), else
-    the storage dtype."""
-    return jnp.dtype(getattr(conf, "compute_dtype", None) or conf.dtype)
-
-
-def _cast_floats(tree, dtype):
-    """Cast floating leaves of a pytree to ``dtype`` (ints — embedding
-    indices, native-width inputs — pass through untouched)."""
-    return jax.tree_util.tree_map(
-        lambda a: (
-            a.astype(dtype)
-            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact)
-            else a
-        ),
-        tree,
-    )
-
-
-def _iter_unchunked(data):
-    """Iterate minibatches, expanding any ChunkedDataSet elements
-    (streamed pipelines may deliver pre-stacked chunks; consumers
-    without a fused path unstack here)."""
-    from deeplearning4j_tpu.datasets.api import ChunkedDataSet
-
-    for d in data:
-        if isinstance(d, ChunkedDataSet):
-            yield from d.to_datasets()
-        else:
-            yield d
-
-
-def _cast_stacked(a, dtype):
-    """The cast-on-device contract shared by _stack_on_device and the
-    prestacked-chunk paths of both engines: narrow integers ride at
-    native width (the step casts on device); everything else casts to
-    the model dtype."""
-    return (
-        a
-        if a.dtype.kind in ("u", "i") and a.dtype.itemsize <= 2
-        else a.astype(dtype)
-    )
-
-
-def _stack_on_device(arrs, dtype):
-    """Stack k same-shaped minibatch arrays for a fused dispatch,
-    preserving the cast-on-device contract in ONE place for both
-    engines: already-device arrays stack on device (no host round
-    trip), narrow integer inputs (uint8 pixels/one-hots) keep their
-    native width — the step casts them on device."""
-    if all(isinstance(a, jax.Array) for a in arrs):
-        return _cast_stacked(jnp.stack(arrs), dtype)
-    return _to_device(
-        np.stack([np.asarray(a) for a in arrs]), dtype
-    )
-
-
-def _nbytes(a) -> int:
-    nb = getattr(a, "nbytes", None)
-    return int(nb) if nb is not None else int(np.asarray(a).nbytes)
-
-
-def _cached_epoch_plan(model, iterator, epochs: int, arrays_of):
-    """Shared eligibility gate + HBM size accounting + plan building
-    for the device-cached multi-epoch fit path (MultiLayerNetwork and
-    ComputationGraph). ``arrays_of(ds)`` yields every array the stacked
-    chunks will hold. Returns the scan plan, or None when the caller
-    must stream (single epoch, iterator input, non-scannable config, or
-    dataset larger than ``model.device_cache_bytes``)."""
-    if (
-        epochs <= 1
-        or not isinstance(iterator, (list, tuple))
-        or len(iterator) == 0
-        or not model._can_scan_steps()
-        or model.scan_chunk <= 1
-    ):
-        return None
-    total = 0
-    for ds in iterator:
-        if not hasattr(ds, "features"):
-            return None
-        for a in arrays_of(ds):
-            if a is not None:
-                total += _nbytes(a)
-    if total > model.device_cache_bytes:
-        return None
-    return _build_scan_plan(
-        iterator, model._ds_scan_sig, model._stack_chunk,
-        model.scan_chunk,
-    )
-
-
-def _build_scan_plan(seq, sig_fn, stack_fn, scan_chunk: int):
-    """Group consecutive same-signature minibatches into fused chunks
-    (the same boundaries ``_fit_epoch_scan`` produces). Returns a list
-    of ``("chunk", stacked_device_arrays, last_host_batch)`` /
-    ``("single", ds, ds)`` entries, shared by MultiLayerNetwork and
-    ComputationGraph."""
-    plan: List[Any] = []
-    buf: List[Any] = []
-    sig = None
-
-    def flush(batches):
-        if len(batches) == 1:
-            plan.append(("single", batches[0], batches[0]))
-        elif batches:
-            plan.append(("chunk", stack_fn(batches), batches[-1]))
-
-    for ds in seq:
-        s = sig_fn(ds)
-        if buf and (s != sig or len(buf) >= scan_chunk):
-            flush(buf)
-            buf = []
-        sig = s
-        buf.append(ds)
-    flush(buf)
-    return plan
-
-
-def _scan_consts(model, k: int, it0: int):
-    """Device-resident (lr_stack, it0) for a fused k-step dispatch.
-
-    Both are tiny, but through a high-latency host link (e.g. the
-    tunneled-TPU dev setup) transferring the per-layer lr dict —
-    ~n_layers small arrays — EVERY chunk dominated ResNet-50-class
-    dispatch cost. Constant schedules (the common case) repeat the
-    same values every chunk, so the device copy is cached by value;
-    the it0 scalar is reused from the multi-step program's own
-    device-computed ``it0 + k`` output (``_note_it0``) so steady-state
-    chunks transfer nothing host-side at all."""
-    rows = [model.updater_def.scheduled_lrs(it0 + i) for i in range(k)]
-    names = list(model.updater_def.settings)
-    key = (k, tuple(
-        tuple(float(r[n]) for n in names) for r in rows
-    ))
-    cache = model._scan_const_cache
-    lr = cache.get(key)
-    if lr is None:
-        if len(cache) >= 64:  # unbounded only for pathological schedules
-            cache.clear()
-        lr = {
-            n: jnp.asarray([r[n] for r in rows], jnp.float32)
-            for n in names
-        }
-        cache[key] = lr
-    if model._it0_shadow == it0 and model._it0_dev is not None:
-        it0_dev = model._it0_dev
-    else:
-        it0_dev = jnp.asarray(it0, jnp.int32)
-    return lr, it0_dev
-
-
-def _note_it0(model, it0_dev, host_value: int) -> None:
-    """Record the device-side iteration counter a multi-step program
-    returned, for reuse by the next chunk's ``_scan_consts``."""
-    model._it0_dev = it0_dev
-    model._it0_shadow = host_value
-
-
-def _stream_guard_and_prime(named_layers, rnn_state, stream_steps,
-                            t_new, batch, dtype) -> None:
-    """Shared ``rnn_time_step`` bookkeeping for both engines: raise
-    before a finite streaming cache (KV) would silently wrap, and
-    prime missing streaming state (zero caches / carries).
-    ``named_layers``: (name, layer_conf) pairs."""
-    caps = [
-        lc.stream_capacity() for _, lc in named_layers
-        if lc.streams_state() and lc.stream_capacity()
-    ]
-    if caps and stream_steps + t_new > min(caps):
-        raise ValueError(
-            f"rnn_time_step overflow: {stream_steps} + {t_new} "
-            f"timesteps exceeds the smallest streaming cache "
-            f"({min(caps)}); raise kv_cache or call "
-            "rnn_clear_previous_state()"
-        )
-    for name, lc in named_layers:
-        if (
-            lc.streams_state()
-            and name not in rnn_state
-            and getattr(lc, "init_stream_state", None) is not None
-        ):
-            rnn_state[name] = lc.init_stream_state(batch, dtype)
-
-
-def _extract_stream_state(named_layers, new_state, rnn_state) -> None:
-    """Pull each streaming layer's carry keys out of the step's state
-    into the host-held ``rnn_state`` (the reference's stateMap)."""
-    for name, lc in named_layers:
-        if lc.streams_state():
-            rnn_state[name] = {
-                k: new_state[name][k]
-                for k in lc.stream_state_keys()
-                if k in new_state[name]
-            }
-
-
-def _reg_penalty(layer, layer_params):
-    """L1/L2 penalty for one layer (reference calcL1/calcL2)."""
-    reg = 0.0
-    if layer.l1 > 0.0 or layer.l2 > 0.0:
-        for pn in layer.regularizable_params():
-            if pn in layer_params:
-                w = layer_params[pn]
-                if layer.l2 > 0.0:
-                    reg = reg + 0.5 * layer.l2 * jnp.sum(w * w)
-                if layer.l1 > 0.0:
-                    reg = reg + layer.l1 * jnp.sum(jnp.abs(w))
-    return reg
+# Compatibility aliases: these helpers grew up in this module and are
+# imported from here by older call sites; the canonical definitions
+# live in the functional core now.
+_dtype_of = core.dtype_of
+_compute_dtype_of = core.compute_dtype_of
+_cast_floats = core.cast_floats
+_to_device = core.to_device
+_cast_stacked = core.cast_stacked
+_stack_on_device = core.stack_on_device
+_nbytes = core.nbytes
+_iter_unchunked = core.iter_unchunked
+_reg_penalty = core.reg_penalty
+_scan_consts = core.scan_consts
+_note_it0 = core.note_it0
+_cached_epoch_plan = core.cached_epoch_plan
+_build_scan_plan = core.build_scan_plan
+_stream_guard_and_prime = core.stream_guard_and_prime
+_extract_stream_state = core.extract_stream_state
 
 
 class MultiLayerNetwork:
@@ -314,7 +106,7 @@ class MultiLayerNetwork:
         self._jit_pretrain_steps: Dict[int, Callable] = {}
         self._jit_pretrain_input = None
         self._pretrain_done = False
-        # device-resident scan constants (see _scan_consts)
+        # device-resident scan constants (see core.scan_consts)
         self._scan_const_cache: Dict[Any, Any] = {}
         self._it0_dev = None
         self._it0_shadow = -1
@@ -324,11 +116,11 @@ class MultiLayerNetwork:
         # host applies skip/rollback policy; forces the per-step path
         # (the fused scan cannot consult the guard mid-dispatch)
         self.divergence_guard = None
-        # async dispatch knobs (the _fit_batches per-step loop runs
-        # through an AsyncDispatchWindow): at most max_in_flight
-        # steps dispatched-but-incomplete; the guard's ok-flag is
-        # collected guard_lag steps late (None -> max_in_flight;
-        # rollback policy forces 0 — see parallel/dispatch.py)
+        # async dispatch knobs (the fit loop runs through an
+        # AsyncDispatchWindow): at most max_in_flight steps
+        # dispatched-but-incomplete; the guard's ok-flag is collected
+        # guard_lag steps late (None -> max_in_flight; rollback policy
+        # forces 0 — see parallel/dispatch.py)
         self.max_in_flight = 2
         self.guard_lag = None
         self._dispatch_window = None
@@ -338,6 +130,9 @@ class MultiLayerNetwork:
         self._telemetry_grad_norm = False
         self._last_grad_norm = None  # 0-d device array; float() syncs
         self._last_batch_rows = None  # host int; examples/sec signal
+        # whole-net transform knobs (scan_layers / remat / loss_scale)
+        # — see core.set_transforms; seeded from config hints
+        core.init_transforms(self, conf)
 
     @property
     def score_value(self) -> float:
@@ -393,139 +188,96 @@ class MultiLayerNetwork:
         return self
 
     # ------------------------------------------------------------------
-    # pure forward builders (these close over conf only — safe to jit)
+    # whole-net transforms (implemented once in nn/core.py)
     # ------------------------------------------------------------------
 
-    def _ctx_for(self, x) -> ShapeContext:
-        t = x.shape[2] if x.ndim == 3 else -1
-        return ShapeContext(batch=x.shape[0], time=t)
+    def set_transforms(self, scan_layers=None, remat=None,
+                       loss_scale=None) -> "MultiLayerNetwork":
+        """(Re)configure the whole-net transforms: ``scan_layers``
+        (stack homogeneous layer runs under one ``lax.scan`` —
+        O(depth) HLO becomes O(1), collapsing deep-stack compile
+        time), ``remat`` (``none | dots_saveable | full`` activation
+        rematerialization via ``jax.checkpoint`` — recompute FLOPs
+        for activation HBM), and ``loss_scale`` (dynamic loss scaling
+        for ``compute_dtype="float16"``; True = default 2**15).
+        Trajectories are bitwise identical with scan/remat on or off;
+        changed knobs invalidate the compiled programs."""
+        core.set_transforms(self, scan_layers, remat, loss_scale)
+        return self
+
+    @property
+    def _loss_scale_active(self) -> bool:
+        return core.loss_scale_active(self)
+
+    def _active_layer_runs(self) -> tuple:
+        if self._layer_runs_cache is None:
+            self._layer_runs_cache = tuple(core.detect_layer_runs(
+                self.conf.layers, self.conf.preprocessors
+            ))
+        return self._layer_runs_cache
+
+    def scan_layer_run_count(self) -> int:
+        """Active scanned layer runs (telemetry signal)."""
+        return len(self._active_layer_runs()) if self.scan_layers else 0
+
+    # ------------------------------------------------------------------
+    # pure forward builders (these close over conf only — safe to jit)
+    # ------------------------------------------------------------------
 
     def _forward_pure(
         self, params, state, x, *, train: bool, rng, upto: Optional[int] = None,
         collect: bool = False, fmask=None,
     ):
         """Forward through layers [0, upto]; returns (activation, preout
-        of last executed layer, new_state, [activations]).
-
-        ``fmask``: [batch, time] features mask threaded to recurrent
-        layers (reference ``setLayerMaskArrays``)."""
-        conf = self.conf
-        cdt = _compute_dtype_of(conf)
-        if cdt != _dtype_of(conf):
-            # mixed precision: master params stay in the storage dtype
-            # (grads flow back through the cast, so the updater applies
-            # them in master precision); compute runs in cdt
-            params = _cast_floats(params, cdt)
-            x = _cast_floats(x, cdt)
-            fmask = _cast_floats(fmask, cdt) if fmask is not None else None
-        ctx = self._ctx_for(x)
-        n = len(conf.layers) if upto is None else upto + 1
-        new_state = dict(state)
-        acts = []
-        preout = None
-        for i in range(n):
-            name = self.layer_names[i]
-            layer = conf.layers[i]
-            if i in conf.preprocessors:
-                x = conf.preprocessors[i].preprocess(x, ctx)
-            lrng = None
-            if rng is not None:
-                lrng = jax.random.fold_in(rng, i)
-            if i == n - 1 and hasattr(layer, "pre_output") and layer.has_loss():
-                xin = layer.maybe_dropout(x, train=train, rng=lrng)
-                # same lrng as apply -> identical DropConnect mask
-                pw = layer.maybe_drop_connect(
-                    params[name], train=train, rng=lrng
-                )
-                preout = layer.pre_output(pw, xin)
-            x, st = layer.apply(
-                params[name], x, state.get(name, {}), train=train, rng=lrng,
-                mask=fmask,
-            )
-            new_state[name] = st
-            if collect:
-                acts.append(x)
-        return x, preout, new_state, acts
+        of last executed layer, new_state, [activations]). Delegates to
+        ``core.sequential_forward`` with this model's transform knobs."""
+        return core.sequential_forward(
+            self.conf, self.layer_names, params, state, x, train=train,
+            rng=rng, upto=upto, collect=collect, fmask=fmask,
+            scan_layers=self.scan_layers, remat=self.remat,
+            runs=self._active_layer_runs() if self.scan_layers else (),
+        )
 
     def _score_pure(self, params, state, x, labels, mask, rng, *,
                     train: bool, fmask=None):
-        """Loss score incl. L1/L2 penalty (reference computeGradientAndScore
-        adds calcL1/calcL2 to the loss). ``mask`` is the labels mask
-        (falls back to ``fmask`` for 3-d labels, like the reference's
-        output-layer masking)."""
-        out, preout, new_state, _ = self._forward_pure(
-            params, state, x, train=train, rng=rng, fmask=fmask,
+        """Loss score incl. L1/L2 penalty (core.sequential_score)."""
+        return core.sequential_score(
+            self.conf, self.layer_names, params, state, x, labels,
+            mask, rng, train=train, fmask=fmask,
+            scan_layers=self.scan_layers, remat=self.remat,
+            runs=self._active_layer_runs() if self.scan_layers else (),
         )
-        last = self.conf.layers[-1]
-        if not last.has_loss():
-            raise ValueError(
-                "Last layer has no loss function; use an OutputLayer/LossLayer"
+
+    # ------------------------------------------------------------------
+    # jitted train step (built by the core)
+    # ------------------------------------------------------------------
+
+    def _score_fn(self):
+        """The engine's contribution to the core step builders: a pure
+        ``(params, state, x, labels, mask, fmask, rng) ->
+        (score, new_state)`` closure."""
+        def score_fn(p, state, x, labels, mask, fmask, rng):
+            return self._score_pure(
+                p, state, x, labels, mask, rng, train=True, fmask=fmask
             )
-        name = self.layer_names[-1]
-        if preout is None:
-            preout = out
-        from deeplearning4j_tpu.nn import losses as losses_mod
-
-        loss_mask = mask
-        if loss_mask is None and labels.ndim == 3:
-            loss_mask = fmask
-        score = losses_mod.score(
-            last.loss, labels, preout, last.activation, loss_mask, True
-        )
-        reg = 0.0
-        for lname, layer in zip(self.layer_names, self.conf.layers):
-            reg = reg + _reg_penalty(layer, params[lname])
-        return score + reg, new_state
-
-    # ------------------------------------------------------------------
-    # jitted train step
-    # ------------------------------------------------------------------
+        return score_fn
 
     def _build_step(self) -> Callable:
-        updater = self.updater_def
-
         step_dtype = _dtype_of(self.conf)
-        guarded = self.divergence_guard is not None
-        telemetry = self._telemetry_grad_norm
 
-        def step(params, upd_state, state, x, labels, mask, fmask, lrs, t,
-                 rng):
-            x = x.astype(step_dtype)           # on-device cast for
-            labels = labels.astype(step_dtype)  # integer-typed inputs
-
-            def loss_fn(p):
-                s, new_state = self._score_pure(
-                    p, state, x, labels, mask, rng, train=True, fmask=fmask
-                )
-                return s, new_state
-
-            (score, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
-            new_params, new_upd = updater.update(
-                grads, upd_state, params, lrs, t
-            )
-            extras = ()
-            if telemetry:
-                from deeplearning4j_tpu.resilience.guard import (
-                    grad_global_norm_sq,
-                )
-
-                extras = (jnp.sqrt(grad_global_norm_sq(grads)),)
-            if not guarded:
-                return (new_params, new_upd, new_state, score) + extras
-            from deeplearning4j_tpu.resilience.guard import (
-                divergence_ok, select_updates,
+        def cast(x, labels, mask, fmask):
+            # on-device cast for integer-typed inputs
+            return (
+                x.astype(step_dtype), labels.astype(step_dtype),
+                mask, fmask,
             )
 
-            ok = divergence_ok(score, grads)
-            new_params, new_upd, new_state = select_updates(
-                ok, new_params, params, new_upd, upd_state,
-                new_state, state,
-            )
-            return (new_params, new_upd, new_state, score) + extras + (ok,)
-
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return core.build_step(
+            self._score_fn(), self.updater_def, cast=cast,
+            guarded=self.divergence_guard is not None,
+            telemetry=self._telemetry_grad_norm,
+            loss_scale=self._loss_scale_active,
+        )
 
     def set_divergence_guard(self, guard) -> None:
         """(Un)install a resilience.DivergenceGuard on the SGD train
@@ -545,150 +297,43 @@ class MultiLayerNetwork:
             self._telemetry_grad_norm = enabled
             self._jit_step = None
 
-    def _apply_step_out(self, out):
-        """Unpack one jitted-step output tuple (base 4 fields, plus
-        the optional telemetry grad-norm, plus the optional guard ok
-        flag) into model state; returns ``(score, ok)``."""
-        self.params, self.updater_state, self.state = out[:3]
-        score = out[3]
-        i = 4
-        if self._telemetry_grad_norm:
-            self._last_grad_norm = out[i]
-            i += 1
-        ok = out[i] if self.divergence_guard is not None else None
-        return score, ok
-
-    def _build_multi_step(self) -> Callable:
-        """k optimizer steps fused into ONE XLA program via lax.scan.
-
-        The reference dispatches one native-op sequence per minibatch
-        (SURVEY.md §3.1 hot loop); the per-dispatch latency is what
-        bounds small-model throughput on TPU (host->device hop per
-        step). Scanning k steps amortizes it k-fold: per-step PRNG keys
-        and Adam's t are computed on device, lr schedules stay host-side
-        (arbitrary Python) and ride in as a tiny stacked array.
-        """
-        updater = self.updater_def
-
-        recurrent_names = [
-            name for name, layer in zip(self.layer_names, self.conf.layers)
-            if layer.is_recurrent()
-        ]
-
+    def _multi_cast(self):
         multi_dtype = _dtype_of(self.conf)
 
-        def body(carry, per_step):
-            params, upd_state, state = carry
-            x, labels, mask, fmask, lrs, t, rng = per_step
-            x = x.astype(multi_dtype)
-            labels = labels.astype(multi_dtype)
+        def cast(x, labels, mask, fmask):
             # keep the cast-on-device contract symmetric with the
             # per-step path, which converts masks to the compute dtype
-            mask = None if mask is None else mask.astype(multi_dtype)
-            fmask = (
-                None if fmask is None else fmask.astype(multi_dtype)
+            return (
+                x.astype(multi_dtype), labels.astype(multi_dtype),
+                None if mask is None else mask.astype(multi_dtype),
+                None if fmask is None else fmask.astype(multi_dtype),
             )
+        return cast
 
-            def loss_fn(p):
-                s, new_state = self._score_pure(
-                    p, state, x, labels, mask, rng, train=True, fmask=fmask
-                )
-                return s, new_state
-
-            (score, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
-            new_params, new_upd = updater.update(
-                grads, upd_state, params, lrs, t
-            )
-            # standard-backprop semantics: recurrent carry resets per
-            # minibatch (_reset_recurrent_state) — keep the carry
-            # structure constant by restoring the empty input entries
-            for name in recurrent_names:
-                new_state[name] = state[name]
-            return (new_params, new_upd, new_state), score
-
-        def multi_step(params, upd_state, state, xs, ys, masks, fmasks,
-                       lr_stack, it0, base_key):
-            k = xs.shape[0]
-            ts = (it0 + 1 + jnp.arange(k)).astype(jnp.float32)
-            rngs = jax.vmap(
-                lambda i: jax.random.fold_in(base_key, i)
-            )(it0 + jnp.arange(k))
-            (params, upd_state, state), scores = jax.lax.scan(
-                body, (params, upd_state, state),
-                (xs, ys, masks, fmasks, lr_stack, ts, rngs),
-            )
-            # next chunk's it0, computed on device: the caller keeps it
-            # resident so consecutive chunks transfer no host scalars
-            return params, upd_state, state, scores, it0 + k
-
-        return jax.jit(multi_step, donate_argnums=(0, 1, 2))
-
-    def _build_tbptt_multi_step(self) -> Callable:
-        """TBPTT chunks fused into ONE XLA dispatch: like
-        ``_build_multi_step`` but the recurrent carry THREADS through
-        the ``lax.scan`` (the reference's host-side chunk loop,
-        ``doTruncatedBPTT:1210``, pays a dispatch per chunk). The
-        caller primes the recurrent state with zero h/c so the scan
-        carry has a fixed pytree structure; ``resets`` (one 0/1 flag
-        per step) zero the carry at minibatch boundaries so MANY
-        minibatches' chunk stacks ride in a single dispatch."""
-        updater = self.updater_def
-        multi_dtype = _dtype_of(self.conf)
-        recurrent_names = [
+    def _recurrent_names(self) -> List[str]:
+        return [
             name for name, layer in zip(self.layer_names, self.conf.layers)
             if layer.is_recurrent()
         ]
 
-        def body(carry, per_step):
-            params, upd_state, state = carry
-            x, labels, mask, fmask, lrs, t, rng, reset = per_step
-            x = x.astype(multi_dtype)
-            labels = labels.astype(multi_dtype)
-            mask = None if mask is None else mask.astype(multi_dtype)
-            fmask = (
-                None if fmask is None else fmask.astype(multi_dtype)
-            )
-            state = dict(state)
-            keep = 1.0 - reset
-            for name in recurrent_names:
-                # reset==1 at a new minibatch's first chunk; v*0 is
-                # bitwise the zeros the primed initial state holds
-                state[name] = {
-                    k2: v * keep.astype(v.dtype)
-                    for k2, v in state[name].items()
-                }
+    def _build_multi_step(self) -> Callable:
+        return core.build_multi_step(
+            self._score_fn(), self.updater_def,
+            cast=self._multi_cast(),
+            recurrent_names=self._recurrent_names(),
+        )
 
-            def loss_fn(p):
-                s, new_state = self._score_pure(
-                    p, state, x, labels, mask, rng, train=True,
-                    fmask=fmask,
-                )
-                return s, new_state
-
-            (score, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
-            new_params, new_upd = updater.update(
-                grads, upd_state, params, lrs, t
-            )
-            return (new_params, new_upd, new_state), score
-
-        def multi_step(params, upd_state, state, xs, ys, masks, fmasks,
-                       lr_stack, it0, base_key, resets):
-            k = xs.shape[0]
-            ts = (it0 + 1 + jnp.arange(k)).astype(jnp.float32)
-            rngs = jax.vmap(
-                lambda i: jax.random.fold_in(base_key, i)
-            )(it0 + jnp.arange(k))
-            (params, upd_state, state), scores = jax.lax.scan(
-                body, (params, upd_state, state),
-                (xs, ys, masks, fmasks, lr_stack, ts, rngs, resets),
-            )
-            return params, upd_state, state, scores, it0 + k
-
-        return jax.jit(multi_step, donate_argnums=(0, 1, 2))
+    def _build_tbptt_multi_step(self) -> Callable:
+        """TBPTT chunks fused into ONE XLA dispatch: the recurrent
+        carry THREADS through the ``lax.scan`` and per-step ``resets``
+        zero it at minibatch boundaries (core.build_multi_step in
+        tbptt mode)."""
+        return core.build_multi_step(
+            self._score_fn(), self.updater_def,
+            cast=self._multi_cast(),
+            recurrent_names=self._recurrent_names(),
+            tbptt=True,
+        )
 
     def _can_fuse_tbptt(self, x, y, fwd: int) -> bool:
         """The fused single-dispatch TBPTT applies when chunks tile the
@@ -701,9 +346,10 @@ class MultiLayerNetwork:
             and x.shape[2] % fwd == 0
             and y.ndim == 3
             and y.shape[2] == x.shape[2]
-            # guarded runs use the per-chunk step (the fused scan
-            # cannot consult the divergence guard mid-dispatch)
+            # guarded/loss-scaled runs use the per-chunk step (the
+            # fused scan cannot consult either mid-dispatch)
             and self.divergence_guard is None
+            and not self._loss_scale_active
             and all(
                 layer.can_stream()
                 and getattr(layer, "init_stream_state", None) is not None
@@ -782,12 +428,12 @@ class MultiLayerNetwork:
         """Scan-fused fitting applies when per-minibatch semantics are
         stateless: standard backprop (recurrent carry resets each
         minibatch — the scan body restores the empty entries), not
-        TBPTT (whose carry threads across host-side chunks). Listeners
-        that time individual iterations would observe k
-        near-simultaneous callbacks, so attached listeners also force
-        the per-step path unless they declare
-        ``supports_batched_iterations = True`` (e.g. averaging
-        listeners like the reference PerformanceListener pattern)."""
+        TBPTT (whose carry threads across host-side chunks), and
+        neither divergence guard nor dynamic loss scaling is active
+        (both need the per-step program). Listeners that time
+        individual iterations would observe k near-simultaneous
+        callbacks, so attached listeners also force the per-step path
+        unless they declare ``supports_batched_iterations = True``."""
         return (
             self.conf.iterations == 1
             and self.conf.backprop
@@ -795,6 +441,7 @@ class MultiLayerNetwork:
             and self.conf.optimization_algo
             == "STOCHASTIC_GRADIENT_DESCENT"
             and self.divergence_guard is None
+            and not self._loss_scale_active
             and all(
                 getattr(l, "supports_batched_iterations", False)
                 for l in self.listeners
@@ -813,39 +460,6 @@ class MultiLayerNetwork:
             sh(getattr(ds, "labels_mask", None)),
             sh(getattr(ds, "features_mask", None)),
         )
-
-    def _fit_epoch_scan(self, it) -> int:
-        """Buffer same-shaped minibatches into chunks of
-        ``self.scan_chunk`` and run each chunk as one fused dispatch.
-        ``ChunkedDataSet`` items (pre-stacked [k, b, ...] payloads from
-        an input pipeline) feed the dispatch directly."""
-        from deeplearning4j_tpu.datasets.api import ChunkedDataSet
-
-        self._reset_recurrent_state()  # scan carries empty rnn entries
-        buf: List[Any] = []
-        sig = None
-        n = 0
-        for ds in it:
-            if isinstance(ds, ChunkedDataSet):
-                if buf:
-                    self._flush_scan_chunk(buf)
-                    buf, sig = [], None
-                self._run_prestacked_chunk(ds)
-                n += ds.k
-                continue
-            s = self._ds_scan_sig(ds)
-            if buf and s != sig:
-                self._flush_scan_chunk(buf)
-                buf = []
-            sig = s
-            buf.append(ds)
-            n += 1
-            if len(buf) >= self.scan_chunk:
-                self._flush_scan_chunk(buf)
-                buf = []
-        if buf:
-            self._flush_scan_chunk(buf)
-        return n
 
     def _stack_chunk(self, batches: List[Any]):
         """Stack k same-shaped minibatches into device-resident arrays
@@ -868,18 +482,10 @@ class MultiLayerNetwork:
             len(batches),
         )
 
-    def _flush_scan_chunk(self, batches: List[Any]) -> None:
-        if len(batches) == 1:
-            self.fit_minibatch(batches[0])
-            return
-        if self._wants_last_features():
-            self._last_features = batches[-1].features
-        self._run_scan_chunk(self._stack_chunk(batches))
-
     def _run_prestacked_chunk(self, ds) -> None:
         """One fused dispatch from a ChunkedDataSet's [k, b, ...]
-        arrays (same dtype contract as _stack_on_device: narrow ints
-        ride as-is and cast on device)."""
+        arrays (same dtype contract as core.stack_on_device: narrow
+        ints ride as-is and cast on device)."""
         dtype = _dtype_of(self.conf)
 
         def prep(a):
@@ -903,34 +509,10 @@ class MultiLayerNetwork:
             return
         if self._wants_last_features():
             self._last_features = ds.features[-1]
-        self._run_scan_chunk((
+        core.run_scan_chunk(self, (
             prep(ds.features), prep(ds.labels), prep(ds.labels_mask),
             prep(ds.features_mask), k,
         ))
-
-    def _run_scan_chunk(self, stacked) -> None:
-        """One fused k-step dispatch from pre-stacked device arrays."""
-        xs, ys, masks, fmasks, k = stacked
-        it0 = self.iteration_count
-        lr_stack, it0_dev = _scan_consts(self, k, it0)
-        if self._jit_multi_step is None:
-            self._jit_multi_step = self._build_multi_step()
-        (
-            self.params, self.updater_state, self.state, scores,
-            it0_next,
-        ) = self._jit_multi_step(
-            self.params, self.updater_state, self.state,
-            xs, ys, masks, fmasks, lr_stack, it0_dev, self._base_key,
-        )
-        _note_it0(self, it0_next, it0 + k)
-        self.iteration_count += k
-        self._last_score = scores[-1]
-        if self.listeners:
-            for i in range(k):
-                self._last_score = scores[i]
-                for listener in self.listeners:
-                    listener.iteration_done(self, it0 + i + 1)
-            self._last_score = scores[-1]
 
     # ------------------------------------------------------------------
     # public API (reference fit/output/score)
@@ -974,121 +556,23 @@ class MultiLayerNetwork:
             self.resume(resume_from)
         if labels is not None:
             batches: Any = [DataSet(features=data, labels=labels)]
-            self._fit_batches(batches, epochs)
+            core.fit_batches(self, batches, epochs)
             return
         if hasattr(data, "features"):
-            self._fit_batches([data], epochs)
+            core.fit_batches(self, [data], epochs)
             return
-        self._fit_batches(data, epochs)
-
-    def _fit_batches(self, iterator, epochs: int) -> None:
-        if self.params is None:
-            self.init()
-        if self.conf.pretrain and not self._pretrain_done:
-            # reference fit():1064 — layer-wise pretrain before backprop
-            if not hasattr(iterator, "reset") and not isinstance(
-                iterator, (list, tuple)
-            ):
-                iterator = list(iterator)
-            self.pretrain(iterator)
-        if not self.conf.backprop:
-            return
-        if self._fit_epochs_device_cached(iterator, epochs):
-            return
-        from deeplearning4j_tpu.parallel.dispatch import (
-            AsyncDispatchWindow,
-        )
-
-        window = AsyncDispatchWindow(
-            model=self, guard_fn=lambda: self.divergence_guard,
-            max_in_flight=self.max_in_flight,
-            guard_lag=self.guard_lag,
-        )
-        try:
-            for epoch in range(epochs):
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_start"):
-                        listener.on_epoch_start(self)
-                it = iter(iterator)
-                if self._can_scan_steps() and self.scan_chunk > 1:
-                    n_batches = self._fit_epoch_scan(it)
-                else:
-                    n_batches = 0
-                    self._dispatch_window = window
-                    try:
-                        for ds in it:
-                            self.fit_minibatch(ds)
-                            n_batches += 1
-                    finally:
-                        self._dispatch_window = None
-                    window.drain()  # guard aborts surface per epoch
-                if epoch > 0 and n_batches == 0:
-                    raise ValueError(
-                        "Iterator yielded no batches after the first "
-                        "epoch — a plain generator cannot be "
-                        "re-iterated; pass a list, a DataSetIterator "
-                        "with reset(), or epochs=1"
-                    )
-                if hasattr(iterator, "reset"):
-                    iterator.reset()
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_end"):
-                        listener.on_epoch_end(self)
-                self.epoch_count += 1
-        except BaseException:
-            window.abandon()  # keep the original exception
-            raise
+        core.fit_batches(self, data, epochs)
 
     def _fit_epochs_device_cached(self, iterator, epochs: int) -> bool:
-        """Multi-epoch fit over a materialized dataset with the batches
-        kept HBM-resident across epochs.
-
-        The reference re-reads host data every epoch and re-copies it
-        over PCIe (`MultipleEpochsIterator` + the per-op JNI hop,
-        SURVEY.md §3.1); on TPU the host->device link is the scarce
-        resource, so when the data is a fixed sequence that fits in
-        device memory we transfer each fused chunk ONCE and re-run the
-        scanned train step over the cached arrays every epoch. lr
-        schedules/iteration counts are recomputed per chunk per epoch,
-        so training semantics are identical to the streaming path.
-        Returns False (caller streams as before) for single epochs,
-        iterator input, solver paths, TBPTT configs the fused scan
-        can't express, or datasets larger than
-        ``self.device_cache_bytes``.
-        """
-        plan = self._tbptt_cached_plan(iterator, epochs)
-        if plan is None:
-            plan = _cached_epoch_plan(
-                self, iterator, epochs,
-                lambda ds: (
-                    ds.features, ds.labels,
-                    getattr(ds, "labels_mask", None),
-                    getattr(ds, "features_mask", None),
-                ),
-            )
-        if plan is None:
-            return False
-        for epoch in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            self._reset_recurrent_state()
-            for kind, item, last in plan:
-                if kind == "chunk":
-                    if self._wants_last_features():
-                        self._last_features = last.features
-                    self._run_scan_chunk(item)
-                elif kind == "tbptt":
-                    if self._wants_last_features():
-                        self._last_features = last.features
-                    self._run_tbptt_stacked(item)
-                else:
-                    self.fit_minibatch(item)
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch_count += 1
-        return True
+        return core.fit_epochs_device_cached(
+            self, iterator, epochs,
+            lambda ds: (
+                ds.features, ds.labels,
+                getattr(ds, "labels_mask", None),
+                getattr(ds, "features_mask", None),
+            ),
+            extra_plan_fn=self._tbptt_cached_plan,
+        )
 
     def _tbptt_cached_plan(self, iterator, epochs: int):
         """HBM-resident multi-epoch plan for fused-TBPTT configs: each
@@ -1165,6 +649,13 @@ class MultiLayerNetwork:
             for kind, item, last in grouped
         ]
 
+    def _step_extra_args(self) -> tuple:
+        """Trailing jitted-step arguments for the active transforms
+        (the dynamic loss-scale state, when engaged)."""
+        if self._loss_scale_active:
+            return (core.ensure_loss_scale_state(self),)
+        return ()
+
     def fit_minibatch(self, ds) -> float:
         """One minibatch through ``conf.iterations`` optimizer steps
         (reference Solver/StochasticGradientDescent.optimize; LBFGS/
@@ -1231,18 +722,18 @@ class MultiLayerNetwork:
                 self.params, self.updater_state, self.state,
                 x, y, mask, fmask,
                 {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
-                t, rng,
+                t, rng, *self._step_extra_args(),
             )
             guard = self.divergence_guard
-            score, ok = self._apply_step_out(out)
+            score, ok = core.apply_step_out(self, out)
             self.iteration_count += 1
             self._last_score = score  # device array; sync deferred
             window = self._dispatch_window
             if window is not None:
-                # async path (_fit_batches): bounded in-flight, guard
-                # flag collected guard_lag steps late — the in-jit
-                # select already suppressed a bad update, so the
-                # trajectory is unchanged (parallel/dispatch.py)
+                # async path (core.fit_batches): bounded in-flight,
+                # guard flag collected guard_lag steps late — the
+                # in-jit select already suppressed a bad update, so
+                # the trajectory is unchanged (parallel/dispatch.py)
                 window.push(score, ok)
             elif guard is not None:
                 if bool(ok):  # device sync — the cost of supervision
@@ -1311,10 +802,10 @@ class MultiLayerNetwork:
         out = self._jit_step(
             self.params, self.updater_state, self.state, xs, ys, ms, fs,
             {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
-            t, rng,
+            t, rng, *self._step_extra_args(),
         )
         guard = self.divergence_guard
-        score, ok = self._apply_step_out(out)
+        score, ok = core.apply_step_out(self, out)
         self.iteration_count += 1
         self._last_score = score  # device array; sync deferred
         if guard is not None:
@@ -1331,7 +822,9 @@ class MultiLayerNetwork:
     def _input_to_layer_pure(self, params, state, x, idx):
         """Input tensor as seen by layer ``idx`` — forward through
         layers [0, idx) including idx's own preprocessor."""
-        ctx = self._ctx_for(x)
+        ctx = ShapeContext(
+            batch=x.shape[0], time=x.shape[2] if x.ndim == 3 else -1
+        )
         for i in range(idx):
             if i in self.conf.preprocessors:
                 x = self.conf.preprocessors[i].preprocess(x, ctx)
@@ -1343,35 +836,12 @@ class MultiLayerNetwork:
             x = self.conf.preprocessors[idx].preprocess(x, ctx)
         return x
 
-    def _build_pretrain_step(self, idx: int, upd_def) -> Callable:
-        """Jitted single-layer update; takes the layer's input tensor
-        precomputed (the frozen lower stack runs once per batch, not
-        once per optimizer iteration — reference feedForwardToLayer
-        once per batch)."""
-        name = self.layer_names[idx]
-        layer = self.conf.layers[idx]
-
-        def step(lparams, upd_state, xin, lrs, t, rng):
-            def loss_fn(p):
-                return layer.pretrain_loss(p, xin, rng) + _reg_penalty(
-                    layer, p
-                )
-
-            loss, grads = jax.value_and_grad(loss_fn)(lparams)
-            new_p, new_upd = upd_def.update(
-                {name: grads}, upd_state, {name: lparams}, lrs, t
-            )
-            return new_p[name], new_upd, loss
-
-        return jax.jit(step, donate_argnums=(0, 1))
-
     def pretrain(self, data, epochs: int = 1) -> None:
         """Greedy layer-wise unsupervised pretraining: fit each
         pretrainable layer (VAE/RBM/AutoEncoder) on the activations of
         the stack below it (reference ``pretrain(DataSetIterator)`` →
         per-layer fit at ``MultiLayerNetwork.java:166``)."""
         from deeplearning4j_tpu.datasets.api import ChunkedDataSet, DataSet
-        from deeplearning4j_tpu.nn.updaters import MultiLayerUpdaterDef
 
         if self.params is None:
             self.init()
@@ -1404,8 +874,8 @@ class MultiLayerNetwork:
             upd_def = MultiLayerUpdaterDef({name: layer.updater_settings()})
             upd_state = upd_def.init({name: self.params[name]})
             if idx not in self._jit_pretrain_steps:
-                self._jit_pretrain_steps[idx] = self._build_pretrain_step(
-                    idx, upd_def
+                self._jit_pretrain_steps[idx] = core.build_pretrain_step(
+                    layer, name, upd_def
                 )
             step = self._jit_pretrain_steps[idx]
             it = 0
@@ -1487,7 +957,13 @@ class MultiLayerNetwork:
 
     # -- AOT export/install (compile/aot.py) ---------------------------
 
-    def aot_fingerprint(self, shape, kind: str = "output") -> str:
+    def _output_kind(self) -> str:
+        """AOT kind for the inference forward: scan-over-layers
+        changes the compiled program (remat/loss-scale do not touch
+        inference), so it is part of the artifact identity."""
+        return "output" + ("+scan" if self.scan_layers else "")
+
+    def aot_fingerprint(self, shape, kind: Optional[str] = None) -> str:
         """Validity fingerprint for this model's AOT artifacts at
         ``shape``: config JSON + shape + dtype + backend + jax
         versions (see ``compile.aot.artifact_fingerprint``)."""
@@ -1495,7 +971,8 @@ class MultiLayerNetwork:
 
         return artifact_fingerprint(
             self.conf.to_dict(), shape,
-            str(jnp.dtype(_dtype_of(self.conf))), kind,
+            str(jnp.dtype(_dtype_of(self.conf))),
+            kind if kind is not None else self._output_kind(),
         )
 
     def aot_export_output(self, x_shape, registry=None) -> bytes:
@@ -1516,7 +993,7 @@ class MultiLayerNetwork:
         return export_artifact(
             fn, (self.params, self.state, spec),
             fingerprint=self.aot_fingerprint(x_shape),
-            shape=x_shape, kind="output",
+            shape=x_shape, kind=self._output_kind(),
             name=f"output-{'x'.join(str(int(d)) for d in x_shape)}",
             registry=registry,
         )
@@ -1552,8 +1029,8 @@ class MultiLayerNetwork:
         ``ds``'s feature/label shapes (no masks) — the executable a
         warm restart installs via ``aot_install_step`` to resume
         fitting without a compile. Exported fresh (never from the
-        live ``_jit_step``) so guard/telemetry flags at export time
-        are captured in the fingerprint."""
+        live ``_jit_step``) so guard/telemetry/transform flags at
+        export time are captured in the fingerprint."""
         if self.params is None:
             self.init()
         from deeplearning4j_tpu.compile.aot import export_artifact
@@ -1572,7 +1049,7 @@ class MultiLayerNetwork:
         return export_artifact(
             self._build_step(),
             (self.params, self.updater_state, self.state, x, y,
-             None, None, lrs, t, rng),
+             None, None, lrs, t, rng) + self._step_extra_args(),
             fingerprint=self.aot_fingerprint(
                 x.shape, kind=self._step_kind()
             ),
@@ -1618,12 +1095,14 @@ class MultiLayerNetwork:
 
     def _step_kind(self) -> str:
         """AOT kind string for the train step: the guard/telemetry
-        flags change the compiled program (extra outputs), so they
-        are part of the artifact identity."""
+        flags and the whole-net transforms change the compiled
+        program (extra outputs / different HLO), so they are part of
+        the artifact identity."""
         return (
             "step"
             + ("+guard" if self.divergence_guard is not None else "")
             + ("+telemetry" if self._telemetry_grad_norm else "")
+            + core.transform_kind_suffix(self)
         )
 
     def _step_label_shape(self, x_shape) -> Tuple[int, ...]:

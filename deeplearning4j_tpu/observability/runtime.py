@@ -157,6 +157,25 @@ class TelemetryListener(IterationListener):
             "training_step_ms",
             help="host wall-clock per optimizer step (ms)",
         )._default()
+        # whole-net transform signals (nn/core.py knobs on the model)
+        self._remat_enabled = reg.gauge(
+            "remat_enabled",
+            help="1 when activation rematerialization is active",
+        )._default()
+        self._scan_runs = reg.gauge(
+            "scan_layer_runs",
+            help="scanned homogeneous layer runs in the active model",
+        )._default()
+        self._loss_scale = reg.gauge(
+            "loss_scale_value",
+            help="current dynamic loss scale (float16 training)",
+        )._default()
+        self._ls_overflows = reg.counter(
+            "loss_scale_overflows_total",
+            help="loss-scale overflow steps (update skipped in-jit, "
+                 "scale halved)",
+        )._default()
+        self._ls_overflows_seen = 0
         self._last_time: Optional[float] = None
         self._enabled_on = None
         self.defer_reads = defer_reads
@@ -220,5 +239,32 @@ class TelemetryListener(IterationListener):
                 self._publish_sample(*pending)
         else:
             self._publish_sample(loss_ref, gn_ref)
+        self._publish_transforms(model)
         if self.publish_memory:
             publish_device_memory(self.registry)
+
+    def _publish_transforms(self, model) -> None:
+        """Whole-net transform gauges, sampled with the loss (the
+        loss-scale state is a device dict — reading it here rides the
+        same gated sync)."""
+        self._remat_enabled.set(
+            1.0 if getattr(model, "remat", "none") != "none" else 0.0
+        )
+        count = getattr(model, "scan_layer_run_count", None)
+        if count is not None:
+            try:
+                self._scan_runs.set(float(count()))
+            except Exception:
+                pass
+        ls = getattr(model, "_loss_scale_state", None)
+        if ls is not None:
+            try:
+                self._loss_scale.set(float(ls["scale"]))
+                seen = int(ls["overflows"])
+                if seen > self._ls_overflows_seen:
+                    self._ls_overflows.inc(
+                        seen - self._ls_overflows_seen
+                    )
+                self._ls_overflows_seen = seen
+            except Exception:
+                pass

@@ -306,10 +306,8 @@ class DistributedTrainer:
         state and parameters across workers the same way. Dropout keys
         fold in the device index (reference workers draw independent
         RNG streams)."""
+        from deeplearning4j_tpu.nn import core
         from deeplearning4j_tpu.parallel.compat import shard_map_compat
-        from deeplearning4j_tpu.resilience.guard import (
-            divergence_ok, grad_global_norm_sq, select_updates,
-        )
 
         shard_map = shard_map_compat()
 
@@ -366,27 +364,13 @@ class DistributedTrainer:
             grads, score, new_state = _fused_pmean(
                 (grads, score, new_state), "data"
             )
-            new_params, new_upd = updater.update(
-                grads, upd_state, params, lrs, t
-            )
-            # telemetry norm is computed post-pmean: the GLOBAL
-            # gradient's L2 norm, identical on every replica
-            extras = (
-                (jnp.sqrt(grad_global_norm_sq(grads)),)
-                if telemetry else ()
-            )
-            if not guarded:
-                return (new_params, new_upd, new_state, score) + extras
-            # divergence guard: grads/score are already replica-
-            # identical post-pmean, so every replica computes the same
-            # ok flag and selects the same trees
-            ok = divergence_ok(score, grads)
-            new_params, new_upd, new_state = select_updates(
-                ok, new_params, params, new_upd, upd_state,
-                new_state, state,
-            )
-            return (
-                (new_params, new_upd, new_state, score) + extras + (ok,)
+            # post-pmean the grads/score are replica-identical, so the
+            # shared finish (updater + telemetry norm + guard select —
+            # nn/core.py) computes the same trees on every replica;
+            # the telemetry norm is the GLOBAL gradient's L2 norm
+            return core.finish_step(
+                updater, grads, score, new_state, params, upd_state,
+                state, lrs, t, guarded=guarded, telemetry=telemetry,
             )
 
         rep = P()
@@ -401,9 +385,7 @@ class DistributedTrainer:
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     def _build_gspmd_step(self):
-        from deeplearning4j_tpu.resilience.guard import (
-            divergence_ok, grad_global_norm_sq, select_updates,
-        )
+        from deeplearning4j_tpu.nn import core
 
         guarded = self.divergence_guard is not None
         telemetry = self._telemetry_enabled()
@@ -447,22 +429,9 @@ class DistributedTrainer:
             (score, new_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
-            new_params, new_upd = updater.update(
-                grads, upd_state, params, lrs, t
-            )
-            extras = (
-                (jnp.sqrt(grad_global_norm_sq(grads)),)
-                if telemetry else ()
-            )
-            if not guarded:
-                return (new_params, new_upd, new_state, score) + extras
-            ok = divergence_ok(score, grads)
-            new_params, new_upd, new_state = select_updates(
-                ok, new_params, params, new_upd, upd_state,
-                new_state, state,
-            )
-            return (
-                (new_params, new_upd, new_state, score) + extras + (ok,)
+            return core.finish_step(
+                updater, grads, score, new_state, params, upd_state,
+                state, lrs, t, guarded=guarded, telemetry=telemetry,
             )
 
         out_shardings = (

@@ -100,11 +100,11 @@ def train_step_cost(model, ds) -> dict:
                        or getattr(ds, "features_mask", None))
         batch = int(x[0].shape[0])
     else:
-        from deeplearning4j_tpu.nn.multilayer import _dtype_of, _to_device
+        from deeplearning4j_tpu.nn.core import dtype_of, to_device
 
-        dtype = _dtype_of(model.conf)
-        x = _to_device(ds.features, dtype)
-        y = _to_device(ds.labels, dtype)
+        dtype = dtype_of(model.conf)
+        x = to_device(ds.features, dtype)
+        y = to_device(ds.labels, dtype)
         lmask = getattr(ds, "labels_mask", None)
         fmask = getattr(ds, "features_mask", None)
         lmask = jnp.asarray(lmask, dtype) if lmask is not None else None

@@ -170,7 +170,8 @@ def _resnet_bottleneck(b, name, in_name, width, *, stride=1,
 def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
              updater="NESTEROVS", learning_rate=0.1, seed=42,
              dtype="float32", compute_dtype=None, cifar_stem=False,
-             depths=(3, 4, 6, 3), base_width=64):
+             depths=(3, 4, 6, 3), base_width=64, remat="none",
+             loss_scale=None):
     """ResNet-50 v1 as a ComputationGraph (BASELINE.md config #5 —
     the data-parallel scaling model; residual Add via the reference's
     ``ElementWiseVertex``, bottleneck stacks ``depths`` — default
@@ -179,7 +180,14 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
 
     ``cifar_stem=True`` swaps the 7x7/s2 stem + maxpool for a 3x3/s1
     conv (the standard CIFAR adaptation) so 32x32 inputs keep spatial
-    extent through the stages."""
+    extent through the stages.
+
+    ``remat`` (``none | dots_saveable | full``) enables activation
+    rematerialization on every bottleneck conv — the conv stack's
+    activations dominate peak HBM at training batch sizes, so remat
+    buys batch at the cost of a second forward in the backward pass
+    (``nn/core.py``); ``loss_scale`` arms dynamic loss scaling for
+    ``compute_dtype="float16"``."""
     # total stride: stem (1 or 4, incl. maxpool) x 2 per later stage
     div = (1 if cifar_stem else 4) * (2 ** (len(depths) - 1))
     if height % div or width % div:
@@ -193,6 +201,7 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, *,
         NeuralNetConfiguration.Builder()
         .seed(seed).learning_rate(learning_rate).updater(updater)
         .data_type(dtype).compute_data_type(compute_dtype)
+        .remat(remat).loss_scale(loss_scale)
         .graph_builder()
         .add_inputs("in")
     )
@@ -333,13 +342,22 @@ def googlenet(height=224, width=224, channels=3, n_classes=1000, *,
 def transformer_lm(vocab=77, d_model=256, n_layers=4, n_heads=8, *,
                    ffn_hidden=None, n_experts=0, updater="ADAM",
                    learning_rate=1e-3, seed=42, dtype="float32",
-                   compute_dtype=None):
+                   compute_dtype=None, scan_layers=False,
+                   remat="none", loss_scale=None):
     """Decoder-only transformer language model (net-new family beyond
     the reference's RNN era): causal MultiHeadSelfAttention via the
     Pallas flash-attention kernel on TPU, sinusoidal positional
     encoding, dense or Switch-MoE FFN (``n_experts > 0``).
     Inputs/labels are [b, vocab, t] one-hots like the char-RNN
-    configs."""
+    configs.
+
+    The repeated TransformerBlocks are THE scan-over-layers workload:
+    ``scan_layers=True`` collapses the n_layers-deep stack's HLO to a
+    single scanned block (compile time stops growing with depth), and
+    ``remat`` (``none | dots_saveable | full``) trades recompute for
+    activation HBM; ``loss_scale`` arms dynamic loss scaling for
+    ``compute_dtype="float16"`` — all trajectory-preserving whole-net
+    transforms from ``nn/core.py``."""
     from deeplearning4j_tpu.nn.layers import (
         PositionalEncoding,
         TransformerBlock,
@@ -349,6 +367,7 @@ def transformer_lm(vocab=77, d_model=256, n_layers=4, n_heads=8, *,
         NeuralNetConfiguration.Builder()
         .seed(seed).learning_rate(learning_rate).updater(updater)
         .data_type(dtype).compute_data_type(compute_dtype)
+        .scan_layers(scan_layers).remat(remat).loss_scale(loss_scale)
         .list()
         .layer(DenseLayer(n_out=d_model, activation="identity"))
         .layer(PositionalEncoding())

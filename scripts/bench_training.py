@@ -156,9 +156,46 @@ def _params_flat(net):
     ])
 
 
+def _remat_ab(batches, policy, windows, seed) -> dict:
+    """Activation-remat A/B on the same seeded batches: steps/sec and
+    bitwise trajectory with the policy off vs on (the remat transform
+    may only trade compute for memory, never change what is
+    trained)."""
+    import jax
+
+    def fit_all(net):
+        for ds in batches:
+            net.fit_minibatch(ds)
+        jax.block_until_ready(net.params)
+
+    nets = {}
+    for key, pol in (("off", "none"), ("on", policy)):
+        nets[key] = _make_net(seed=seed).set_transforms(remat=pol)
+        nets[key].fit_minibatch(batches[0])  # compile outside windows
+    out = {"policy": policy}
+    best = {"off": float("inf"), "on": float("inf")}
+    for _ in range(windows):
+        for key in ("off", "on"):
+            t0 = time.perf_counter()
+            fit_all(nets[key])
+            best[key] = min(best[key], time.perf_counter() - t0)
+    out["steps_per_s_off"] = round(len(batches) / best["off"], 2)
+    out["steps_per_s_on"] = round(len(batches) / best["on"], 2)
+    fresh = {
+        key: _make_net(seed=seed).set_transforms(remat=pol)
+        for key, pol in (("off", "none"), ("on", policy))
+    }
+    for net in fresh.values():
+        fit_all(net)
+    out["trajectory_match"] = bool(np.array_equal(
+        _params_flat(fresh["off"]), _params_flat(fresh["on"])
+    ))
+    return out
+
+
 def run(steps=40, batch=256, io_ms=4.0, cost_loops=0,
         queue_depth=3, max_in_flight=3, windows=3,
-        seed=0) -> dict:
+        seed=0, remat="none") -> dict:
     import jax
 
     from deeplearning4j_tpu.datasets.api import DataSet
@@ -277,6 +314,8 @@ def run(steps=40, batch=256, io_ms=4.0, cost_loops=0,
     out["speedup"] = round(
         out["pipelined"]["steps_per_s"] / out["sync"]["steps_per_s"], 3
     )
+    if remat and remat != "none":
+        out["remat"] = _remat_ab(batches, remat, windows, seed)
     return out
 
 
@@ -294,12 +333,16 @@ def main():
     ap.add_argument("--windows", type=int, default=3,
                     help="same-length windows per mode (best wins)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--remat", default="none",
+                    choices=("none", "dots_saveable", "full"),
+                    help="also A/B activation remat off vs this "
+                         "policy (steps/sec + bitwise trajectory)")
     args = ap.parse_args()
     print(json.dumps(run(
         steps=args.steps, batch=args.batch, io_ms=args.io_ms,
         cost_loops=args.cost_loops, queue_depth=args.queue_depth,
         max_in_flight=args.max_in_flight, windows=args.windows,
-        seed=args.seed,
+        seed=args.seed, remat=args.remat,
     )))
 
 

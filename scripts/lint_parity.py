@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Static check: both engine wrappers delegate their hot paths to the
+unified functional core (``deeplearning4j_tpu/nn/core.py``).
+
+History: ``MultiLayerNetwork`` and ``ComputationGraph`` each carried a
+private copy of the train-step builder, the scan-fused multi-step,
+the pretrain step, and the fit drivers — every perf PR paid its tax
+twice, and the copies drifted. The core refactor collapsed them; this
+lint keeps them collapsed:
+
+1. Both engine modules must import ``deeplearning4j_tpu.nn.core``.
+2. Neither engine module may call the primitives that define a hot
+   path of its own: ``value_and_grad`` / ``grad`` (a private backward
+   pass), ``lax.scan`` / ``checkpoint`` / ``remat`` (a private
+   whole-net transform), or ``updater.update`` outside the core.
+3. The core must actually define the shared machinery the engines
+   claim to delegate to (``build_step``, ``build_multi_step``,
+   ``build_pretrain_step``, ``apply_layer_run``, ``fit_batches``).
+4. Both engine classes must still expose the delegating methods the
+   rest of the stack calls (``_build_step``, ``_build_multi_step``,
+   ``fit_minibatch``, ``output``).
+
+Pure AST scan — nothing is imported, so this runs in milliseconds in
+any environment (part of the ``scripts/run_chaos.sh`` preamble next
+to ``lint_metrics.py``).
+
+Exit 0 when the split holds; exit 1 with the exact violations.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+NN = REPO / "deeplearning4j_tpu" / "nn"
+ENGINES = {
+    "MultiLayerNetwork": NN / "multilayer.py",
+    "ComputationGraph": NN / "graph.py",
+}
+CORE = NN / "core.py"
+
+# calling any of these inside an engine module means a duplicate hot
+# path grew back (the backward pass, a scan fusion, or a remat wrap
+# that belongs in the core)
+FORBIDDEN_CALLS = {"value_and_grad", "scan", "checkpoint", "remat"}
+# plus updater.update(...) — the optimizer application site
+FORBIDDEN_METHOD_ON = {"update": {"updater", "upd_def", "updater_def"}}
+
+CORE_REQUIRED = {
+    "build_step", "build_multi_step", "build_pretrain_step",
+    "apply_layer_run", "maybe_remat", "fit_batches", "run_scan_chunk",
+    "apply_step_out",
+}
+ENGINE_REQUIRED_METHODS = {
+    "_build_step", "_build_multi_step", "fit_minibatch", "output",
+}
+
+
+def call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def call_base(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id
+    return ""
+
+
+def check_engine(name: str, path: Path, errors: list) -> None:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    imports_core = any(
+        (isinstance(n, ast.ImportFrom)
+         and n.module == "deeplearning4j_tpu.nn"
+         and any(a.name == "core" for a in n.names))
+        or (isinstance(n, ast.ImportFrom)
+            and n.module == "deeplearning4j_tpu.nn.core")
+        or (isinstance(n, ast.Import)
+            and any(a.name == "deeplearning4j_tpu.nn.core"
+                    for a in n.names))
+        for n in ast.walk(tree)
+    )
+    if not imports_core:
+        errors.append(
+            f"{path.name}: does not import deeplearning4j_tpu.nn.core"
+        )
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node)
+        base = call_base(node)
+        if base == "core":
+            continue  # delegation to the core is the point
+        if cn in FORBIDDEN_CALLS:
+            errors.append(
+                f"{path.name}:{node.lineno}: calls {cn}() — the "
+                "backward pass / scan fusion / remat belongs in "
+                "nn/core.py"
+            )
+        bases = FORBIDDEN_METHOD_ON.get(cn)
+        if bases and base in bases:
+            errors.append(
+                f"{path.name}:{node.lineno}: calls {base}.{cn}() — "
+                "optimizer application belongs in nn/core.py"
+            )
+    # the engine class must still expose the delegating surface
+    cls = next(
+        (n for n in tree.body
+         if isinstance(n, ast.ClassDef) and n.name == name), None,
+    )
+    if cls is None:
+        errors.append(f"{path.name}: class {name} not found")
+        return
+    methods = {
+        n.name for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for m in sorted(ENGINE_REQUIRED_METHODS - methods):
+        errors.append(
+            f"{path.name}: {name} lost its delegating method {m}()"
+        )
+
+
+def check_core(errors: list) -> None:
+    tree = ast.parse(CORE.read_text(), filename=str(CORE))
+    defined = {
+        n.name for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for fn in sorted(CORE_REQUIRED - defined):
+        errors.append(
+            f"core.py: missing shared implementation {fn}() — the "
+            "engines have nothing to delegate to"
+        )
+
+
+def main() -> int:
+    errors: list = []
+    check_core(errors)
+    for name, path in ENGINES.items():
+        check_engine(name, path, errors)
+    if errors:
+        print("engine/core parity violations:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print(
+        "lint_parity: both engines delegate step/apply/fit hot paths "
+        "to nn/core.py"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,10 @@ echo "chaos seed: ${DL4J_TPU_CHAOS_SEED}"
 # match the names registered in code — drift fails loudly here,
 # before the chaos suite spends a second (see scripts/lint_metrics.py).
 python scripts/lint_metrics.py
+# ... and both engine wrappers must still delegate their hot paths to
+# the unified functional core, nn/core.py (no reintroduced duplicate
+# step/scan/remat implementations — see scripts/lint_parity.py).
+python scripts/lint_parity.py
 # Registered chaos suites:
 #   tests/test_resilience.py     — training runtime (retry/checkpoint/
 #                                  guard, kill/resume incl. prefetch)

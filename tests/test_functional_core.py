@@ -1,0 +1,584 @@
+"""Functional-core transform equivalence (``nn/core.py``).
+
+The contract this file pins: the whole-net transforms — scan-over-
+layers, activation remat, dynamic loss scaling — may change the
+COMPILED PROGRAM (its HLO size, its memory plan, its f16 dynamic
+range) but never WHAT IS TRAINED. Trajectories are asserted BITWISE
+with each transform on vs off, on BOTH engines, through the per-step
+path, the scan-fused multi-step, the device-cached multi-epoch
+replay, resume-from-checkpoint, and AOT export/install of the
+transformed step. Reduction-heavy blocks (layernorm/softmax in
+TransformerBlock) are the one documented exception: XLA fuses
+grad-of-scan differently from grad-of-unrolled, so their backward
+may differ at float-ulp level — the forward stays bitwise and the
+trajectory is asserted to tight tolerance.
+
+Also covered: run/chain detection rules, the DAG engine's new
+divergence-guard + step-telemetry support (it inherited them from
+the core step builder), loss-scale overflow dynamics, the transform
+telemetry gauges, and the ``scripts/lint_parity.py`` gate itself.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    DenseLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.resilience.checkpoint import CheckpointManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixtures / builders
+# ---------------------------------------------------------------------------
+
+
+def _mlp(depth=5, width=16, seed=7, **transforms):
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .learning_rate(0.1).list())
+    for _ in range(depth):
+        b.layer(DenseLayer(n_in=width, n_out=width, activation="tanh"))
+    b.layer(OutputLayer(n_in=width, n_out=4))
+    net = MultiLayerNetwork(b.build()).init()
+    if transforms:
+        net.set_transforms(**transforms)
+    return net
+
+
+def _chain_graph(depth=4, width=12, seed=9, **transforms):
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .learning_rate(0.1).graph_builder().add_inputs("in"))
+    prev = "in"
+    for i in range(depth):
+        b.add_layer(f"d{i}", DenseLayer(n_in=width, n_out=width,
+                                        activation="tanh"), prev)
+        prev = f"d{i}"
+    b.add_layer("out", OutputLayer(n_in=width, n_out=3), prev)
+    b.set_outputs("out")
+    g = ComputationGraph(b.build()).init()
+    if transforms:
+        g.set_transforms(**transforms)
+    return g
+
+
+def _batches(n, batch, width, classes, seed=0):
+    r = np.random.RandomState(seed)
+    return [
+        DataSet(
+            features=r.randn(batch, width).astype(np.float32),
+            labels=np.eye(classes, dtype=np.float32)[
+                r.randint(0, classes, batch)
+            ],
+        )
+        for _ in range(n)
+    ]
+
+
+def _flat(net):
+    return net.params_flat()
+
+
+# ---------------------------------------------------------------------------
+# run / chain detection rules
+# ---------------------------------------------------------------------------
+
+
+def test_detect_layer_runs_rules():
+    d = DenseLayer(n_in=8, n_out=8, activation="tanh")
+    other = DenseLayer(n_in=8, n_out=8, activation="relu")
+    out = OutputLayer(n_in=8, n_out=2)
+    # maximal homogeneous run, loss head excluded
+    assert core.detect_layer_runs([d, d, d, out]) == [(0, 3)]
+    # a config change splits the run
+    assert core.detect_layer_runs([d, d, other, d, d, out]) == [
+        (0, 2), (3, 5)
+    ]
+    # an inner preprocessor breaks the run; one on the head does not
+    assert core.detect_layer_runs([d, d, d], preprocessors={1: object()}
+                                  ) == [(1, 3)]
+    assert core.detect_layer_runs([d, d, d], preprocessors={0: object()}
+                                  ) == [(0, 3)]
+    # batch statistics (running-stats state) are never scanned
+    bn = BatchNormalization(n_out=8)
+    assert core.detect_layer_runs([bn, bn, bn]) == []
+    # layer names don't matter — config identity does
+    import dataclasses
+
+    named = [dataclasses.replace(d, name=f"l{i}") for i in range(3)]
+    assert core.detect_layer_runs(named) == [(0, 3)]
+
+
+def test_detect_vertex_chains_rules():
+    g = _chain_graph(depth=4)
+    assert core.detect_vertex_chains(g.conf, g.topo) == [(0, 4)]
+    # fan-out from an inner member breaks the chain there
+    b = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+         .graph_builder().add_inputs("in"))
+    b.add_layer("d0", DenseLayer(n_in=8, n_out=8, activation="tanh"),
+                "in")
+    b.add_layer("d1", DenseLayer(n_in=8, n_out=8, activation="tanh"),
+                "d0")
+    b.add_layer("side", DenseLayer(n_in=8, n_out=8,
+                                   activation="tanh"), "d0")
+    from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+
+    b.add_vertex("merge", MergeVertex(), "d1", "side")
+    b.add_layer("out", OutputLayer(n_in=16, n_out=2), "merge")
+    b.set_outputs("out")
+    conf = b.build()
+    chains = core.detect_vertex_chains(conf, conf.topological_order())
+    assert (0, 2) not in chains  # d0 feeds two consumers
+
+
+def test_scan_run_count_signal():
+    net = _mlp(depth=5)
+    assert net.scan_layer_run_count() == 0  # transform off
+    net.set_transforms(scan_layers=True)
+    assert net.scan_layer_run_count() == 1
+    g = _chain_graph(scan_layers=True)
+    assert g.scan_layer_run_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# bitwise trajectory equivalence (the refactor/transform contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("transforms", [
+    {"scan_layers": True},
+    {"remat": "full"},
+    {"remat": "dots_saveable"},
+    {"scan_layers": True, "remat": "full"},
+])
+def test_mln_transform_bitwise_trajectory(transforms):
+    """Dense homogeneous stack: N steps over 2 epochs (exercises the
+    scan-fused multi-step AND the device-cached replay) are bitwise
+    identical with the transform on vs off."""
+    data = _batches(4, 8, 16, 4)
+    ref = _mlp()
+    ref.fit(data, epochs=2)
+    net = _mlp(**transforms)
+    net.fit(data, epochs=2)
+    assert np.array_equal(_flat(net), _flat(ref))
+
+
+@pytest.mark.parametrize("transforms", [
+    {"scan_layers": True},
+    {"remat": "full"},
+    {"scan_layers": True, "remat": "dots_saveable"},
+])
+def test_graph_transform_bitwise_trajectory(transforms):
+    data = _batches(4, 8, 12, 3, seed=1)
+    ref = _chain_graph()
+    ref.fit(data, epochs=2)
+    g = _chain_graph(**transforms)
+    g.fit(data, epochs=2)
+    assert np.array_equal(_flat(g), _flat(ref))
+
+
+def test_mln_per_step_vs_fused_scan_bitwise():
+    """Behavior-neutrality of the core fit drivers: the per-step loop
+    (fit_minibatch) and the scan-fused epoch (core.build_multi_step)
+    still produce bit-identical trajectories through the core."""
+    data = _batches(6, 8, 16, 4, seed=2)
+    a = _mlp()
+    for ds in data:
+        a.fit_minibatch(ds)
+    b = _mlp()
+    b.fit(data, epochs=1)  # scan_chunk=16 fuses all 6 steps
+    assert np.array_equal(_flat(a), _flat(b))
+
+
+def test_transformer_scan_forward_bitwise_trajectory_close():
+    """TransformerBlock runs: the scanned forward is BITWISE equal to
+    the unrolled one; the trajectory matches to float-ulp tolerance
+    (XLA fuses grad-of-scan differently around layernorm/softmax
+    reductions — the documented exception to bitwise)."""
+    from deeplearning4j_tpu.zoo.models import transformer_lm
+
+    conf = transformer_lm(vocab=11, d_model=16, n_layers=3, n_heads=2)
+    r = np.random.RandomState(4)
+    x = r.randn(2, 11, 6).astype(np.float32)
+    y = np.eye(11, dtype=np.float32)[
+        r.randint(0, 11, (2, 6))
+    ].transpose(0, 2, 1)
+
+    ref = MultiLayerNetwork(conf).init()
+    net = MultiLayerNetwork(conf).init().set_transforms(
+        scan_layers=True
+    )
+    assert net._active_layer_runs() == ((2, 5),)
+    assert np.array_equal(
+        np.asarray(ref.output(x)), np.asarray(net.output(x))
+    )
+    for _ in range(3):
+        ref.fit_minibatch(DataSet(features=x, labels=y))
+        net.fit_minibatch(DataSet(features=x, labels=y))
+    np.testing.assert_allclose(
+        _flat(net), _flat(ref), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_feed_forward_unaffected_by_scan():
+    """Callers that need every per-layer activation bypass the scan:
+    same values, full coverage."""
+    net = _mlp(scan_layers=True)
+    x = np.random.RandomState(5).randn(4, 16).astype(np.float32)
+    acts = net.feed_forward(x)
+    assert len(acts) == 6  # every layer materialized
+    g = _chain_graph(scan_layers=True)
+    xg = np.random.RandomState(5).randn(4, 12).astype(np.float32)
+    values = g.feed_forward(xg)
+    assert set(values) == {"in", "d0", "d1", "d2", "d3", "out"}
+
+
+def test_rnn_time_step_skips_scan_with_live_state():
+    """Streaming KV caches make a run's state non-empty — the scan
+    must fall back to the unrolled walk, bitwise."""
+    from deeplearning4j_tpu.zoo.models import transformer_lm
+
+    conf = transformer_lm(vocab=7, d_model=8, n_layers=2, n_heads=2)
+    r = np.random.RandomState(6)
+    steps = [r.randn(1, 7).astype(np.float32) for _ in range(3)]
+    ref = MultiLayerNetwork(conf).init()
+    net = MultiLayerNetwork(conf).init().set_transforms(
+        scan_layers=True
+    )
+    for s in steps:
+        a = np.asarray(ref.rnn_time_step(s))
+        b = np.asarray(net.rnn_time_step(s))
+        assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# resume-from-checkpoint with transforms
+# ---------------------------------------------------------------------------
+
+
+def test_resume_with_transforms_bitwise(tmp_path):
+    """Transforms are runtime knobs, not checkpoint identity: a
+    checkpoint written with them OFF resumes with them ON, and the
+    continued trajectory is bitwise the uninterrupted one (both
+    engines)."""
+    data = _batches(6, 8, 16, 4, seed=3)
+    ref = _mlp()
+    for ds in data:
+        ref.fit_minibatch(ds)
+
+    first = _mlp()
+    for ds in data[:3]:
+        first.fit_minibatch(ds)
+    mgr = CheckpointManager(tmp_path / "mln")
+    mgr.save(first)
+
+    resumed = _mlp(scan_layers=True, remat="full")
+    step = resumed.resume(mgr)
+    assert step == 3
+    for ds in data[3:]:
+        resumed.fit_minibatch(ds)
+    assert np.array_equal(_flat(resumed), _flat(ref))
+
+    gdata = _batches(6, 8, 12, 3, seed=8)
+    gref = _chain_graph()
+    for ds in gdata:
+        gref.fit_minibatch(ds)
+    gfirst = _chain_graph()
+    for ds in gdata[:3]:
+        gfirst.fit_minibatch(ds)
+    gmgr = CheckpointManager(tmp_path / "graph")
+    gmgr.save(gfirst)
+    from deeplearning4j_tpu.resilience.checkpoint import restore_into
+
+    gresumed = _chain_graph(scan_layers=True, remat="dots_saveable")
+    restore_into(gresumed, gmgr)
+    for ds in gdata[3:]:
+        gresumed.fit_minibatch(ds)
+    assert np.array_equal(_flat(gresumed), _flat(gref))
+
+
+# ---------------------------------------------------------------------------
+# AOT export/install of the transformed step
+# ---------------------------------------------------------------------------
+
+
+def test_aot_step_kind_encodes_transforms():
+    net = _mlp()
+    assert net._step_kind() == "step"
+    net.set_transforms(scan_layers=True, remat="full")
+    assert net._step_kind() == "step+scan+remat:full"
+    g = _chain_graph(scan_layers=True)
+    assert g._step_kind() == "step+scan"
+    assert g._output_kind() == "output+scan"
+
+
+def test_aot_transformed_step_fingerprint_mismatch_refused():
+    """An artifact exported with transforms ON must not install into
+    a model running them OFF (different compiled program)."""
+    data = _batches(1, 8, 16, 4)[0]
+    src = _mlp(scan_layers=True)
+    blob = src.aot_export_step(data)
+    plain = _mlp()
+    assert plain.aot_install_step(blob) is False
+    twin = _mlp(scan_layers=True)
+    assert twin.aot_install_step(blob) is True
+
+
+def test_aot_transformed_step_subprocess_trajectory():
+    """Export the scan+remat step, install it in a FRESH process
+    (honest restart semantics — jaxlib's deserializer stays out of
+    the long-lived suite process), fit through it, and compare
+    bitwise against the JIT trajectory."""
+    snippet = """
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration as NNC
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.datasets.api import DataSet
+import numpy as np, json
+
+def mlp():
+    b = NNC.Builder().seed(7).learning_rate(0.1).list()
+    for _ in range(4):
+        b.layer(DenseLayer(n_in=10, n_out=10, activation="tanh"))
+    b.layer(OutputLayer(n_in=10, n_out=3))
+    net = MultiLayerNetwork(b.build()).init()
+    net.set_transforms(scan_layers=True, remat="full")
+    return net
+
+r = np.random.RandomState(0)
+data = [DataSet(features=r.randn(6, 10).astype(np.float32),
+                labels=np.eye(3, dtype=np.float32)[
+                    r.randint(0, 3, 6)])
+        for _ in range(4)]
+blob = mlp().aot_export_step(data[0])
+aot = mlp()
+installed = aot.aot_install_step(blob)
+for ds in data:
+    aot.fit_minibatch(ds)
+jit = mlp()
+for ds in data:
+    jit.fit_minibatch(ds)
+print(json.dumps({
+    "installed": bool(installed),
+    "bitwise": bool(np.array_equal(aot.params_flat(),
+                                   jit.params_flat())),
+}))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", snippet], capture_output=True,
+        text=True, env=env, timeout=240,
+    )
+    assert out.returncode == 0, f"child failed:\n{out.stderr[-3000:]}"
+    verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    assert verdict["installed"] is True
+    assert verdict["bitwise"] is True
+
+
+# ---------------------------------------------------------------------------
+# dynamic loss scaling (float16)
+# ---------------------------------------------------------------------------
+
+
+def _f16_net(loss_scale=True, seed=5):
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .learning_rate(0.05).data_type("float32")
+         .compute_data_type("float16").list())
+    b.layer(DenseLayer(n_in=8, n_out=8, activation="tanh"))
+    b.layer(OutputLayer(n_in=8, n_out=3))
+    net = MultiLayerNetwork(b.build()).init()
+    if loss_scale:
+        net.set_transforms(loss_scale=loss_scale)
+    return net
+
+
+def test_loss_scale_off_by_default_and_bf16_unaffected():
+    assert _f16_net(loss_scale=False)._loss_scale_active is False
+    b = (NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+         .compute_data_type("bfloat16").loss_scale(True).list())
+    b.layer(DenseLayer(n_in=4, n_out=4))
+    b.layer(OutputLayer(n_in=4, n_out=2))
+    net = MultiLayerNetwork(b.build()).init()
+    # knob set but compute dtype is bf16 -> scaling never engages
+    assert net._loss_scale_active is False
+
+
+def test_loss_scale_dynamics():
+    """Clean steps count up; a non-finite gradient skips the update
+    in-jit (params unchanged), halves the scale, and counts the
+    overflow — no host round trip in the step itself."""
+    net = _f16_net()
+    r = np.random.RandomState(2)
+    x = r.randn(4, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.randint(0, 3, 4)]
+    for _ in range(3):
+        net.fit_minibatch(DataSet(features=x, labels=y))
+    st = net._loss_scale_state
+    assert float(st["scale"]) == core.DEFAULT_LOSS_SCALE
+    assert int(st["good_steps"]) == 3
+    assert int(st["overflows"]) == 0
+
+    before = _flat(net)
+    net.fit_minibatch(DataSet(features=x * 1e30, labels=y))
+    st = net._loss_scale_state
+    assert float(st["scale"]) == core.DEFAULT_LOSS_SCALE / 2
+    assert int(st["overflows"]) == 1
+    assert int(st["good_steps"]) == 0
+    assert np.array_equal(_flat(net), before)  # update suppressed
+
+    # recovery: clean steps resume counting on the halved scale
+    net.fit_minibatch(DataSet(features=x, labels=y))
+    st = net._loss_scale_state
+    assert int(st["good_steps"]) == 1
+    assert np.isfinite(_flat(net)).all()
+
+
+def test_loss_scale_growth():
+    """growth_interval clean steps double the scale (capped)."""
+    state = core.loss_scale_state(4.0)
+    import jax.numpy as jnp
+
+    state["good_steps"] = jnp.asarray(
+        core.LOSS_SCALE_GROWTH_INTERVAL - 1, jnp.int32
+    )
+    net = _f16_net()
+    net._loss_scale_state = state
+    r = np.random.RandomState(3)
+    x = r.randn(4, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.randint(0, 3, 4)]
+    net.set_transforms(loss_scale=4.0)
+    net._loss_scale_state = state
+    net.fit_minibatch(DataSet(features=x, labels=y))
+    st = net._loss_scale_state
+    assert float(st["scale"]) == 8.0
+    assert int(st["good_steps"]) == 0
+
+
+def test_loss_scale_on_graph_engine():
+    b = (NeuralNetConfiguration.Builder().seed(11).learning_rate(0.05)
+         .compute_data_type("float16").graph_builder()
+         .add_inputs("in"))
+    b.add_layer("h", DenseLayer(n_in=8, n_out=8, activation="tanh"),
+                "in")
+    b.add_layer("out", OutputLayer(n_in=8, n_out=3), "h")
+    b.set_outputs("out")
+    g = ComputationGraph(b.build()).init()
+    g.set_transforms(loss_scale=True)
+    assert g._loss_scale_active
+    r = np.random.RandomState(4)
+    x = r.randn(4, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.randint(0, 3, 4)]
+    g.fit_minibatch(DataSet(features=x, labels=y))
+    g.fit_minibatch(DataSet(features=x * 1e30, labels=y))
+    st = g._loss_scale_state
+    assert int(st["overflows"]) == 1
+    assert float(st["scale"]) == core.DEFAULT_LOSS_SCALE / 2
+
+
+# ---------------------------------------------------------------------------
+# the DAG engine's inherited guard/telemetry (new with the core)
+# ---------------------------------------------------------------------------
+
+
+def test_graph_divergence_guard_via_core():
+    from deeplearning4j_tpu.resilience.guard import DivergenceGuard
+
+    g = _chain_graph()
+    guard = DivergenceGuard(policy="skip")
+    g.set_divergence_guard(guard)
+    r = np.random.RandomState(5)
+    x = r.randn(4, 12).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.randint(0, 3, 4)]
+    g.fit_minibatch(DataSet(features=x, labels=y))
+    before = _flat(g)
+    bad = x.copy()
+    bad[0, 0] = np.nan
+    g.fit_minibatch(DataSet(features=bad, labels=y))
+    assert guard.skipped_steps == 1
+    assert np.array_equal(_flat(g), before)  # suppressed in-jit
+
+
+def test_graph_step_telemetry_via_core():
+    g = _chain_graph()
+    g.enable_step_telemetry(True)
+    r = np.random.RandomState(6)
+    x = r.randn(4, 12).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.randint(0, 3, 4)]
+    g.fit_minibatch(DataSet(features=x, labels=y))
+    assert g._last_grad_norm is not None
+    assert float(g._last_grad_norm) > 0
+
+
+def test_telemetry_transform_gauges():
+    from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+    from deeplearning4j_tpu.observability.runtime import (
+        TelemetryListener,
+    )
+
+    reg = MetricsRegistry()
+    net = _mlp(scan_layers=True, remat="full")
+    net.add_listener(TelemetryListener(
+        registry=reg, frequency=1, publish_memory=False,
+        defer_reads=False,
+    ))
+    ds = _batches(1, 8, 16, 4)[0]
+    net.fit_minibatch(ds)
+    assert reg.get("remat_enabled")._default().value == 1.0
+    assert reg.get("scan_layer_runs")._default().value == 1.0
+
+
+# ---------------------------------------------------------------------------
+# knob plumbing / parity gate
+# ---------------------------------------------------------------------------
+
+
+def test_set_transforms_invalidates_programs():
+    net = _mlp()
+    ds = _batches(1, 8, 16, 4)[0]
+    net.fit_minibatch(ds)
+    assert net._jit_step is not None
+    net.set_transforms(scan_layers=True)
+    assert net._jit_step is None and net._jit_output is None
+    with pytest.raises(ValueError):
+        net.set_transforms(remat="bogus")
+
+
+def test_builder_hints_seed_model_knobs():
+    b = (NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+         .scan_layers(True).remat("dots_saveable").list())
+    b.layer(DenseLayer(n_in=4, n_out=4))
+    b.layer(OutputLayer(n_in=4, n_out=2))
+    conf = b.build()
+    net = MultiLayerNetwork(conf)
+    assert net.scan_layers is True and net.remat == "dots_saveable"
+    # hints are NOT serialized — checkpoint/config identity unchanged
+    assert "scan_layers" not in conf.to_dict()
+    assert "remat" not in conf.to_dict()
+
+
+def test_lint_parity_gate():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "lint_parity.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
