@@ -1022,6 +1022,117 @@ def bench_dp_scaling(batch=64, steps=4, budget_s=None) -> dict:
     }
 
 
+_ELASTIC_CHILD = r"""
+import json, os, time
+import numpy as np
+from __graft_entry__ import _ensure_devices
+_ensure_devices(8)
+import jax
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import ElasticTrainer, build_mesh
+from deeplearning4j_tpu.resilience import (CheckpointManager,
+    PreemptionHandler, PreemptedException)
+
+conf = (NeuralNetConfiguration.Builder().seed(7).learning_rate(0.05)
+        .updater("ADAM").list()
+        .layer(DenseLayer(n_in=32, n_out=64, activation="tanh"))
+        .layer(OutputLayer(n_out=8)).build())
+net = MultiLayerNetwork(conf).init()
+rng = np.random.RandomState(0)
+bs = [DataSet(features=rng.rand(16, 32).astype(np.float32),
+              labels=np.eye(8, dtype=np.float32)[
+                  rng.randint(0, 8, 16)])
+      for _ in range(12)]
+
+et = ElasticTrainer(net, mesh=build_mesh(), snapshot_every=4)
+marks = {}
+orig_recover = et.recover
+def timed_recover(dead):
+    marks["step_at_kill"] = int(net.iteration_count)
+    marks["t_kill"] = time.perf_counter()
+    snap = orig_recover(dead)
+    marks["snap_step"] = snap["step"]
+    marks["t_recovered"] = time.perf_counter()
+    return snap
+et.recover = timed_recover
+class _Inject:
+    def iteration_done(self, model, it):
+        if it == 6 and "injected" not in marks:
+            marks["injected"] = True
+            et.inject_device_loss([4, 5, 6, 7])
+        elif et.recoveries and "t_first_step" not in marks:
+            # first completed optimizer step on the survivor mesh
+            marks["t_first_step"] = time.perf_counter()
+net.listeners.append(_Inject())
+et.fit(bs, epochs=1)
+
+# the other half of the crash story: preemption notice -> quiesced
+# emergency checkpoint (drain + atomic save) latency
+import tempfile
+mgr = CheckpointManager(tempfile.mkdtemp())
+h = PreemptionHandler(manager=mgr).install()
+h.notify("bench")
+t0 = time.perf_counter()
+try:
+    et.fit(bs, epochs=1)
+    ckpt_s = None
+except PreemptedException:
+    ckpt_s = time.perf_counter() - t0
+h.uninstall()
+
+print(json.dumps({
+    "recovery_s": round(marks["t_recovered"] - marks["t_kill"], 4),
+    "time_to_first_step_s": round(
+        marks["t_first_step"] - marks["t_kill"], 4),
+    "steps_lost": marks["step_at_kill"] - marks["snap_step"],
+    "snapshot_every": 4,
+    "devices_before": 8, "devices_after": 4,
+    "final_step": int(net.iteration_count),
+    "emergency_checkpoint_s": (round(ckpt_s, 4)
+                               if ckpt_s is not None else None),
+}))
+"""
+
+
+def bench_elastic_recovery(budget_s=None) -> dict:
+    """Device-loss recovery latency on the 8-device virtual CPU mesh:
+    kill half the mesh mid-run, measure declared-dead ->
+    survivor-mesh rebuild (``recovery_s``) and -> first completed
+    optimizer step on the survivors (``time_to_first_step_s``, which
+    includes the re-jit for the new mesh). ``steps_lost`` must stay
+    under ``snapshot_every`` — recovery replays from the host-RAM
+    snapshot ring, no disk I/O. Also reports the preemption half:
+    notice -> drained emergency checkpoint wall time."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE or "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.abspath(__file__))]
+            + env.get("PYTHONPATH", "").split(os.pathsep)
+        ),
+    })
+    timeout = 900
+    if budget_s is not None:
+        timeout = max(60, min(timeout, int(budget_s)))
+    out = subprocess.run(
+        [sys.executable, "-c", _ELASTIC_CHILD], env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"elastic child failed: {out.stderr[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------------------------------------
 # 8. Serving micro-batch throughput (scripts/bench_serving.py)
 # ---------------------------------------------------------------------------
@@ -1355,6 +1466,12 @@ def _section_table(budget_fn):
         ("dp_scaling", lambda: bench_dp_scaling(budget_s=budget_fn()),
          "dp sharding-overhead efficiency, fixed global batch "
          "(8 virtual cpu devices; 1.0 = zero overhead)"),
+        ("elastic_recovery",
+         lambda: bench_elastic_recovery(budget_fn()),
+         "device-loss -> survivor-mesh recovery latency, kill half "
+         "the 8-device virtual mesh mid-run (host-RAM snapshot "
+         "ring; steps_lost < snapshot_every is the gate), plus "
+         "preemption-notice -> emergency-checkpoint wall time"),
         ("serving_microbatch",
          lambda: bench_serving(budget_fn()),
          "batched-vs-solo serving req/s at concurrency 32 "

@@ -107,10 +107,13 @@ class AsyncDataSetIterator(DataSetIterator):
         self._started = False
         self._next_item = None
 
-    def shutdown(self) -> None:
+    def shutdown(self, timeout: float = 5.0) -> None:
         """Cancel and join the worker (reference ``shutdown()``). Safe
         to call mid-stream: the producer observes the stop flag instead
-        of blocking on a full queue."""
+        of blocking on a full queue. The join is bounded by
+        ``timeout`` seconds — a worker that refuses to die raises
+        instead of hanging the caller (the preemption path runs this
+        inside a grace window)."""
         if self._thread is not None and self._thread.is_alive():
             self._stop.set()
             # unblock a producer stuck between puts
@@ -119,7 +122,7 @@ class AsyncDataSetIterator(DataSetIterator):
                     self._queue.get_nowait()
             except queue.Empty:
                 pass
-            self._thread.join(timeout=5.0)
+            self._thread.join(timeout=timeout)
             if self._thread.is_alive():  # pragma: no cover
                 raise RuntimeError("AsyncDataSetIterator worker leaked")
         self._thread = None

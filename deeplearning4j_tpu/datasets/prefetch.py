@@ -142,6 +142,29 @@ class PrefetchIterator(AsyncDataSetIterator):
                 f"prefetch pipeline failed: {type(e).__name__}: {e}"
             ) from e
 
+    def shutdown(self, timeout: float = 5.0,
+                 raise_pending: bool = False) -> None:
+        """Cancel and join the worker within ``timeout`` seconds.
+
+        With ``raise_pending=True`` (the preemption path) a worker
+        fault that was queued for delivery but never consumed — the
+        consumer is shutting down early, so ``next()`` would never
+        surface it — re-raises here as ``DL4JFaultException`` AFTER
+        the join, so the fault is neither lost nor racing a live
+        worker. The default (False) keeps ``close()``/``reset()``
+        unwind-safe: raising from a ``finally`` would mask the
+        original exception."""
+        super().shutdown(timeout=timeout)
+        if raise_pending:
+            exc = self._pending_exc or self._exception
+            self._pending_exc = None
+            self._exception = None
+            if exc is not None:
+                raise DL4JFaultException(
+                    f"prefetch worker fault pending at shutdown: "
+                    f"{type(exc).__name__}: {exc}"
+                ) from exc
+
     def close(self) -> None:
         """Alias for ``shutdown()`` (context-manager friendly)."""
         self.shutdown()

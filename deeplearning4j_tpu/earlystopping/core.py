@@ -261,8 +261,14 @@ class EarlyStoppingTrainer:
         """One epoch; returns the tripped iteration-termination
         condition or None. Subclasses replace the training mechanics
         (parallel wrapper / cluster master) but share the loop."""
+        from deeplearning4j_tpu.resilience import preemption
+
         cfg = self.config
         for ds in self.train_iterator:
+            # preemption notice -> emergency checkpoint (the per-epoch
+            # manager if configured, else the handler's) + raise
+            preemption.check_fit(self.model,
+                                 manager=cfg.checkpoint_manager)
             self.model.fit_minibatch(ds)
             for c in cfg.iteration_terminations:
                 if c.terminate(self.model.score_value):
@@ -280,7 +286,13 @@ class EarlyStoppingTrainer:
         scores: dict = {}
         epoch = 0
         reason, details = "MaxEpochs", "exhausted"
+        from deeplearning4j_tpu.resilience import preemption
+
         while True:
+            # epoch boundary check covers subclasses whose
+            # _train_epoch replaces the minibatch loop
+            preemption.check_fit(self.model,
+                                 manager=cfg.checkpoint_manager)
             stop_iter = self._train_epoch()
             if hasattr(self.train_iterator, "reset"):
                 self.train_iterator.reset()

@@ -150,10 +150,20 @@ class ContinualTrainer:
         (tests and budget-boxed demos); ``publish_trailing`` also
         publishes a final partial window so a drained stream never
         strands unpublished progress."""
+        from deeplearning4j_tpu.resilience import preemption
+
         fit = (self.trainer.fit_minibatch if self.trainer is not None
                else self.model.fit_minibatch)
         consumed = 0
         for ds in self._iter(stream):
+            # preemption notice -> emergency publish through THIS
+            # trainer's publish() (AOT artifacts attached, journal
+            # retention honored), then PreemptedException
+            preemption.check_fit(
+                self.model, checkpoint_fn=self.publish,
+                prefetch=stream
+                if hasattr(stream, "shutdown") else None,
+            )
             fit(ds)
             consumed += 1
             self._m_steps.inc()

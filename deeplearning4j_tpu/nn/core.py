@@ -918,11 +918,17 @@ def fit_epoch_scan(model, it) -> int:
     an input pipeline) feed the dispatch directly."""
     from deeplearning4j_tpu.datasets.api import ChunkedDataSet
 
+    from deeplearning4j_tpu.resilience import preemption
+
     model._reset_recurrent_state()  # scan carries empty rnn entries
     buf: List[Any] = []
     sig = None
     n = 0
     for ds in it:
+        # chunk boundary is the scan path's step boundary: an
+        # un-flushed buffer holds no dispatched work, so an emergency
+        # checkpoint here is consistent at the last flushed step
+        preemption.check_fit(model)
         if isinstance(ds, ChunkedDataSet):
             if buf:
                 flush_scan_chunk(model, buf)
@@ -969,7 +975,10 @@ def fit_epochs_device_cached(model, iterator, epochs: int, arrays_of,
             if hasattr(listener, "on_epoch_start"):
                 listener.on_epoch_start(model)
         model._reset_recurrent_state()
+        from deeplearning4j_tpu.resilience import preemption
+
         for kind, item, last in plan:
+            preemption.check_fit(model)
             if kind == "chunk":
                 if _wants_last_features(model):
                     model._last_features = last.features
@@ -1009,6 +1018,7 @@ def fit_batches(model, iterator, epochs: int) -> None:
     from deeplearning4j_tpu.parallel.dispatch import (
         AsyncDispatchWindow,
     )
+    from deeplearning4j_tpu.resilience import preemption
 
     window = AsyncDispatchWindow(
         model=model,
@@ -1029,6 +1039,14 @@ def fit_batches(model, iterator, epochs: int) -> None:
                 model._dispatch_window = window
                 try:
                     for ds in it:
+                        # preemption notice -> drain + emergency
+                        # checkpoint + PreemptedException (prefetch
+                        # sources are shut down with a bounded join)
+                        preemption.check_fit(
+                            model, window=window,
+                            prefetch=iterator
+                            if hasattr(iterator, "shutdown") else None,
+                        )
                         model.fit_minibatch(ds)
                         n_batches += 1
                 finally:

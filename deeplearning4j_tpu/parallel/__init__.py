@@ -46,6 +46,13 @@ from deeplearning4j_tpu.parallel.sequence import (  # noqa: F401
 from deeplearning4j_tpu.parallel.dispatch import (  # noqa: F401
     AsyncDispatchWindow,
 )
+from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
+    DeviceLostException,
+    ElasticTrainer,
+    HeartbeatMonitor,
+    SnapshotRing,
+    StragglerDetector,
+)
 from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
     DistributedTrainer,
     default_partition_rules,

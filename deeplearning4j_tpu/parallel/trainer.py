@@ -632,6 +632,7 @@ class DistributedTrainer:
         from deeplearning4j_tpu.parallel.dispatch import (
             AsyncDispatchWindow,
         )
+        from deeplearning4j_tpu.resilience import preemption
 
         m = self.model
         source = iterator
@@ -661,6 +662,14 @@ class DistributedTrainer:
                 scores = []
                 try:
                     for ds in iter(source):
+                        # preemption notice -> drain window + shut
+                        # down the prefetch worker + emergency
+                        # checkpoint, then PreemptedException
+                        preemption.check_fit(
+                            m, window=window,
+                            prefetch=source
+                            if hasattr(source, "shutdown") else None,
+                        )
                         scores.append(
                             self.fit_minibatch(ds, _window=window)
                         )

@@ -29,7 +29,12 @@ Four cooperating pieces:
   seeded failure schedules, ``FaultyObjectStore``, ``FlakyIterator``;
 - **divergence guard** (``guard.py``): in-step NaN/Inf detection on
   loss + gradient global-norm with skip-step or
-  rollback-to-last-checkpoint policies.
+  rollback-to-last-checkpoint policies;
+- **preemption handling** (``preemption.py``): ``PreemptionHandler``
+  — SIGTERM/SIGINT (or a simulated notice) -> atomic flag -> drain +
+  emergency checkpoint + ``PreemptedException`` at the next step
+  boundary, with documented exit codes (``EXIT_PREEMPTED`` /
+  ``EXIT_PREEMPTED_DIRTY``) and serving-drain callbacks.
 """
 
 from deeplearning4j_tpu.resilience.breaker import (  # noqa: F401
@@ -53,6 +58,15 @@ from deeplearning4j_tpu.resilience.checkpoint import (  # noqa: F401
 )
 from deeplearning4j_tpu.resilience.guard import (  # noqa: F401
     DivergenceGuard,
+)
+from deeplearning4j_tpu.resilience.preemption import (  # noqa: F401
+    EXIT_PREEMPTED,
+    EXIT_PREEMPTED_DIRTY,
+    PreemptedException,
+    PreemptionHandler,
+    active_handler,
+    exit_on_preemption,
+    preemption_requested,
 )
 from deeplearning4j_tpu.resilience.retry import (  # noqa: F401
     RetryPolicy,

@@ -34,7 +34,14 @@ class RetryPolicy:
     """Exponential backoff: attempt ``i`` (0-based) sleeps
     ``min(base_delay * multiplier**i, max_delay)`` scaled by a random
     factor in ``[1 - jitter, 1]`` (full-jitter-style decorrelation so a
-    fleet of preempted workers doesn't thundering-herd the store)."""
+    fleet of preempted workers doesn't thundering-herd the store).
+
+    ``total_timeout`` bounds the WALL time of the whole retry loop
+    (attempts + backoff sleeps) as a per-call ``Deadline``; it
+    composes with an explicit ``retry_call(deadline=)`` — whichever
+    budget is tighter wins — so a retry storm can never overrun the
+    request deadline it runs under. ``clock`` is injectable for
+    deterministic deadline tests."""
 
     max_attempts: int = 5
     base_delay: float = 0.1
@@ -44,12 +51,16 @@ class RetryPolicy:
     retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON
     sleep: Callable[[float], None] = time.sleep
     seed: Optional[int] = None
+    total_timeout: Optional[float] = None
+    clock: Callable[[], float] = time.monotonic
     _rng: random.Random = field(init=False, repr=False, compare=False,
                                 default=None)
 
     def __post_init__(self):
         if self.max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
+        if self.total_timeout is not None and self.total_timeout <= 0:
+            raise ValueError("total_timeout must be > 0 (or None)")
         self._rng = random.Random(self.seed)
 
     def delay_for(self, attempt: int) -> float:
@@ -62,18 +73,34 @@ class RetryPolicy:
 
 
 def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
-               **kwargs):
+               deadline=None, **kwargs):
     """Call ``fn(*args, **kwargs)``, retrying allowlisted exceptions
     under ``policy`` (default ``RetryPolicy()``). Non-allowlisted
     exceptions propagate on the first occurrence; an exhausted budget
-    raises ``RetryExhaustedException`` chained to the last cause."""
+    raises ``RetryExhaustedException`` chained to the last cause.
+
+    ``deadline`` (a ``resilience.Deadline``, e.g. the serving tier's
+    per-request budget) and ``policy.total_timeout`` bound the loop's
+    wall time: an attempt never STARTS past the deadline, and a
+    backoff sleep that would overrun it raises
+    ``DeadlineExceededException`` immediately (chained to the last
+    failure) instead of burning the remaining budget asleep.
+    ``DeadlineExceededException`` is deliberately not a
+    ``TimeoutError``, so it is never itself retried."""
     from deeplearning4j_tpu.observability.trace import get_tracer
+    from deeplearning4j_tpu.resilience.deadline import Deadline
 
     policy = policy or RetryPolicy()
+    deadlines = [] if deadline is None else [deadline]
+    if policy.total_timeout is not None:
+        deadlines.append(Deadline.after(policy.total_timeout,
+                                        clock=policy.clock))
     tracer = get_tracer()
     name = str(getattr(fn, "__name__", fn))
     last: Optional[BaseException] = None
     for attempt in range(policy.max_attempts):
+        for d in deadlines:
+            d.check(name)
         try:
             return fn(*args, **kwargs)
         except policy.retry_on as e:  # noqa: PERF203 — the point
@@ -81,6 +108,29 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
             if attempt + 1 >= policy.max_attempts:
                 break
             delay = policy.delay_for(attempt)
+            bounded = [d for d in deadlines
+                       if d.remaining() is not None]
+            if bounded:
+                tightest = min(bounded, key=lambda d: d.remaining())
+                if tightest.remaining() <= delay:
+                    tracer.event("retry.deadline", attrs={
+                        "fn": name, "attempt": attempt + 1,
+                        "backoff_s": round(delay, 6),
+                        "remaining_s": round(
+                            tightest.remaining(), 6),
+                    })
+                    from deeplearning4j_tpu.exceptions import (
+                        DeadlineExceededException,
+                    )
+
+                    raise DeadlineExceededException(
+                        f"{name} backoff ({delay:.3f}s before "
+                        f"attempt {attempt + 2}) would overrun the "
+                        f"deadline ({max(tightest.remaining(), 0.0):.3f}s "
+                        "left)",
+                        elapsed=tightest.elapsed(),
+                        budget=tightest.budget,
+                    ) from e
             tracer.event("retry.attempt", attrs={
                 "fn": name, "attempt": attempt + 1,
                 "error": type(e).__name__,

@@ -29,19 +29,34 @@ from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 
 
 class RetryingObjectStore(ObjectStore):
+    """``deadline_fn`` (optional) supplies the CURRENT request's
+    ``Deadline`` per operation — the serving tier threads its
+    admission-stamped budget here so a storage retry storm can never
+    overrun the 504 envelope: attempts stop (and backoff sleeps are
+    refused) the moment they would exceed the request budget,
+    surfacing ``DeadlineExceededException`` instead of a late
+    success nobody is waiting for. ``policy.total_timeout`` composes
+    on top as a per-call wall bound independent of any request."""
+
     def __init__(self, inner: ObjectStore,
                  policy: Optional[RetryPolicy] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 deadline_fn=None):
         self.inner = inner
         self.policy = policy or RetryPolicy()
         self.breaker = breaker
+        self.deadline_fn = deadline_fn
 
     def _call(self, fn, *args):
+        deadline = (self.deadline_fn()
+                    if self.deadline_fn is not None else None)
         if self.breaker is not None:
             return self.breaker.call(
-                retry_call, fn, *args, policy=self.policy
+                retry_call, fn, *args, policy=self.policy,
+                deadline=deadline,
             )
-        return retry_call(fn, *args, policy=self.policy)
+        return retry_call(fn, *args, policy=self.policy,
+                          deadline=deadline)
 
     def keys(self, prefix: str = "") -> List[str]:
         return self._call(self.inner.keys, prefix)

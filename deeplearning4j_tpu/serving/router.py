@@ -205,6 +205,21 @@ class ServingRouter:
             self._thread.join(timeout=5)
         self._httpd.server_close()
 
+    def install_preemption_drain(self, handler=None) -> "ServingRouter":
+        """Translate a preemption notice (SIGTERM/SIGINT or a
+        simulated one) into this router's shutdown: in-flight
+        forwards complete (``_httpd.shutdown`` waits out active
+        handlers), then the listener closes. Uses the active
+        ``resilience.preemption.PreemptionHandler``, installing a
+        default one if none exists."""
+        from deeplearning4j_tpu.resilience import preemption
+
+        h = handler if handler is not None else preemption.active_handler()
+        if h is None:
+            h = preemption.PreemptionHandler().install()
+        h.on_preemption(lambda reason: self.stop())
+        return self
+
     # -- health ---------------------------------------------------------
 
     def _next_interval(self) -> float:

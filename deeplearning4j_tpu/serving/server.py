@@ -542,6 +542,26 @@ class ModelServer:
             self.metrics.inflight == 0 and self._queue.empty()
         )
 
+    def install_preemption_drain(self, handler=None,
+                                 drain_timeout: float = 5.0
+                                 ) -> "ModelServer":
+        """Translate a preemption notice (SIGTERM/SIGINT or a
+        simulated one) into the graceful drain above: new work sheds
+        with ``503 draining``, in-flight requests finish, then the
+        listener closes. Uses the active ``resilience.preemption.
+        PreemptionHandler``, installing a default one if none
+        exists — so a bare serving process gets signal handling by
+        calling this once after ``start()``."""
+        from deeplearning4j_tpu.resilience import preemption
+
+        h = handler if handler is not None else preemption.active_handler()
+        if h is None:
+            h = preemption.PreemptionHandler().install()
+        h.on_preemption(
+            lambda reason: self.stop(drain_timeout=drain_timeout)
+        )
+        return self
+
     # -- worker pool ----------------------------------------------------
 
     def _worker_loop(self) -> None:

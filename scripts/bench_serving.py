@@ -200,12 +200,19 @@ def _make_tenant_net(idx, seed=0):
 
 
 def serve_backend(tenants=4, seed=0, workers=4, queue_depth=128,
-                  quota=None, max_device_models=None):
+                  quota=None, max_device_models=None,
+                  preemption_drain=False):
     """``--serve``: one fleet backend process. Serves ``tenants``
     named models (``m0..``) from one ``ModelServer``, prints its port
     as one JSON line, then blocks until stdin closes (the parent's
     handle on our lifetime) — SIGKILL-ing us mid-load is the chaos
-    scenario the router must absorb."""
+    scenario the router must absorb.
+
+    ``--preemption-drain`` installs the resilience tier's
+    ``PreemptionHandler`` and translates SIGTERM/SIGINT into the
+    graceful drain (in-flight requests finish, new work sheds with
+    503), then exits 0 — the preemption-notice chaos storm for the
+    serving tier."""
     from deeplearning4j_tpu.serving import ModelServer
 
     models = {
@@ -217,8 +224,28 @@ def serve_backend(tenants=4, seed=0, workers=4, queue_depth=128,
         max_batch_size=32,
         max_device_models=max_device_models or None,
     ).start()
+    drained = threading.Event()
+    if preemption_drain:
+        from deeplearning4j_tpu.resilience.preemption import (
+            PreemptionHandler,
+        )
+
+        handler = PreemptionHandler().install()
+        server.install_preemption_drain(handler, drain_timeout=10.0)
+        handler.on_preemption(lambda reason: drained.set())
     print(json.dumps({"port": server.port, "pid": os.getpid()}),
           flush=True)
+    if preemption_drain:
+        # stdin EOF (parent died) on a side thread; the main thread
+        # waits for the drain so the process exit code means
+        # "drained cleanly", not "killed mid-request"
+        eof = threading.Thread(target=sys.stdin.read, daemon=True)
+        eof.start()
+        while not drained.is_set() and eof.is_alive():
+            drained.wait(0.05)
+        if not drained.is_set():
+            server.stop(drain_timeout=2)
+        return
     try:
         sys.stdin.read()  # parent closed our stdin: time to go
     except KeyboardInterrupt:
@@ -462,10 +489,14 @@ def main():
     ap.add_argument("--max-device-models", type=int, default=0,
                     help="backend weight-paging budget (0 = no "
                          "paging)")
+    ap.add_argument("--preemption-drain", action="store_true",
+                    help="with --serve: translate SIGTERM/SIGINT "
+                         "into a graceful drain and exit 0")
     args = ap.parse_args()
     if args.serve:
         serve_backend(tenants=args.tenants, seed=args.seed,
-                      max_device_models=args.max_device_models)
+                      max_device_models=args.max_device_models,
+                      preemption_drain=args.preemption_drain)
         return
     if args.fleet:
         print(json.dumps(run_fleet(

@@ -8,8 +8,12 @@
 #
 #   DL4J_TPU_CHAOS_SEED=7 scripts/run_chaos.sh
 #
-# Extra pytest args pass through (e.g. -k retry, -x).
-set -euo pipefail
+# Extra pytest args pass through (e.g. -k retry, -x). Each storm
+# suite runs as its own pytest invocation with a faulthandler
+# timeout (a hung storm dumps every thread's stack instead of dying
+# silently), and the run ends with a per-storm pass/fail summary —
+# the exit code is nonzero iff any storm failed.
+set -uo pipefail
 cd "$(dirname "$0")/.."
 
 export DL4J_TPU_CHAOS_SEED="${DL4J_TPU_CHAOS_SEED:-1337}"
@@ -18,14 +22,16 @@ echo "chaos seed: ${DL4J_TPU_CHAOS_SEED}"
 # Preamble: the metric signal catalog (docs/ARCHITECTURE.md) must
 # match the names registered in code — drift fails loudly here,
 # before the chaos suite spends a second (see scripts/lint_metrics.py).
-python scripts/lint_metrics.py
+python scripts/lint_metrics.py || exit 1
 # ... and both engine wrappers must still delegate their hot paths to
 # the unified functional core, nn/core.py (no reintroduced duplicate
 # step/scan/remat implementations — see scripts/lint_parity.py).
-python scripts/lint_parity.py
-# Registered chaos suites:
+python scripts/lint_parity.py || exit 1
+
+# Registered chaos storms (suite -> what the storm asserts):
 #   tests/test_resilience.py     — training runtime (retry/checkpoint/
-#                                  guard, kill/resume incl. prefetch)
+#                                  guard, kill/resume incl. prefetch,
+#                                  deadline-capped retry storms)
 #   tests/test_serving.py        — serving tier (breaker + fault storms)
 #   tests/test_batching.py       — micro-batch drain loop (seeded storms
 #                                  through the batched path: sequential
@@ -33,7 +39,9 @@ python scripts/lint_parity.py
 #   tests/test_input_pipeline.py — prefetch pipeline (flaky-source
 #                                  storms surface as DL4JFaultException;
 #                                  guarded bad-step trajectory
-#                                  equivalence under async dispatch)
+#                                  equivalence under async dispatch;
+#                                  bounded shutdown re-raises pending
+#                                  worker faults)
 #   tests/test_compile.py        — compile artifacts (corrupted /
 #                                  stale AOT bundles must degrade
 #                                  silently to JIT, never error the
@@ -59,9 +67,55 @@ python scripts/lint_parity.py
 #                                  forward) — plus the traffic-shift
 #                                  regression rollback with zero XLA
 #                                  compiles, counter-asserted
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pytest tests/test_resilience.py tests/test_serving.py \
-    tests/test_batching.py tests/test_input_pipeline.py \
-    tests/test_compile.py tests/test_fleet.py tests/test_loop.py \
-    -q -m chaos \
-    -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+#   tests/test_preemption.py     — preemption notices: SIGTERM
+#                                  mid-epoch with prefetch + async
+#                                  dispatch live -> emergency
+#                                  checkpoint, exit code 75, bitwise
+#                                  resume on both engines; ModelServer
+#                                  + ServingRouter drain with zero 5xx
+#   tests/test_elastic.py        — device loss mid-run -> survivor-
+#                                  mesh recovery from the host-RAM
+#                                  snapshot ring (no steps lost beyond
+#                                  the last snapshot); injected
+#                                  straggler -> straggler_detected_total
+STORMS=(
+    tests/test_resilience.py
+    tests/test_serving.py
+    tests/test_batching.py
+    tests/test_input_pipeline.py
+    tests/test_compile.py
+    tests/test_fleet.py
+    tests/test_loop.py
+    tests/test_preemption.py
+    tests/test_elastic.py
+)
+
+declare -a names rcs
+failed=0
+for storm in "${STORMS[@]}"; do
+    echo
+    echo "=== storm: ${storm} ==="
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python -m pytest "${storm}" \
+        -q -m chaos \
+        -o faulthandler_timeout=300 \
+        -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+    rc=$?
+    # pytest rc 5 = "no tests collected" (e.g. -k filtered a suite
+    # to nothing): not a storm failure
+    if [ "$rc" -eq 5 ]; then rc=0; fi
+    names+=("${storm}")
+    rcs+=("${rc}")
+    if [ "$rc" -ne 0 ]; then failed=1; fi
+done
+
+echo
+echo "=== chaos storm summary (seed ${DL4J_TPU_CHAOS_SEED}) ==="
+for i in "${!names[@]}"; do
+    if [ "${rcs[$i]}" -eq 0 ]; then
+        echo "  PASS  ${names[$i]}"
+    else
+        echo "  FAIL  ${names[$i]} (exit ${rcs[$i]})"
+    fi
+done
+exit "${failed}"

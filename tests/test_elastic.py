@@ -1,0 +1,323 @@
+"""Elastic-training chaos storms (registered in
+``scripts/run_chaos.sh``): device loss mid-run -> survivor-mesh
+recovery from the host-RAM snapshot ring, heartbeat liveness, and
+injected-straggler detection.
+
+The headline storm kills half the mesh mid-epoch and requires the
+recovered run to be *bitwise* identical to a piecewise reference that
+never failed: the same batches trained on the pre-loss mesh up to the
+last snapshot, then on the survivor mesh — proving recovery loses no
+steps beyond the snapshot interval and the trajectory re-derivation
+(step-folded PRNG, lr schedules, updater ``t``) is exact across the
+mesh change.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import conftest
+
+from test_resilience import (
+    assert_updater_state_match,
+    batches as mk_batches,
+    simple_net,
+)
+
+from deeplearning4j_tpu.datasets.api import ListDataSetIterator
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.parallel import (
+    DeviceLostException,
+    DistributedTrainer,
+    ElasticTrainer,
+    HeartbeatMonitor,
+    SnapshotRing,
+    StragglerDetector,
+    build_mesh,
+)
+
+CHAOS_SEED = int(os.environ.get("DL4J_TPU_CHAOS_SEED", "1337"))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- heartbeat liveness -------------------------------------------------
+
+
+def test_heartbeat_silent_shard_declared_dead_once():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    mon = HeartbeatMonitor(["0", "1", "2"], timeout=10.0, clock=clock,
+                           registry=reg)
+    assert mon.dead() == []
+    clock.advance(6.0)
+    mon.beat("0")
+    mon.beat("1")  # shard 2 goes silent
+    clock.advance(6.0)
+    assert mon.dead() == ["2"]
+    assert mon.alive() == ["0", "1"]
+    missed = reg.get("heartbeat_missed_total")
+    assert missed.labels("2").value == 1
+    # repeat polls don't re-count the same death
+    assert mon.dead() == ["2"]
+    assert missed.labels("2").value == 1
+
+
+def test_heartbeat_death_is_sticky_until_reset():
+    clock = FakeClock()
+    mon = HeartbeatMonitor(["0", "1"], timeout=5.0, clock=clock)
+    mon.mark_dead("1")
+    assert mon.dead() == ["1"]
+    mon.beat("1")  # zombie beat: ignored
+    assert mon.dead() == ["1"]
+    with pytest.raises(KeyError):
+        mon.beat("9")
+    with pytest.raises(KeyError):
+        mon.mark_dead("9")
+    mon.reset(["0"])  # survivor set after recovery
+    assert mon.shards == ["0"]
+    assert mon.dead() == []
+
+
+# -- straggler detection ------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_injected_straggler_flagged_with_metric():
+    """The injected-straggler storm: one shard's step times are 4x
+    its peers'. After warmup its EWMA crosses factor x peer-median
+    and ``straggler_detected_total{shard=}`` increments exactly once
+    for the sustained state."""
+    reg = MetricsRegistry()
+    det = StragglerDetector(alpha=0.5, factor=2.0, warmup=3,
+                            registry=reg)
+    for _ in range(4):
+        for s in ("0", "1", "2", "3"):
+            det.observe(s, 0.40 if s == "3" else 0.10)
+        flagged = det.stragglers()
+    assert flagged == ["3"]
+    counter = reg.get("straggler_detected_total")
+    assert counter.labels("3").value == 1
+    det.observe("3", 0.40)
+    assert det.stragglers() == ["3"]
+    assert counter.labels("3").value == 1  # still the same episode
+    # the shard recovers: flag drops, and a relapse counts again
+    for _ in range(8):
+        det.observe("3", 0.10)
+    assert det.stragglers() == []
+    for _ in range(8):
+        det.observe("3", 0.50)
+    assert det.stragglers() == ["3"]
+    assert counter.labels("3").value == 2
+
+
+def test_straggler_needs_warm_peers():
+    det = StragglerDetector(warmup=3, registry=MetricsRegistry())
+    for _ in range(5):
+        det.observe("0", 1.0)
+    assert det.stragglers() == []  # one warm shard: no peer median
+
+
+# -- snapshot ring ------------------------------------------------------
+
+
+def test_snapshot_ring_capacity_and_host_isolation():
+    reg = MetricsRegistry()
+    ring = SnapshotRing(capacity=2, registry=reg)
+    with pytest.raises(DeviceLostException):
+        ring.restore_into_model(simple_net())
+
+    m = simple_net()
+    bs = mk_batches(np.random.RandomState(CHAOS_SEED), 3)
+    ring.push(m, epoch_index=0)
+    snap0 = ring.latest()
+    frozen = {k: np.array(v) for k, v in snap0["params"]["0"].items()}
+    for i, ds in enumerate(bs):
+        m.fit_minibatch(ds)
+        ring.push(m, epoch_index=i + 1)
+    # ring holds only the newest `capacity` snapshots
+    assert len(ring) == 2
+    assert ring.latest()["step"] == 3
+    assert reg.get("snapshot_ring_saves_total").value == 4
+    # the evicted snapshot's arrays were host copies: training after
+    # the push never mutated them
+    for k, v in frozen.items():
+        np.testing.assert_array_equal(v, snap0["params"]["0"][k])
+
+
+def test_snapshot_restore_roundtrip_is_bitwise():
+    m = simple_net()
+    bs = mk_batches(np.random.RandomState(CHAOS_SEED + 1), 6)
+    for ds in bs[:3]:
+        m.fit_minibatch(ds)
+    ring = SnapshotRing(capacity=1, registry=MetricsRegistry())
+    ring.push(m)
+    ref = simple_net()
+    for ds in bs:
+        ref.fit_minibatch(ds)
+    # roll m forward past the snapshot, then restore + replay
+    for ds in bs[3:5]:
+        m.fit_minibatch(ds)
+    snap = ring.restore_into_model(m)
+    assert snap["step"] == m.iteration_count == 3
+    for ds in bs[3:]:
+        m.fit_minibatch(ds)
+    conftest.assert_params_match(m, ref)
+    assert_updater_state_match(m, ref)
+
+
+# -- the device-loss storm ----------------------------------------------
+
+
+class LoseDevicesAt:
+    """Injects loss of ``shards`` once, when the optimizer step
+    counter reaches ``at`` (fire-once: the replayed steps after
+    recovery cross ``at`` again and must not re-kill)."""
+
+    def __init__(self, et, at, shards):
+        self.et = et
+        self.at = at
+        self.shards = shards
+        self.fired = False
+
+    def iteration_done(self, model, it):
+        if it == self.at and not self.fired:
+            self.fired = True
+            self.et.inject_device_loss(self.shards)
+
+
+@pytest.mark.chaos
+def test_chaos_device_loss_recovers_on_survivor_mesh_bitwise():
+    """Kill devices 4-7 mid-epoch (step 6, snapshots every 4). The
+    run must roll back to the step-4 snapshot — losing 2 < 4 steps —
+    rebuild the mesh over survivors 0-3, and finish bitwise-identical
+    to a piecewise reference trained 8-wide to the snapshot and
+    4-wide after it."""
+    conftest.require_devices(8)
+    rng = np.random.RandomState(CHAOS_SEED)
+    bs = mk_batches(rng, n_batches=12, batch=16)
+    reg = MetricsRegistry()
+
+    m = simple_net()
+    et = ElasticTrainer(m, snapshot_every=4, registry=reg)
+    assert len(et.devices()) == 8
+    m.listeners.append(LoseDevicesAt(et, at=6, shards=[4, 5, 6, 7]))
+    scores = et.fit(bs, epochs=1)
+
+    assert et.recoveries == 1
+    assert len(et.devices()) == 4
+    assert {d.id for d in et.devices()} == {0, 1, 2, 3}
+    assert m.iteration_count == 12
+    assert len(scores) == 1 and np.isfinite(scores[0])
+    assert reg.get("elastic_recoveries_total").value == 1
+    assert reg.get("elastic_mesh_devices").value == 4
+    assert reg.get("heartbeat_missed_total").labels("5").value == 1
+    assert reg.get("elastic_recovery_ms").snapshot()["count"] == 1
+
+    # piecewise reference: an unfailed 8-wide run to the snapshot
+    # boundary, then a 4-wide run on the same surviving devices
+    import jax
+
+    ref = simple_net()
+    DistributedTrainer(ref).fit(ListDataSetIterator(bs[:4]), epochs=1)
+    survivors = [d for d in jax.devices() if d.id < 4]
+    tr4 = DistributedTrainer(
+        ref, mesh=build_mesh(data=4, model=1, devices=survivors))
+    tr4.fit(ListDataSetIterator(bs[4:]), epochs=1)
+
+    conftest.assert_params_match(m, ref)
+    assert_updater_state_match(m, ref)
+
+
+@pytest.mark.chaos
+def test_chaos_device_loss_second_epoch_and_steps_lost_bound():
+    """Loss in the SECOND epoch: the epoch-start snapshot bounds the
+    rollback (no cross-epoch replay), and steps lost never exceed
+    the snapshot interval."""
+    conftest.require_devices(8)
+    rng = np.random.RandomState(CHAOS_SEED + 7)
+    bs = mk_batches(rng, n_batches=6, batch=16)
+
+    m = simple_net()
+    et = ElasticTrainer(m, snapshot_every=8)  # only epoch-start snaps
+    m.listeners.append(LoseDevicesAt(et, at=8, shards=[6, 7]))
+    et.fit(bs, epochs=2)
+
+    assert et.recoveries == 1
+    assert len(et.devices()) == 6
+    assert m.iteration_count == 12 and m.epoch_count == 2
+    snap = et.ring.latest()
+    # the recovery snapshot was the second epoch's start (step 6):
+    # 8 - 6 = 2 steps replayed, < snapshot_every
+    assert snap["step"] == 6 and snap["epoch_index"] == 0
+
+
+@pytest.mark.chaos
+def test_chaos_total_loss_is_unrecoverable():
+    conftest.require_devices(2)
+    m = simple_net()
+    et = ElasticTrainer(m, snapshot_every=2)
+    et.ring.push(m)
+    with pytest.raises(DeviceLostException) as e:
+        et.recover([str(d.id) for d in et.devices()])
+    assert e.value.dead  # names the lost shards
+
+
+def test_elastic_rejects_tensor_parallel():
+    with pytest.raises(ValueError, match="data-parallel only"):
+        ElasticTrainer(simple_net(), tensor_parallel=True)
+
+
+@pytest.mark.chaos
+def test_chaos_heartbeat_timeout_triggers_recovery_in_fit():
+    """Death via the timeout path (not injection): shard 3's host
+    stops reporting heartbeats and the fake clock runs past the
+    timeout — the fit loop recovers exactly as for an injected
+    loss."""
+    conftest.require_devices(4)
+    import jax
+
+    clock = FakeClock()
+    m = simple_net()
+    four = sorted(jax.devices(), key=lambda d: d.id)[:4]
+    et = ElasticTrainer(m, mesh=build_mesh(data=4, model=1,
+                                           devices=four),
+                        snapshot_every=4, heartbeat_timeout=30.0,
+                        clock=clock)
+
+    stalled = []
+    real_beat = et.monitor.beat
+
+    def beat(shard, step=None):
+        if stalled and str(shard) == "3":
+            return  # the host stopped reporting
+        real_beat(shard, step)
+
+    et.monitor.beat = beat
+
+    class StallShard:
+        fired = False
+
+        def iteration_done(self, model, it):
+            if it == 2 and not self.fired:
+                self.fired = True
+                stalled.append(True)
+                clock.advance(31.0)  # run the grace period out
+
+    bs = mk_batches(np.random.RandomState(CHAOS_SEED + 9),
+                    n_batches=6, batch=8)
+    m.listeners.append(StallShard())
+    et.fit(bs, epochs=1)
+    assert et.recoveries == 1
+    assert {d.id for d in et.devices()} == {0, 1, 2}
+    assert m.iteration_count == 6

@@ -273,6 +273,78 @@ def test_prefetch_chaos_storm_deterministic(rng):
     np.testing.assert_array_equal(p1, p2)
 
 
+# -- bounded shutdown + pending worker faults (preemption path) ---------
+
+
+@pytest.mark.chaos
+def test_chaos_shutdown_raise_pending_surfaces_parked_fault(rng):
+    """The preemption drain stops consuming early, so a worker fault
+    parked for the NEXT take would vanish: ``shutdown(
+    raise_pending=True)`` re-raises it after the bounded join — the
+    fault is neither lost nor racing a live worker."""
+    data = batches(rng, n_batches=6)
+    chaos = ChaosPolicy(fail_calls={"next": {1}})
+    it = PrefetchIterator(
+        FlakyIterator(ListDataSetIterator(data), chaos),
+        queue_depth=2, registry=MetricsRegistry(),
+    )
+    first = it.next()  # worker is up; the fault lands behind this
+    np.testing.assert_array_equal(first.features, data[0].features)
+    deadline = time.monotonic() + 5
+    while it._exception is None and it._pending_exc is None:
+        assert time.monotonic() < deadline, "worker fault never landed"
+        time.sleep(0.01)
+    with pytest.raises(DL4JFaultException) as ei:
+        it.shutdown(timeout=5.0, raise_pending=True)
+    assert "pending at shutdown" in str(ei.value)
+    assert ei.value.__cause__ is not None
+    assert it._thread is None  # joined before the re-raise
+    # the fault was consumed: a second shutdown is clean
+    it.shutdown(timeout=1.0, raise_pending=True)
+
+
+def test_shutdown_default_swallows_pending_fault(rng):
+    """Default shutdown stays unwind-safe: raising from the finally
+    path would mask the exception that triggered the unwind."""
+    data = batches(rng, n_batches=4)
+    chaos = ChaosPolicy(fail_calls={"next": {0}})
+    it = PrefetchIterator(
+        FlakyIterator(ListDataSetIterator(data), chaos),
+        queue_depth=2, registry=MetricsRegistry(),
+    )
+    assert it.has_next()  # the parked fault IS the pending next()
+    it.shutdown(timeout=5.0)  # must not raise
+    assert it._thread is None
+
+
+def test_shutdown_timeout_bounds_join(rng):
+    """``shutdown(timeout=)`` bounds the join: a worker wedged in a
+    slow source read past the budget raises instead of hanging the
+    caller's grace window; a later generous shutdown reaps it."""
+
+    class Wedged:
+        def __init__(self, items):
+            self.items = items
+
+        def __iter__(self):
+            for ds in self.items:
+                time.sleep(0.3)
+                yield ds
+
+        def reset(self):
+            pass
+
+    it = PrefetchIterator(Wedged(batches(rng, n_batches=50)),
+                          queue_depth=2, registry=MetricsRegistry())
+    assert it.has_next()
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="worker leaked"):
+        it.shutdown(timeout=0.01)
+    assert time.monotonic() - t0 < 2.0  # bounded, not wedged
+    it.shutdown(timeout=5.0)  # the worker observed stop by now
+    assert it._thread is None
+
+
 # -- trajectory equivalence ---------------------------------------------
 
 

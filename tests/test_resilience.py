@@ -147,6 +147,114 @@ def test_deterministic_jitter_replays():
     assert d1 == d2
 
 
+# -- deadline-capped retry ----------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+@pytest.mark.chaos
+def test_chaos_retry_stops_when_backoff_would_overrun_deadline():
+    """A retry storm under a request deadline must give the budget
+    back instead of burning it asleep: when the next backoff exceeds
+    the remaining budget, the loop raises DeadlineExceededException
+    chained to the last transient fault — after only the attempts the
+    budget actually afforded."""
+    from deeplearning4j_tpu.exceptions import DeadlineExceededException
+    from deeplearning4j_tpu.resilience.deadline import Deadline
+
+    clock = _FakeClock()
+    calls = []
+
+    def always(**_):
+        calls.append(clock())
+        raise OSError("store down")
+
+    policy = RetryPolicy(max_attempts=10, base_delay=2.0, jitter=0.0,
+                         sleep=clock.sleep, clock=clock)
+    deadline = Deadline.after(1.5, clock=clock)
+    with pytest.raises(DeadlineExceededException) as ei:
+        retry_call(always, policy=policy, deadline=deadline)
+    # attempt 0 failed at t=0; the 2 s backoff overruns the 1.5 s
+    # budget, so no sleep and no second attempt happened
+    assert calls == [0.0]
+    assert clock() == 0.0
+    assert ei.value.budget == 1.5
+    assert isinstance(ei.value.__cause__, OSError)
+    # deliberately NOT a TimeoutError: the allowlist must never
+    # re-retry an expired budget
+    assert not isinstance(ei.value, TimeoutError)
+
+
+@pytest.mark.chaos
+def test_chaos_retry_policy_total_timeout_composes_with_deadline():
+    """policy.total_timeout is a per-call wall budget; with an
+    explicit deadline too, the TIGHTER one wins."""
+    from deeplearning4j_tpu.exceptions import DeadlineExceededException
+    from deeplearning4j_tpu.resilience.deadline import Deadline
+
+    clock = _FakeClock()
+    calls = []
+
+    def always(**_):
+        calls.append(clock())
+        raise OSError("store down")
+
+    policy = RetryPolicy(max_attempts=10, base_delay=1.0,
+                         multiplier=1.0, jitter=0.0,
+                         sleep=clock.sleep, clock=clock,
+                         total_timeout=2.5)
+    with pytest.raises(DeadlineExceededException) as ei:
+        # the explicit deadline (10 s) is looser: total_timeout wins
+        retry_call(always, policy=policy,
+                   deadline=Deadline.after(10.0, clock=clock))
+    # attempts at t=0, 1, 2; the next 1 s backoff would end at 3 s,
+    # past the 2.5 s total_timeout
+    assert calls == [0.0, 1.0, 2.0]
+    assert ei.value.budget == 2.5
+
+
+def test_retry_total_timeout_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(total_timeout=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(total_timeout=-1.0)
+
+
+@pytest.mark.chaos
+def test_chaos_retrying_store_honors_request_deadline(tmp_path):
+    """RetryingObjectStore(deadline_fn=): the serving tier's
+    per-request deadline bounds the store's retry loop — a dead
+    backend can't eat the whole request budget in backoff sleeps."""
+    from deeplearning4j_tpu.exceptions import DeadlineExceededException
+    from deeplearning4j_tpu.resilience.deadline import Deadline
+
+    clock = _FakeClock()
+    inner = LocalObjectStore(tmp_path)
+    inner.write("k", b"v")
+    chaos = ChaosPolicy(fail_calls={"read": {0, 1, 2}})
+    store = RetryingObjectStore(
+        FaultyObjectStore(inner, chaos),
+        RetryPolicy(max_attempts=6, base_delay=1.0, multiplier=1.0,
+                    jitter=0.0, sleep=clock.sleep, clock=clock),
+        deadline_fn=lambda: Deadline.after(1.5, clock=clock),
+    )
+    with pytest.raises(DeadlineExceededException):
+        store.read("k")
+    # only the attempts the budget afforded: t=0 and t=1
+    assert chaos.injected == [("read", 0), ("read", 1)]
+    # a fresh call gets a fresh deadline (deadline_fn is per-call)
+    assert store.read("k") == b"v"
+
+
 # -- fault injection + retrying storage ---------------------------------
 
 
