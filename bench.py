@@ -1225,6 +1225,47 @@ def bench_input_pipeline(budget_s=None) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_zero_sharding(budget_s=None) -> dict:
+    """ZeRO-sharded optimizer state + in-jit gradient accumulation
+    A/B via the standalone training script (subprocess — it builds
+    its own 8-virtual-device mesh and trainers). Reports the
+    script's ``zero_sharding`` and ``grad_accum`` payloads; the
+    acceptance gates are ``trajectory_match`` == true (sharding
+    never changes the bits trained) and ``updater_bytes_ratio``
+    <= 0.25 (per-device optimizer state at most 1/4 of replicated
+    on the 8-wide mesh — the train-N×-larger headroom claim)."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_training.py",
+    )
+    timeout = 300
+    if budget_s is not None:
+        timeout = max(30, min(timeout, int(budget_s)))
+    env = dict(os.environ)
+    env.update({
+        "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE or "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+    })
+    out = subprocess.run(
+        [sys.executable, script, "--steps", "16", "--io-ms", "0",
+         "--zero", "--grad-accum", "4"],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_training --zero failed: {out.stderr[-2000:]}"
+        )
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    return {
+        "zero_sharding": doc.get("zero_sharding", {}),
+        "grad_accum": doc.get("grad_accum", {}),
+    }
+
+
 def bench_aot_compile(budget_s=None) -> dict:
     """Cold vs warm serving boot through the compile-artifact
     subsystem, via the standalone A/B script (subprocess — it boots
@@ -1487,6 +1528,12 @@ def _section_table(budget_fn):
          "pipelined-vs-synchronous training fit steps/sec "
          "(scripts/bench_training.py; speedup > 1 and "
          "trajectory_match are the gates)"),
+        ("zero_sharding",
+         lambda: bench_zero_sharding(budget_fn()),
+         "ZeRO-sharded optimizer state + in-jit grad accumulation "
+         "(scripts/bench_training.py --zero --grad-accum 4; bitwise "
+         "trajectory_match and updater_bytes_ratio <= 0.25 are the "
+         "gates)"),
         ("aot_compile",
          lambda: bench_aot_compile(budget_fn()),
          "cold-vs-warm serving boot-to-ready "

@@ -45,6 +45,7 @@ not re-grow a ``value_and_grad`` / ``lax.scan`` of their own.
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -565,6 +566,233 @@ def _scale_tree(tree, factor):
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-1 optimizer-state sharding (flattened-leaf layout)
+# ---------------------------------------------------------------------------
+#
+# The data-parallel trainer replicates updater state (Adam/RMSProp
+# moments) on every device, so its HBM cost is O(params) per chip no
+# matter how wide the mesh is. The zero layout instead stores each
+# state leaf as a 1-d vector, zero-padded to a multiple of the shard
+# count and sharded P("data"): each device holds 1/N of every moment.
+# The updater rules are elementwise, so running them on the flat
+# vectors is bitwise the canonical-shape math, and the padding slots
+# (grad 0, state 0) provably produce step 0 / state 0 under every rule
+# — the trajectory is bitwise identical to the replicated baseline.
+# Checkpoints/snapshots always store the CANONICAL layout
+# (zero_gather_updater_state), so a save on an 8-device mesh restores
+# bitwise on 4 or 1.
+
+_ZERO_GATHER_MS = None
+
+
+def _zero_gather_summary():
+    global _ZERO_GATHER_MS
+    if _ZERO_GATHER_MS is None:
+        from deeplearning4j_tpu.observability.metrics import (
+            default_registry,
+        )
+
+        _ZERO_GATHER_MS = default_registry().summary(
+            "zero_allgather_ms",
+            help="host gather of zero-sharded optimizer state back to "
+                 "canonical per-param shapes (checkpoint/snapshot/"
+                 "re-shard path, ms)",
+        )._default()
+    return _ZERO_GATHER_MS
+
+
+def zero_flat_size(shape, shards: int) -> int:
+    """Padded flat length of one leaf under the zero layout: the
+    element count rounded up to a multiple of the shard count so
+    ``P("data")`` splits it evenly."""
+    n = int(np.prod(shape)) if len(shape) else 1
+    return -(-n // int(shards)) * int(shards)
+
+
+def zero_flatten_leaf(a, shards: int):
+    """Canonical leaf -> flat zero-padded vector (pure; runs in-jit)."""
+    v = jnp.reshape(a, (-1,))
+    pad = zero_flat_size(a.shape, shards) - v.shape[0]
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+    return v
+
+
+def zero_unflatten_leaf(v, shape):
+    """Inverse of ``zero_flatten_leaf``: drop the padding, restore the
+    canonical shape."""
+    n = int(np.prod(shape)) if len(shape) else 1
+    return jnp.reshape(v[:n], shape)
+
+
+def zero_layout_closures(zero_layout):
+    """(flatten, unflatten) for a ``{"shards": n}`` layout, or
+    ``(None, None)`` — the pair ``MultiLayerUpdaterDef.update`` takes."""
+    if not zero_layout:
+        return None, None
+    shards = int(zero_layout["shards"])
+    return (lambda a: zero_flatten_leaf(a, shards)), zero_unflatten_leaf
+
+
+def zero_gather_updater_state(upd_state, params):
+    """Gather a zero-laid-out updater state back to canonical
+    per-param shapes on HOST (numpy) — the checkpoint / snapshot /
+    cross-mesh re-shard form. Idempotent: a leaf already in canonical
+    shape passes through (modulo the host copy), so callers may apply
+    it without knowing the live layout; ``np.asarray`` on a sharded
+    leaf performs the device->host all-gather."""
+    t0 = time.perf_counter()
+    out: Dict[str, Any] = {}
+    for ln, lp in upd_state.items():
+        out[ln] = {}
+        for pn, tup in lp.items():
+            shape = tuple(np.shape(params[ln][pn]))
+            n = int(np.prod(shape)) if len(shape) else 1
+            gathered = []
+            for a in tup:
+                h = np.asarray(a)
+                if h.shape != shape:
+                    h = h.reshape(-1)[:n].reshape(shape)
+                gathered.append(h)
+            out[ln][pn] = tuple(gathered)
+    _zero_gather_summary().observe(
+        (time.perf_counter() - t0) * 1000.0
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# in-jit gradient accumulation
+# ---------------------------------------------------------------------------
+
+_GRAD_ACCUM_GAUGE = None
+
+
+def note_grad_accum(k: int) -> None:
+    """Publish the microbatch count an optimizer step accumulates."""
+    global _GRAD_ACCUM_GAUGE
+    if _GRAD_ACCUM_GAUGE is None:
+        from deeplearning4j_tpu.observability.metrics import (
+            default_registry,
+        )
+
+        _GRAD_ACCUM_GAUGE = default_registry().gauge(
+            "grad_accum_microbatches",
+            help="microbatches accumulated in-jit per optimizer step "
+                 "(1 = plain single-batch steps)",
+        )._default()
+    _GRAD_ACCUM_GAUGE.set(float(k))
+
+
+def _model_layer_confs(model):
+    conf = model.conf
+    if hasattr(conf, "vertices"):
+        return [
+            v.layer_conf for v in conf.vertices.values()
+            if getattr(v, "layer_conf", None) is not None
+        ]
+    return list(conf.layers)
+
+
+def check_grad_accum(model, k) -> int:
+    """Validate a ``grad_accum`` knob for ``model``: a positive
+    microbatch count, and no batch-statistics layer (each microbatch
+    would see its own BatchNormalization stats — different math from
+    the full batch, so the config is rejected rather than silently
+    diverging)."""
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {k}")
+    if k > 1 and any(
+        layer.uses_batch_statistics()
+        for layer in _model_layer_confs(model)
+    ):
+        raise ValueError(
+            "grad_accum > 1 is incompatible with batch-statistics "
+            "layers (BatchNormalization): each microbatch would "
+            "compute its own batch stats, changing the math vs the "
+            "single-big-batch step"
+        )
+    return k
+
+
+def set_grad_accum(model, k) -> None:
+    """Set the in-jit gradient-accumulation knob on either engine;
+    a change invalidates every compiled step that bakes it in."""
+    k = check_grad_accum(model, k)
+    if k != getattr(model, "grad_accum", 1):
+        model.grad_accum = k
+        model._jit_step = None
+        model._jit_multi_step = None
+        if hasattr(model, "_jit_tbptt_multi_step"):
+            model._jit_tbptt_multi_step = None
+    note_grad_accum(k)
+
+
+def check_grad_accum_batch(k: int, batch_n: int) -> None:
+    if k > 1 and batch_n % k != 0:
+        raise ValueError(
+            f"grad_accum={k} needs the batch to split into equal "
+            f"microbatches; got batch size {batch_n}"
+        )
+
+
+def accum_grad_step(score_fn, params, state, x, labels, mask, fmask,
+                    rng, k: int, scale=None,
+                    recurrent_names: Sequence[str] = ()):
+    """``grad_step`` over K microbatches fused into one program: a
+    ``lax.scan`` splits the batch leaves ``[n, ...] -> [k, n/k, ...]``
+    (contiguous row blocks — microbatch j is rows ``[j*n/k, (j+1)*
+    n/k)``), accumulates f32 gradients + the f32 score, and returns
+    their means — ``((score, new_state), grads)``, the same contract
+    as ``grad_step``, so one updater apply follows K backward passes
+    at one microbatch's activation memory. ``1/k`` is exact for
+    power-of-two k; per-microbatch PRNG keys fold the microbatch
+    index into ``rng``. Recurrent carry entries are restored per
+    microbatch (standard-backprop semantics + a constant scan-carry
+    structure), matching ``build_multi_step``."""
+
+    def split(a):
+        return jnp.reshape(a, (k, a.shape[0] // k) + a.shape[1:])
+
+    micro = jax.tree_util.tree_map(split, (x, labels, mask, fmask))
+    rngs = None
+    if rng is not None:
+        rngs = jax.vmap(
+            lambda j: jax.random.fold_in(rng, j)
+        )(jnp.arange(k))
+    acc0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
+    )
+
+    def body(carry, per):
+        acc, ssum, st = carry
+        (xj, yj, mj, fj), rj = per
+        (score, new_st), grads = grad_step(
+            score_fn, params, st, xj, yj, mj, fj, rj, scale=scale
+        )
+        new_st = dict(new_st)
+        for name in recurrent_names:
+            if name in new_st:
+                new_st[name] = st[name]
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), acc, grads
+        )
+        return (acc, ssum + score.astype(jnp.float32), new_st), None
+
+    (acc, ssum, last_state), _ = jax.lax.scan(
+        body, (acc0, jnp.zeros((), jnp.float32), state),
+        (micro, rngs),
+    )
+    inv = 1.0 / k
+    grads = jax.tree_util.tree_map(
+        lambda a, p: (a * inv).astype(jnp.asarray(p).dtype),
+        acc, params,
+    )
+    return (ssum * inv, last_state), grads
+
+
+# ---------------------------------------------------------------------------
 # jitted step builders (ONE implementation for both engines)
 # ---------------------------------------------------------------------------
 
@@ -586,36 +814,83 @@ def grad_step(score_fn, params, state, x, labels, mask, fmask, rng,
 
 
 def finish_step(updater, grads, score, new_state, params, upd_state,
-                state, lrs, t, *, guarded: bool, telemetry: bool):
+                state, lrs, t, *, guarded: bool, telemetry: bool,
+                ls=None, flatten=None, unflatten=None):
     """The post-gradient half shared by the engine steps AND the
-    distributed trainer's shard_map/GSPMD steps: updater application,
-    optional telemetry grad-norm, optional in-jit divergence-guard
-    select. Returns the step output tuple
-    ``(params, upd_state, state, score[, grad_norm][, ok])``."""
+    distributed trainer's shard_map/GSPMD steps: dynamic loss-scale
+    unscale/adjust (when ``ls``, the incoming loss-scale state dict,
+    is given — the caller already scaled the loss via ``grad_step``'s
+    ``scale``), updater application (optionally through the zero
+    flattened-leaf layout via ``flatten``/``unflatten``), optional
+    telemetry grad-norm, optional in-jit divergence-guard select.
+    Returns the step output tuple
+    ``(params, upd_state, state, score[, grad_norm]
+    [, loss_scale_state][, ok])``."""
     from deeplearning4j_tpu.resilience.guard import (
         divergence_ok,
         grad_global_norm_sq,
         select_updates,
     )
 
-    new_params, new_upd = updater.update(
-        grads, upd_state, params, lrs, t
-    )
+    tail = ()
+    if ls is not None:
+        scale = ls["scale"]
+        inv = 1.0 / scale
+        grads = _scale_tree(grads, inv)
+        score = score * inv
+        # the overflow probe: a non-finite gradient skips the update
+        # in-jit and halves the scale; growth_interval clean steps
+        # double it back (capped)
+        finite = jnp.isfinite(grad_global_norm_sq(grads))
+        new_params, new_upd = updater.update(
+            grads, upd_state, params, lrs, t,
+            flatten=flatten, unflatten=unflatten,
+        )
+        new_params, new_upd, new_state = select_updates(
+            finite, new_params, params, new_upd, upd_state,
+            new_state, state,
+        )
+        good = jnp.where(finite, ls["good_steps"] + 1, 0)
+        grow = good >= LOSS_SCALE_GROWTH_INTERVAL
+        new_scale = jnp.where(
+            finite,
+            jnp.where(
+                grow,
+                jnp.minimum(scale * 2.0, MAX_LOSS_SCALE),
+                scale,
+            ),
+            jnp.maximum(scale * 0.5, 1.0),
+        )
+        tail = ({
+            "scale": new_scale,
+            "good_steps": jnp.where(grow, 0, good),
+            "overflows": ls["overflows"]
+            + (1 - finite.astype(jnp.int32)),
+        },)
+    else:
+        new_params, new_upd = updater.update(
+            grads, upd_state, params, lrs, t,
+            flatten=flatten, unflatten=unflatten,
+        )
     extras = ()
     if telemetry:
         extras = (jnp.sqrt(grad_global_norm_sq(grads)),)
     if not guarded:
-        return (new_params, new_upd, new_state, score) + extras
+        return (new_params, new_upd, new_state, score) + extras + tail
     ok = divergence_ok(score, grads)
     new_params, new_upd, new_state = select_updates(
         ok, new_params, params, new_upd, upd_state, new_state, state,
     )
-    return (new_params, new_upd, new_state, score) + extras + (ok,)
+    return (
+        (new_params, new_upd, new_state, score) + extras + tail + (ok,)
+    )
 
 
 def build_step(score_fn, updater, *, cast=None, guarded: bool = False,
-               telemetry: bool = False,
-               loss_scale: bool = False) -> Callable:
+               telemetry: bool = False, loss_scale: bool = False,
+               grad_accum: int = 1,
+               recurrent_names: Sequence[str] = (),
+               zero_layout=None) -> Callable:
     """ONE jitted SGD train step for both engines.
 
     ``score_fn(params, state, x, labels, mask, fmask, rng) ->
@@ -626,72 +901,35 @@ def build_step(score_fn, updater, *, cast=None, guarded: bool = False,
     [, ok]`` — unpacked by ``apply_step_out``. With ``loss_scale``
     the step takes the loss-scale state dict as a trailing argument,
     skips the update in-jit on a non-finite gradient (the overflow
-    probe), and adjusts the scale — no host round trip."""
-    from deeplearning4j_tpu.resilience.guard import (
-        divergence_ok,
-        grad_global_norm_sq,
-        select_updates,
-    )
+    probe), and adjusts the scale — no host round trip. With
+    ``grad_accum=K`` the forward/backward runs as a ``lax.scan`` over
+    K microbatches (``accum_grad_step``) before the ONE updater apply.
+    ``zero_layout`` (``{"shards": n}``) runs the updater through the
+    zero flattened-leaf layout — ``upd_state`` leaves are 1-d padded
+    vectors (see the ZeRO section above)."""
+    flatten, unflatten = zero_layout_closures(zero_layout)
+    k = int(grad_accum)
 
     def step(params, upd_state, state, x, labels, mask, fmask, lrs, t,
-             rng, *ls):
+             rng, *ls_args):
         if cast is not None:
             x, labels, mask, fmask = cast(x, labels, mask, fmask)
-        scale = ls[0]["scale"] if loss_scale else None
-        (score, new_state), grads = grad_step(
-            score_fn, params, state, x, labels, mask, fmask, rng,
-            scale=scale,
-        )
-        tail = ()
-        if loss_scale:
-            inv = 1.0 / scale
-            grads = _scale_tree(grads, inv)
-            score = score * inv
-            finite = jnp.isfinite(grad_global_norm_sq(grads))
-            new_params, new_upd = updater.update(
-                grads, upd_state, params, lrs, t
+        ls = ls_args[0] if loss_scale else None
+        scale = ls["scale"] if loss_scale else None
+        if k > 1:
+            (score, new_state), grads = accum_grad_step(
+                score_fn, params, state, x, labels, mask, fmask, rng,
+                k, scale=scale, recurrent_names=recurrent_names,
             )
-            new_params, new_upd, new_state = select_updates(
-                finite, new_params, params, new_upd, upd_state,
-                new_state, state,
-            )
-            st = ls[0]
-            good = jnp.where(finite, st["good_steps"] + 1, 0)
-            grow = good >= LOSS_SCALE_GROWTH_INTERVAL
-            new_scale = jnp.where(
-                finite,
-                jnp.where(
-                    grow,
-                    jnp.minimum(scale * 2.0, MAX_LOSS_SCALE),
-                    scale,
-                ),
-                jnp.maximum(scale * 0.5, 1.0),
-            )
-            tail = ({
-                "scale": new_scale,
-                "good_steps": jnp.where(grow, 0, good),
-                "overflows": st["overflows"]
-                + (1 - finite.astype(jnp.int32)),
-            },)
         else:
-            new_params, new_upd = updater.update(
-                grads, upd_state, params, lrs, t
+            (score, new_state), grads = grad_step(
+                score_fn, params, state, x, labels, mask, fmask, rng,
+                scale=scale,
             )
-        extras = ()
-        if telemetry:
-            extras = (jnp.sqrt(grad_global_norm_sq(grads)),)
-        if not guarded:
-            return (
-                (new_params, new_upd, new_state, score) + extras + tail
-            )
-        ok = divergence_ok(score, grads)
-        new_params, new_upd, new_state = select_updates(
-            ok, new_params, params, new_upd, upd_state,
-            new_state, state,
-        )
-        return (
-            (new_params, new_upd, new_state, score) + extras + tail
-            + (ok,)
+        return finish_step(
+            updater, grads, score, new_state, params, upd_state,
+            state, lrs, t, guarded=guarded, telemetry=telemetry,
+            ls=ls, flatten=flatten, unflatten=unflatten,
         )
 
     return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -719,7 +957,8 @@ def apply_step_out(model, out):
 
 def build_multi_step(score_fn, updater, *, cast,
                      recurrent_names: Sequence[str] = (),
-                     tbptt: bool = False) -> Callable:
+                     tbptt: bool = False, grad_accum: int = 1,
+                     zero_layout=None) -> Callable:
     """k optimizer steps fused into ONE XLA program via lax.scan.
 
     The reference dispatches one native-op sequence per minibatch
@@ -735,7 +974,21 @@ def build_multi_step(score_fn, updater, *, cast,
     0/1 per step) that zeroes the carry at minibatch boundaries, so
     MANY minibatches' TBPTT chunk stacks ride in a single dispatch
     (the reference's host-side chunk loop, ``doTruncatedBPTT:1210``,
-    pays a dispatch per chunk)."""
+    pays a dispatch per chunk).
+
+    ``grad_accum``/``zero_layout`` compose exactly as in
+    ``build_step``: each scanned optimizer step accumulates K
+    microbatch gradients, and the updater runs through the zero
+    flattened-leaf layout (TBPTT mode excludes grad_accum — the
+    recurrent carry threads BETWEEN chunks, so a chunk cannot split
+    into independent microbatches)."""
+    flatten, unflatten = zero_layout_closures(zero_layout)
+    k_accum = int(grad_accum)
+    if tbptt and k_accum > 1:
+        raise ValueError(
+            "grad_accum > 1 is incompatible with the fused TBPTT "
+            "path: the recurrent carry threads between chunks"
+        )
 
     def body(carry, per_step):
         params, upd_state, state = carry
@@ -754,11 +1007,18 @@ def build_multi_step(score_fn, updater, *, cast,
                     k2: v * keep.astype(v.dtype)
                     for k2, v in state[name].items()
                 }
-        (score, new_state), grads = grad_step(
-            score_fn, params, state, x, labels, mask, fmask, rng
-        )
+        if k_accum > 1:
+            (score, new_state), grads = accum_grad_step(
+                score_fn, params, state, x, labels, mask, fmask, rng,
+                k_accum, recurrent_names=recurrent_names,
+            )
+        else:
+            (score, new_state), grads = grad_step(
+                score_fn, params, state, x, labels, mask, fmask, rng
+            )
         new_params, new_upd = updater.update(
-            grads, upd_state, params, lrs, t
+            grads, upd_state, params, lrs, t,
+            flatten=flatten, unflatten=unflatten,
         )
         if not tbptt:
             # standard-backprop semantics: recurrent carry resets per
@@ -1089,6 +1349,11 @@ def init_transforms(model, conf) -> None:
     )
     model._layer_runs_cache = None
     model._loss_scale_state = None
+    model.grad_accum = 1
+    # {"shards": n} while the updater state lives in the zero
+    # flattened-leaf layout (set/cleared by the distributed trainer's
+    # placement); None = canonical per-param shapes
+    model._zero_layout = None
 
 
 def set_transforms(model, scan_layers=None, remat=None,
@@ -1151,4 +1416,11 @@ def transform_kind_suffix(model) -> str:
         parts.append(f"remat:{model.remat}")
     if getattr(model, "_loss_scale_active", False):
         parts.append("lossscale")
+    if int(getattr(model, "grad_accum", 1)) > 1:
+        parts.append(f"accum:{model.grad_accum}")
+    if getattr(model, "_zero_layout", None):
+        # a +zero executable bakes in the flattened-leaf updater
+        # layout; a stale plain-step artifact must be refused, not
+        # fed flat state (and vice versa)
+        parts.append("zero")
     return ("+" + "+".join(parts)) if parts else ""

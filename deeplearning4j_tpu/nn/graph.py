@@ -370,12 +370,21 @@ class ComputationGraph:
             )
         return score_fn
 
+    def _recurrent_names(self):
+        return [
+            n for n in self.layer_vertex_names
+            if self.conf.vertices[n].layer_conf.is_recurrent()
+        ]
+
     def _build_step(self):
         return core.build_step(
             self._score_fn(), self.updater_def,
             guarded=self.divergence_guard is not None,
             telemetry=self._telemetry_grad_norm,
             loss_scale=self._loss_scale_active,
+            grad_accum=self.grad_accum,
+            recurrent_names=self._recurrent_names(),
+            zero_layout=self._zero_layout,
         )
 
     def _build_multi_step(self):
@@ -391,10 +400,9 @@ class ComputationGraph:
 
         return core.build_multi_step(
             self._score_fn(), self.updater_def, cast=cast,
-            recurrent_names=[
-                n for n in self.layer_vertex_names
-                if self.conf.vertices[n].layer_conf.is_recurrent()
-            ],
+            recurrent_names=self._recurrent_names(),
+            grad_accum=self.grad_accum,
+            zero_layout=self._zero_layout,
         )
 
     def _can_scan_steps(self) -> bool:
@@ -487,10 +495,24 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
 
-    def fit(self, data, labels=None, *, epochs: int = 1) -> None:
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            grad_accum=None) -> None:
         """Accepts a MultiDataSet/DataSet, an iterator of either, or
         (inputs, labels) lists (reference fit overloads
-        ``ComputationGraph.java:614-760``)."""
+        ``ComputationGraph.java:614-760``). ``grad_accum=K``
+        accumulates K microbatch gradients in-jit per optimizer step
+        (same contract as ``MultiLayerNetwork.fit``)."""
+        if grad_accum is not None:
+            if (
+                int(grad_accum) > 1
+                and self.conf.backprop_type == "TruncatedBPTT"
+            ):
+                raise ValueError(
+                    "grad_accum > 1 is incompatible with TBPTT: the "
+                    "recurrent carry threads between chunks, so a "
+                    "chunk cannot split into independent microbatches"
+                )
+            core.set_grad_accum(self, grad_accum)
         if labels is not None:
             from deeplearning4j_tpu.datasets.api import MultiDataSet
 
@@ -669,6 +691,9 @@ class ComputationGraph:
         ):
             return self._fit_tbptt(inputs, labels, lmasks, fmasks)
         self._last_batch_rows = int(inputs[0].shape[0])
+        core.check_grad_accum_batch(
+            self.grad_accum, int(inputs[0].shape[0])
+        )
         score = None
         for _ in range(self.conf.iterations):
             if self._jit_step is None:

@@ -277,6 +277,9 @@ class MultiLayerNetwork:
             guarded=self.divergence_guard is not None,
             telemetry=self._telemetry_grad_norm,
             loss_scale=self._loss_scale_active,
+            grad_accum=self.grad_accum,
+            recurrent_names=self._recurrent_names(),
+            zero_layout=self._zero_layout,
         )
 
     def set_divergence_guard(self, guard) -> None:
@@ -321,6 +324,8 @@ class MultiLayerNetwork:
             self._score_fn(), self.updater_def,
             cast=self._multi_cast(),
             recurrent_names=self._recurrent_names(),
+            grad_accum=self.grad_accum,
+            zero_layout=self._zero_layout,
         )
 
     def _build_tbptt_multi_step(self) -> Callable:
@@ -538,7 +543,7 @@ class MultiLayerNetwork:
         return step
 
     def fit(self, data, labels=None, *, epochs: int = 1,
-            resume_from=None) -> None:
+            resume_from=None, grad_accum=None) -> None:
         """fit(DataSetIterator) / fit(x, y) (reference ``fit:1048``).
 
         ``data`` may be a DataSetIterator-style iterable of objects with
@@ -549,9 +554,28 @@ class MultiLayerNetwork:
         zip path — restores params/updater/step counter before fitting
         (see ``resume``); the caller supplies the data stream from the
         restored position.
+
+        ``grad_accum=K``: each optimizer step accumulates K microbatch
+        gradients in-jit (``core.accum_grad_step``) before ONE updater
+        apply — the effective batch is K× the delivered batch at one
+        microbatch's activation memory. Batches must split into K equal
+        microbatches; BatchNormalization configs are rejected (per-
+        microbatch batch stats would change the math). The knob
+        persists until changed (``grad_accum=1`` restores plain steps).
         """
         from deeplearning4j_tpu.datasets.api import DataSet
 
+        if grad_accum is not None:
+            if (
+                int(grad_accum) > 1
+                and self.conf.backprop_type == "TruncatedBPTT"
+            ):
+                raise ValueError(
+                    "grad_accum > 1 is incompatible with TBPTT: the "
+                    "recurrent carry threads between chunks, so a "
+                    "chunk cannot split into independent microbatches"
+                )
+            core.set_grad_accum(self, grad_accum)
         if resume_from is not None:
             self.resume(resume_from)
         if labels is not None:
@@ -709,6 +733,7 @@ class MultiLayerNetwork:
         if self._wants_last_features():
             self._last_features = ds.features  # activation listeners
         self._last_batch_rows = int(x.shape[0])  # examples/sec signal
+        core.check_grad_accum_batch(self.grad_accum, int(x.shape[0]))
         score = None
         for _ in range(self.conf.iterations):
             if self._jit_step is None:

@@ -242,6 +242,8 @@ class MultiLayerUpdaterDef:
         params: dict[str, dict[str, jax.Array]],
         lrs: dict[str, jax.Array],
         t: jax.Array,
+        flatten=None,
+        unflatten=None,
     ):
         """Pure: returns (new_params, new_state). Runs inside jit.
 
@@ -256,6 +258,16 @@ class MultiLayerUpdaterDef:
         Biases (param names in ``s.bias_params``) use
         ``bias_learning_rate`` when configured (reference
         ``biasLearningRate``).
+
+        ``flatten``/``unflatten`` select the ZeRO flattened-leaf
+        layout (nn/core.py): ``state`` leaves are 1-d zero-padded
+        vectors, gradients are flattened before the rule and the
+        stepped params restored to their canonical shapes after. The
+        rules are elementwise, so the flat math is bitwise the
+        canonical math; padding slots carry grad 0 / state 0, which
+        every rule maps back to step 0 / state 0. Gradient
+        normalization runs BEFORE the flatten, on the full-shape
+        gradients, so per-layer norms are unchanged.
         """
         new_params: dict[str, Any] = {}
         new_state: dict[str, Any] = {}
@@ -272,11 +284,20 @@ class MultiLayerUpdaterDef:
             for pn, g in lgrads.items():
                 p = params[ln][pn]
                 p_lr = lr * bias_scale if pn in s.bias_params else lr
-                step, st = apply_updater(s, g, state[ln][pn], p_lr, t)
+                if flatten is not None:
+                    step, st = apply_updater(
+                        s, flatten(g), state[ln][pn], p_lr, t
+                    )
+                    stepped = (flatten(p) - step).astype(p.dtype)
+                    np_[pn] = unflatten(stepped, p.shape)
+                else:
+                    step, st = apply_updater(
+                        s, g, state[ln][pn], p_lr, t
+                    )
+                    np_[pn] = (p - step).astype(p.dtype)
                 # keep param AND state dtypes: the f32 lr would promote
                 # bf16 params/momenta (and break the scan path's fixed
                 # carry dtype)
-                np_[pn] = (p - step).astype(p.dtype)
                 ns_[pn] = tuple(
                     a.astype(o.dtype)
                     for a, o in zip(st, state[ln][pn])

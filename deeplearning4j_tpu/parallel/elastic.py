@@ -236,13 +236,24 @@ class SnapshotRing:
     def push(self, model, epoch_index: int = 0) -> dict:
         """Snapshot ``model`` at its current step. ``epoch_index``
         is the batch index within the current epoch (so the fit loop
-        can replay from the right batch after a rollback)."""
+        can replay from the right batch after a rollback).
+
+        ZeRO-sharded updater state (``model._zero_layout``) is
+        gathered to its canonical shapes first — the ring holds ONE
+        host copy of each shard, never N padded replicas, and the
+        snapshot re-shards cleanly onto whatever mesh recovery
+        builds."""
+        from deeplearning4j_tpu.nn import core
+
+        upd = model.updater_state
+        if getattr(model, "_zero_layout", None):
+            upd = core.zero_gather_updater_state(upd, model.params)
         snap = {
             "step": int(model.iteration_count),
             "epoch": int(model.epoch_count),
             "epoch_index": int(epoch_index),
             "params": self._host(model.params),
-            "updater_state": self._host(model.updater_state),
+            "updater_state": self._host(upd),
             "state": self._host(model.state),
             "rng": np.array(model._base_key),
         }
@@ -268,6 +279,10 @@ class SnapshotRing:
         model.params = self._host(snap["params"])
         model.updater_state = self._host(snap["updater_state"])
         model.state = self._host(snap["state"])
+        # ring snapshots are canonical-shaped: any ZeRO flat layout is
+        # gone until the next trainer re-places (and re-shards) state
+        if getattr(model, "_zero_layout", None):
+            model._zero_layout = None
         model._base_key = jax.numpy.asarray(snap["rng"])
         model.iteration_count = snap["step"]
         model.epoch_count = snap["epoch"]

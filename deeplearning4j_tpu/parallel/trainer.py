@@ -25,7 +25,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.nn import core
 from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+
+def _default_registry():
+    from deeplearning4j_tpu.observability.metrics import default_registry
+
+    return default_registry()
 
 
 def _fused_pmean(tree, axis_name: str):
@@ -103,7 +110,8 @@ class DistributedTrainer:
                  batch_stats: str = "auto",
                  divergence_guard=None,
                  max_in_flight: int = 2,
-                 guard_lag: Optional[int] = None):
+                 guard_lag: Optional[int] = None,
+                 zero: bool = False):
         """``batch_stats`` picks the data-parallel batch-statistics
         semantics:
 
@@ -124,6 +132,17 @@ class DistributedTrainer:
           minibatch carries no loss masks (per-shard mask counts
           would reweight the mean) — else the GSPMD step. The default
           never changes the training trajectory vs single-device.
+
+        ``zero=True`` (ZeRO-1): optimizer state (Adam/RMSProp moments
+        etc.) is stored in the flattened-leaf layout sharded
+        ``P("data")`` — each device holds ~1/N of every moment instead
+        of a full replica, so the largest trainable model grows with
+        the mesh. After the gradient all-reduce each device updates
+        only its slice and GSPMD all-gathers the updated param slices
+        back. The per-element update math is unchanged: the trajectory
+        is bitwise identical to the replicated baseline
+        (``updater_state_bytes_per_device`` / ``zero_shard_bytes``
+        gauge the memory win).
         """
         if batch_stats not in ("auto", "sync", "local"):
             raise ValueError(
@@ -135,6 +154,31 @@ class DistributedTrainer:
                 "tensor_parallel=True: sharded weights need the GSPMD "
                 "step, which computes global (sync) batch statistics"
             )
+        if zero and tensor_parallel:
+            raise ValueError(
+                "zero=True shards optimizer state over the data axis; "
+                "tensor_parallel=True already shards it with the "
+                "params — combining the two layouts is not supported"
+            )
+        if zero and batch_stats == "local":
+            raise ValueError(
+                "zero=True needs the GSPMD step; batch_stats='local' "
+                "forces the shard_map step, whose per-device replicated "
+                "updater state is exactly what zero removes"
+            )
+        self.zero = bool(zero)
+        registry = _default_registry()
+        self._m_upd_bytes = registry.gauge(
+            "updater_state_bytes_per_device",
+            help="optimizer-state bytes resident on ONE device "
+                 "(replicated leaves count full size; zero shards "
+                 "count ~1/N)",
+        )._default()
+        self._m_zero_shard_bytes = registry.gauge(
+            "zero_shard_bytes",
+            help="bytes of this device's 1/N flattened optimizer-state "
+                 "shard under zero=True (0 when zero is off)",
+        )._default()
         self.model = model
         self.mesh = mesh if mesh is not None else build_mesh()
         self.tensor_parallel = tensor_parallel
@@ -160,10 +204,12 @@ class DistributedTrainer:
         self._place_params()
         self._jit_step_sm = None
         self._jit_step_gspmd = None
-        # step-telemetry flag the jitted steps were built against
-        # (lives on the MODEL so the same TelemetryListener hook
-        # covers both engines); a change rebuilds the steps
+        # step-telemetry / loss-scale / grad-accum flags the jitted
+        # steps were built against (they live on the MODEL so the same
+        # hooks cover both engines); a change rebuilds the steps
         self._built_telemetry = self._telemetry_enabled()
+        self._built_ls = core.loss_scale_active(model)
+        self._built_accum = int(getattr(model, "grad_accum", 1))
 
     def _telemetry_enabled(self) -> bool:
         return bool(getattr(self.model, "_telemetry_grad_norm", False))
@@ -201,6 +247,16 @@ class DistributedTrainer:
 
     def _pick_shard_map(self, has_masks: bool) -> bool:
         if self.tensor_parallel:
+            return False
+        if self.zero:
+            # the flattened P("data") updater layout is a GSPMD
+            # sharding; the shard_map step would replicate it again
+            return False
+        if (
+            core.loss_scale_active(self.model)
+            or int(getattr(self.model, "grad_accum", 1)) > 1
+        ):
+            # loss-scale state / microbatch scans ride the GSPMD step
             return False
         if self.batch_stats == "local":
             return True
@@ -249,36 +305,108 @@ class DistributedTrainer:
 
     def _place_params(self) -> None:
         """Move params/updater-state onto the mesh with their target
-        shardings (the reference's broadcast step, done once)."""
+        shardings (the reference's broadcast step, done once). With
+        ``zero=True`` the updater state is flattened, zero-padded to a
+        multiple of the data-parallel degree, and sharded
+        ``P("data")`` instead of replicated — ~1/N of every moment per
+        device. An incoming zero layout (checkpoint rollback,
+        survivor-mesh recovery from a DIFFERENT mesh width) is first
+        gathered back to canonical shapes, so re-sharding 8-wide state
+        onto 4 devices — or onto 1, the replicated fallback — is the
+        same code path."""
         m = self.model
+        if getattr(m, "_zero_layout", None):
+            # canonicalize first: the live layout may belong to a
+            # previous mesh (elastic recovery / cross-mesh resume)
+            m.updater_state = core.zero_gather_updater_state(
+                m.updater_state, m.params
+            )
+            m._zero_layout = None
         m.params = jax.tree_util.tree_map(
             lambda a, s: jax.device_put(a, s), m.params,
             self._param_shardings,
         )
         rep = NamedSharding(self.mesh, P())
-        m.updater_state = {
-            ln: {
-                pn: tuple(
-                    jax.device_put(a, self._param_shardings[ln][pn])
-                    for a in tup
-                )
-                for pn, tup in lp.items()
+        if self.zero:
+            n_data = int(self.mesh.shape["data"])
+            flat = NamedSharding(self.mesh, P("data"))
+
+            def shard_leaf(a):
+                h = np.asarray(a)
+                v = h.reshape(-1)
+                pad = core.zero_flat_size(h.shape, n_data) - v.size
+                if pad:
+                    v = np.concatenate([v, np.zeros(pad, h.dtype)])
+                return jax.device_put(v, flat)
+
+            m.updater_state = {
+                ln: {
+                    pn: tuple(shard_leaf(a) for a in tup)
+                    for pn, tup in lp.items()
+                }
+                for ln, lp in m.updater_state.items()
             }
-            for ln, lp in m.updater_state.items()
-        }
+            m._zero_layout = {"shards": n_data}
+        else:
+            m.updater_state = {
+                ln: {
+                    pn: tuple(
+                        jax.device_put(
+                            a, self._param_shardings[ln][pn]
+                        )
+                        for a in tup
+                    )
+                    for pn, tup in lp.items()
+                }
+                for ln, lp in m.updater_state.items()
+            }
         m.state = jax.tree_util.tree_map(
             lambda a: jax.device_put(a, rep), m.state
         )
+        # the layout is baked into every compiled step: the engine's
+        # own cached steps must not be fed state in the other layout
+        m._jit_step = None
+        m._jit_multi_step = None
+        self._publish_updater_gauges()
+
+    def _publish_updater_gauges(self) -> None:
+        """Per-device updater-state residency, measured from the live
+        arrays' addressable shards (what acceptance asserts: zero's
+        per-device bytes ~1/N of replicated)."""
+        per_dev = 0
+        shard_bytes = 0
+        for leaf in jax.tree_util.tree_leaves(self.model.updater_state):
+            if not isinstance(leaf, jax.Array):
+                per_dev += int(np.asarray(leaf).nbytes)
+                continue
+            shards = leaf.addressable_shards
+            if not shards:
+                continue
+            nb = int(shards[0].data.nbytes)
+            per_dev += nb
+            if self.zero:
+                shard_bytes += nb
+        self._m_upd_bytes.set(float(per_dev))
+        self._m_zero_shard_bytes.set(float(shard_bytes))
 
     # -- step -----------------------------------------------------------
 
     def _step_for(self, has_masks: bool):
         """Lazily-built step per flavor; the choice is per-minibatch
         (``auto`` must see whether THIS batch carries masks)."""
-        if self._telemetry_enabled() != self._built_telemetry:
-            # telemetry flipped since the steps were built (e.g. a
-            # TelemetryListener attached mid-run): rebuild both
+        ls_now = core.loss_scale_active(self.model)
+        accum_now = int(getattr(self.model, "grad_accum", 1))
+        if (
+            self._telemetry_enabled() != self._built_telemetry
+            or ls_now != self._built_ls
+            or accum_now != self._built_accum
+        ):
+            # a baked-in knob flipped since the steps were built (e.g.
+            # a TelemetryListener attached mid-run, fit(grad_accum=K)
+            # changed the microbatch count): rebuild both
             self._built_telemetry = self._telemetry_enabled()
+            self._built_ls = ls_now
+            self._built_accum = accum_now
             self._jit_step_sm = None
             self._jit_step_gspmd = None
         if self._pick_shard_map(has_masks):
@@ -306,7 +434,6 @@ class DistributedTrainer:
         state and parameters across workers the same way. Dropout keys
         fold in the device index (reference workers draw independent
         RNG streams)."""
-        from deeplearning4j_tpu.nn import core
         from deeplearning4j_tpu.parallel.compat import shard_map_compat
 
         shard_map = shard_map_compat()
@@ -385,53 +512,96 @@ class DistributedTrainer:
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     def _build_gspmd_step(self):
-        from deeplearning4j_tpu.nn import core
-
         guarded = self.divergence_guard is not None
         telemetry = self._telemetry_enabled()
+        ls_active = self._built_ls
+        grad_accum = self._built_accum
         m = self.model
         mesh = self.mesh
         rep = NamedSharding(mesh, P())
         batch = NamedSharding(mesh, P("data"))
-        # updater-state sharding mirrors params
-        upd_shardings = {
-            ln: {
-                pn: tuple(
-                    self._param_shardings[ln][pn] for _ in range(len(tup))
-                )
-                for pn, tup in lp.items()
+        if self.zero:
+            # ZeRO layout: every updater leaf is a flat padded vector
+            # sharded over 'data' — each device applies the update to
+            # its 1/N slice; the replicated out_sharding on params
+            # makes GSPMD insert the all-gather of the updated slices
+            n_data = int(mesh.shape["data"])
+            flat = NamedSharding(mesh, P("data"))
+            upd_shardings = {
+                ln: {
+                    pn: tuple(flat for _ in range(len(tup)))
+                    for pn, tup in lp.items()
+                }
+                for ln, lp in m.updater_state.items()
             }
-            for ln, lp in m.updater_state.items()
-        }
+
+            def flatten(a):
+                # the inner replicated pin stops the flat sharding
+                # from propagating BACKWARD into the grad computation
+                # (under grad-accum it would re-partition the scan
+                # body's matmuls and change reduction order — breaking
+                # the bitwise-vs-replicated trajectory)
+                a = jax.lax.with_sharding_constraint(a, rep)
+                return jax.lax.with_sharding_constraint(
+                    core.zero_flatten_leaf(a, n_data), flat
+                )
+
+            unflatten = core.zero_unflatten_leaf
+        else:
+            # updater-state sharding mirrors params
+            upd_shardings = {
+                ln: {
+                    pn: tuple(
+                        self._param_shardings[ln][pn]
+                        for _ in range(len(tup))
+                    )
+                    for pn, tup in lp.items()
+                }
+                for ln, lp in m.updater_state.items()
+            }
+            flatten = unflatten = None
         # Layer state uses a prefix sharding (one NamedSharding for the
         # whole subtree): its pytree structure changes when recurrent
         # carry (h, c) appears in the step output.
         state_shardings = rep
         updater = m.updater_def
         is_graph = self._is_graph
+        recurrent_names = (
+            m._recurrent_names() if hasattr(m, "_recurrent_names")
+            else ()
+        )
 
-        def step(params, upd_state, state, x, labels, mask, fmask, lrs, t,
-                 rng):
-            def loss_fn(p):
-                if is_graph:
-                    # ComputationGraph takes lists + per-output masks
-                    s, new_state = m._score_pure(
-                        p, state, x, labels, mask, rng, train=True,
-                        fmasks=fmask,
-                    )
-                else:
-                    s, new_state = m._score_pure(
-                        p, state, x, labels, mask, rng, train=True,
-                        fmask=fmask,
-                    )
-                return s, new_state
+        def score_fn(p, state, x, labels, mask, fmask, rng):
+            if is_graph:
+                # ComputationGraph takes lists + per-output masks
+                return m._score_pure(
+                    p, state, x, labels, mask, rng, train=True,
+                    fmasks=fmask,
+                )
+            return m._score_pure(
+                p, state, x, labels, mask, rng, train=True,
+                fmask=fmask,
+            )
 
-            (score, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
+        def step(params, upd_state, state, x, labels, mask, fmask, lrs,
+                 t, rng, *ls_args):
+            ls = ls_args[0] if ls_active else None
+            scale = ls["scale"] if ls_active else None
+            if grad_accum > 1:
+                (score, new_state), grads = core.accum_grad_step(
+                    score_fn, params, state, x, labels, mask, fmask,
+                    rng, grad_accum, scale=scale,
+                    recurrent_names=recurrent_names,
+                )
+            else:
+                (score, new_state), grads = core.grad_step(
+                    score_fn, params, state, x, labels, mask, fmask,
+                    rng, scale=scale,
+                )
             return core.finish_step(
                 updater, grads, score, new_state, params, upd_state,
                 state, lrs, t, guarded=guarded, telemetry=telemetry,
+                ls=ls, flatten=flatten, unflatten=unflatten,
             )
 
         out_shardings = (
@@ -439,14 +609,19 @@ class DistributedTrainer:
         )
         if telemetry:
             out_shardings = out_shardings + (rep,)
+        if ls_active:
+            out_shardings = out_shardings + (rep,)
         if guarded:
             out_shardings = out_shardings + (rep,)
+        in_shardings = (
+            self._param_shardings, upd_shardings, state_shardings,
+            batch, batch, batch, batch, None, None, None,
+        )
+        if ls_active:
+            in_shardings = in_shardings + (None,)
         return jax.jit(
             step,
-            in_shardings=(
-                self._param_shardings, upd_shardings, state_shardings,
-                batch, batch, batch, batch, None, None, None,
-            ),
+            in_shardings=in_shardings,
             out_shardings=out_shardings,
             donate_argnums=(0, 1, 2),
         )
@@ -564,6 +739,14 @@ class DistributedTrainer:
         if isinstance(first, (list, tuple)):
             first = first[0]
         batch_n = int(np.shape(first)[0])
+        k_accum = int(getattr(m, "grad_accum", 1))
+        if k_accum > 1 and batch_n % (k_accum * n_data) != 0:
+            raise ValueError(
+                f"grad_accum={k_accum} on a {n_data}-wide data mesh "
+                f"needs the batch to split into {k_accum} microbatches "
+                f"of whole shards; got batch size {batch_n} (make it a "
+                f"multiple of {k_accum * n_data})"
+            )
         if batch_n % n_data != 0:
             ds = self._pad_minibatch(ds, batch_n, n_data)
 
@@ -613,7 +796,8 @@ class DistributedTrainer:
     # -- public API -----------------------------------------------------
 
     def fit(self, iterator, epochs: int = 1,
-            prefetch: Optional[int] = None) -> list:
+            prefetch: Optional[int] = None,
+            grad_accum: Optional[int] = None) -> list:
         """Fit ``epochs`` passes of ``iterator``, pipelined: batch
         materialization + sharded placement can run on a prefetch
         thread (``prefetch=N`` wraps the iterator in a depth-N
@@ -635,6 +819,10 @@ class DistributedTrainer:
         from deeplearning4j_tpu.resilience import preemption
 
         m = self.model
+        if grad_accum is not None:
+            # in-jit microbatch accumulation (core.accum_grad_step);
+            # _step_for notices the knob change and rebuilds the step
+            core.set_grad_accum(m, grad_accum)
         source = iterator
         owned_prefetch = None
         if prefetch is not None and int(prefetch) > 0:
@@ -702,10 +890,14 @@ class DistributedTrainer:
         lrs = m.updater_def.scheduled_lrs(m.iteration_count)
         t = jnp.asarray(m.iteration_count + 1, jnp.float32)
         rng = jax.random.fold_in(m._base_key, m.iteration_count)
+        extra = (
+            (core.ensure_loss_scale_state(m),) if self._built_ls
+            else ()
+        )
         out = step(
             m.params, m.updater_state, m.state, x, y, mask, fmask,
             {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
-            t, rng,
+            t, rng, *extra,
         )
         guard = self.divergence_guard
         m.params, m.updater_state, m.state = out[:3]
@@ -713,6 +905,9 @@ class DistributedTrainer:
         i = 4
         if self._built_telemetry:
             m._last_grad_norm = out[i]  # device scalar; lazy
+            i += 1
+        if self._built_ls:
+            m._loss_scale_state = out[i]
             i += 1
         ok = out[i] if guard is not None else None
         m._last_batch_rows = placed.num_rows  # examples/sec signal

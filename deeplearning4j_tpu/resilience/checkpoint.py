@@ -109,6 +109,12 @@ class CheckpointInfo:
     size: int
     format: int = MANIFEST_FORMAT
     artifacts: dict = field(default_factory=dict)
+    # ZeRO layout the model trained under at save time (e.g.
+    # {"shards": 8}) — informational: the zip always holds canonical
+    # (gathered) updater state, so restore works on ANY mesh; the
+    # field lets operators see which runs were sharded. Manifests
+    # without it parse as zero=None (old checkpoints keep restoring).
+    zero: Optional[dict] = None
 
     def to_manifest(self) -> dict:
         doc = {
@@ -118,6 +124,8 @@ class CheckpointInfo:
         }
         if self.artifacts:
             doc["artifacts"] = self.artifacts
+        if self.zero:
+            doc["zero"] = self.zero
         return doc
 
     @classmethod
@@ -128,6 +136,7 @@ class CheckpointInfo:
             size=int(doc["size"]),
             format=int(doc.get("format", MANIFEST_FORMAT)),
             artifacts=dict(doc.get("artifacts") or {}),
+            zero=dict(doc["zero"]) if doc.get("zero") else None,
         )
 
 
@@ -210,6 +219,8 @@ class CheckpointManager:
             info = CheckpointInfo(
                 step=step, epoch=epoch, file=zpath.name, crc32=crc,
                 size=size, artifacts=artifact_map,
+                zero=dict(getattr(model, "_zero_layout", None) or {})
+                or None,
             )
             # manifest lands after the zip: a crash between the two
             # leaves an orphan zip that available() ignores, never a
@@ -429,6 +440,11 @@ def restore_into(model, source, load_updater: bool = True):
     model.state = restored.state
     if load_updater and restored.updater_state is not None:
         model.updater_state = restored.updater_state
+        # checkpoints hold canonical updater state: a model that was
+        # ZeRO-sharded is canonical again until its trainer re-places
+        # (and re-shards) — possibly on a different-sized mesh
+        if getattr(model, "_zero_layout", None):
+            model._zero_layout = None
     model.iteration_count = restored.iteration_count
     model.epoch_count = restored.epoch_count
     return model, restored.iteration_count

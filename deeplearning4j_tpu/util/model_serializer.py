@@ -118,9 +118,17 @@ def write_model(model, path, save_updater: bool = True) -> None:
                     zf, LAYER_STATE_NAME, _flatten_params(layer_state)
                 )
             if save_updater and model.updater_state is not None:
-                _write_npz(
-                    zf, UPDATER_NAME, _flatten_updater(model.updater_state)
-                )
+                upd = model.updater_state
+                if getattr(model, "_zero_layout", None):
+                    # ZeRO-sharded moments: gather the flat shards back
+                    # to canonical param shapes so the checkpoint is
+                    # mesh-independent (restore re-shards onto whatever
+                    # mesh is present — 8-wide, 4-wide, or replicated)
+                    from deeplearning4j_tpu.nn import core
+                    upd = core.zero_gather_updater_state(
+                        upd, model.params
+                    )
+                _write_npz(zf, UPDATER_NAME, _flatten_updater(upd))
 
     if hasattr(path, "write"):
         _write_to(path)

@@ -33,10 +33,17 @@ The acceptance gates this makes falsifiable on CPU:
   residual wait says whether the run is host-bound (high: the
   source can't keep up even prefetched) or device-bound (near 0).
 
+Optional A/B riders on the same seeded batches: ``--remat`` (policy
+off vs on), ``--zero`` (replicated vs ZeRO-sharded optimizer state —
+steps/sec, per-device updater bytes, bitwise trajectory), and
+``--grad-accum K`` (accum=1 vs K in-jit microbatches — steps/sec +
+trajectory vs the single-big-batch run).
+
 Windows are interleaved best-of-N like ``scripts/bench_serving.py``
 (host noise only ever slows a run). Runnable standalone
 (``python scripts/bench_training.py``) or from ``bench.py``'s
-``input_pipeline`` section under ``BENCH_BUDGET_S``.
+``input_pipeline`` / ``zero_sharding`` sections under
+``BENCH_BUDGET_S``.
 """
 
 import argparse
@@ -53,19 +60,22 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as np  # noqa: E402
 
 
-def _make_net(seed=0, n_in=64, hidden=256, n_out=8):
+def _make_net(seed=0, n_in=64, hidden=256, n_out=8, updater=None):
     from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    conf = (
+    b = (
         NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.1)
-        .list()
+    )
+    if updater:
+        b = b.updater(updater)
+    b = (
+        b.list()
         .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="tanh"))
         .layer(OutputLayer(n_out=n_out))
-        .build()
     )
-    return MultiLayerNetwork(conf).init()
+    return MultiLayerNetwork(b.build()).init()
 
 
 class CostlyIterator:
@@ -193,9 +203,126 @@ def _remat_ab(batches, policy, windows, seed) -> dict:
     return out
 
 
+def _upd_bytes_per_device(model):
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(model.updater_state):
+        if hasattr(leaf, "addressable_shards"):
+            total += leaf.addressable_shards[0].data.nbytes
+        else:
+            total += np.asarray(leaf).nbytes
+    return total
+
+
+def _zero_ab(batches, windows, seed) -> dict:
+    """ZeRO optimizer-state sharding A/B on the same seeded batches
+    through ``DistributedTrainer``: steps/sec replicated vs sharded,
+    per-device updater bytes for both (the ~1/N claim), and the
+    bitwise trajectory check (sharding may only move bytes, never
+    change what is trained)."""
+    import jax
+
+    from deeplearning4j_tpu.parallel import (
+        DistributedTrainer, build_mesh,
+    )
+
+    def mk(zero):
+        net = _make_net(seed=seed, updater="ADAM")
+        return DistributedTrainer(net, mesh=build_mesh(), zero=zero)
+
+    def fit_all(tr):
+        for ds in batches:
+            tr.fit_minibatch(ds)
+        jax.block_until_ready(tr.model.params)
+
+    trainers = {"replicated": mk(False), "zero": mk(True)}
+    for tr in trainers.values():
+        tr.fit_minibatch(batches[0])  # compile outside windows
+        jax.block_until_ready(tr.model.params)
+    out = {"data_shards": int(trainers["zero"].mesh.shape["data"])}
+    best = {k: float("inf") for k in trainers}
+    for _ in range(windows):
+        for key, tr in trainers.items():
+            t0 = time.perf_counter()
+            fit_all(tr)
+            best[key] = min(best[key], time.perf_counter() - t0)
+    for key, tr in trainers.items():
+        out[f"steps_per_s_{key}"] = round(len(batches) / best[key], 2)
+        out[f"updater_bytes_per_device_{key}"] = (
+            _upd_bytes_per_device(tr.model)
+        )
+    out["updater_bytes_ratio"] = round(
+        out["updater_bytes_per_device_zero"]
+        / max(out["updater_bytes_per_device_replicated"], 1), 4,
+    )
+    fresh = {key: mk(key == "zero") for key in trainers}
+    for tr in fresh.values():
+        fit_all(tr)
+    out["trajectory_match"] = bool(np.array_equal(
+        _params_flat(fresh["replicated"].model),
+        _params_flat(fresh["zero"].model),
+    ))
+    return out
+
+
+def _grad_accum_ab(batches, k, windows, seed) -> dict:
+    """In-jit gradient-accumulation A/B through the GSPMD trainer
+    step: steps/sec with accum=1 vs accum=k on the same batches, and
+    the trajectory check vs the single-big-batch run (tight
+    tolerance — the batch-dim matmul regroups its reduction under the
+    microbatch scan; the BITWISE contract is vs the unfused
+    per-microbatch reference, pinned in tests/test_zero.py)."""
+    import jax
+
+    from deeplearning4j_tpu.nn import core
+    from deeplearning4j_tpu.parallel import (
+        DistributedTrainer, build_mesh,
+    )
+
+    def mk(kk):
+        net = _make_net(seed=seed, updater="ADAM")
+        tr = DistributedTrainer(net, mesh=build_mesh())
+        if kk > 1:
+            core.set_grad_accum(net, kk)
+        return tr
+
+    def fit_all(tr):
+        for ds in batches:
+            tr.fit_minibatch(ds)
+        jax.block_until_ready(tr.model.params)
+
+    trainers = {"accum1": mk(1), f"accum{k}": mk(k)}
+    for tr in trainers.values():
+        tr.fit_minibatch(batches[0])
+        jax.block_until_ready(tr.model.params)
+    out = {"microbatches": k}
+    best = {key: float("inf") for key in trainers}
+    for _ in range(windows):
+        for key, tr in trainers.items():
+            t0 = time.perf_counter()
+            fit_all(tr)
+            best[key] = min(best[key], time.perf_counter() - t0)
+    for key in trainers:
+        out[f"steps_per_s_{key}"] = round(len(batches) / best[key], 2)
+    fresh = {key: mk(kk) for key, kk in (("accum1", 1),
+                                         (f"accum{k}", k))}
+    for tr in fresh.values():
+        fit_all(tr)
+    a = _params_flat(fresh["accum1"].model)
+    b = _params_flat(fresh[f"accum{k}"].model)
+    # float-ulp regrouping noise compounds through ADAM's moment
+    # normalization over the window, so the gate is loose; the raw
+    # max divergence is reported for trend tracking
+    out["trajectory_close"] = bool(np.allclose(a, b, rtol=5e-3,
+                                               atol=1e-5))
+    out["trajectory_max_abs_diff"] = float(np.max(np.abs(a - b)))
+    return out
+
+
 def run(steps=40, batch=256, io_ms=4.0, cost_loops=0,
         queue_depth=3, max_in_flight=3, windows=3,
-        seed=0, remat="none") -> dict:
+        seed=0, remat="none", zero=False, grad_accum=0) -> dict:
     import jax
 
     from deeplearning4j_tpu.datasets.api import DataSet
@@ -316,6 +443,12 @@ def run(steps=40, batch=256, io_ms=4.0, cost_loops=0,
     )
     if remat and remat != "none":
         out["remat"] = _remat_ab(batches, remat, windows, seed)
+    if zero:
+        out["zero_sharding"] = _zero_ab(batches, windows, seed)
+    if grad_accum and grad_accum > 1:
+        out["grad_accum"] = _grad_accum_ab(
+            batches, grad_accum, windows, seed
+        )
     return out
 
 
@@ -337,12 +470,22 @@ def main():
                     choices=("none", "dots_saveable", "full"),
                     help="also A/B activation remat off vs this "
                          "policy (steps/sec + bitwise trajectory)")
+    ap.add_argument("--zero", action="store_true",
+                    help="also A/B ZeRO optimizer-state sharding "
+                         "(steps/sec + per-device updater bytes + "
+                         "bitwise trajectory)")
+    ap.add_argument("--grad-accum", type=int, default=0,
+                    metavar="K",
+                    help="also A/B in-jit gradient accumulation "
+                         "accum=1 vs accum=K (steps/sec + trajectory "
+                         "vs the single-big-batch run)")
     args = ap.parse_args()
     print(json.dumps(run(
         steps=args.steps, batch=args.batch, io_ms=args.io_ms,
         cost_loops=args.cost_loops, queue_depth=args.queue_depth,
         max_in_flight=args.max_in_flight, windows=args.windows,
-        seed=args.seed, remat=args.remat,
+        seed=args.seed, remat=args.remat, zero=args.zero,
+        grad_accum=args.grad_accum,
     )))
 
 

@@ -12,7 +12,11 @@ lint keeps them collapsed:
 2. Neither engine module may call the primitives that define a hot
    path of its own: ``value_and_grad`` / ``grad`` (a private backward
    pass), ``lax.scan`` / ``checkpoint`` / ``remat`` (a private
-   whole-net transform), or ``updater.update`` outside the core.
+   whole-net transform), ``updater.update`` outside the core, or any
+   cross-device collective (``psum`` / ``all_gather`` /
+   ``psum_scatter`` — collectives live only in ``parallel/`` and
+   ``nn/core.py``; an engine that grows one has re-inlined a
+   distribution concern, e.g. the ZeRO all-gather).
 3. The core must actually define the shared machinery the engines
    claim to delegate to (``build_step``, ``build_multi_step``,
    ``build_pretrain_step``, ``apply_layer_run``, ``fit_batches``).
@@ -45,6 +49,12 @@ CORE = NN / "core.py"
 # path grew back (the backward pass, a scan fusion, or a remat wrap
 # that belongs in the core)
 FORBIDDEN_CALLS = {"value_and_grad", "scan", "checkpoint", "remat"}
+# cross-device collectives: distribution (grad psum, the ZeRO state
+# all-gather, reduce-scatter variants) lives in parallel/ + nn/core.py
+# only — an engine file growing one of these has re-inlined it
+FORBIDDEN_COLLECTIVES = {
+    "psum", "all_gather", "all_gather_invariant", "psum_scatter",
+}
 # plus updater.update(...) — the optimizer application site
 FORBIDDEN_METHOD_ON = {"update": {"updater", "upd_def", "updater_def"}}
 
@@ -103,6 +113,11 @@ def check_engine(name: str, path: Path, errors: list) -> None:
                 f"{path.name}:{node.lineno}: calls {cn}() — the "
                 "backward pass / scan fusion / remat belongs in "
                 "nn/core.py"
+            )
+        if cn in FORBIDDEN_COLLECTIVES:
+            errors.append(
+                f"{path.name}:{node.lineno}: calls {cn}() — "
+                "collectives live only in parallel/ + nn/core.py"
             )
         bases = FORBIDDEN_METHOD_ON.get(cn)
         if bases and base in bases:

@@ -76,7 +76,11 @@ python scripts/lint_parity.py || exit 1
 #   tests/test_elastic.py        — device loss mid-run -> survivor-
 #                                  mesh recovery from the host-RAM
 #                                  snapshot ring (no steps lost beyond
-#                                  the last snapshot); injected
+#                                  the last snapshot); the same storm
+#                                  with zero=True ZeRO-sharded
+#                                  optimizer state (8->4 survivors
+#                                  re-shard the moments, bitwise vs a
+#                                  piecewise reference); injected
 #                                  straggler -> straggler_detected_total
 STORMS=(
     tests/test_resilience.py

@@ -263,6 +263,56 @@ def test_chaos_device_loss_second_epoch_and_steps_lost_bound():
 
 
 @pytest.mark.chaos
+def test_chaos_device_loss_with_zero_sharded_optimizer_bitwise():
+    """The headline storm with ZeRO-sharded moments: kill devices 4-7
+    at step 6 of a ``zero=True`` run. The snapshot ring held ONE
+    canonical host copy of the sharded updater state, recovery
+    re-shards it 4 ways over the survivors, and the finished run is
+    bitwise identical to a piecewise ``zero=True`` reference (8-wide
+    to the snapshot, 4-wide after) — device loss never costs
+    optimizer-state precision or placement correctness."""
+    conftest.require_devices(8)
+    import jax
+
+    from deeplearning4j_tpu.nn import core as nn_core
+
+    rng = np.random.RandomState(CHAOS_SEED)
+    bs = mk_batches(rng, n_batches=12, batch=16)
+
+    m = simple_net()
+    et = ElasticTrainer(m, snapshot_every=4, zero=True)
+    assert et.trainer.zero and m._zero_layout == {"shards": 8}
+    m.listeners.append(LoseDevicesAt(et, at=6, shards=[4, 5, 6, 7]))
+    et.fit(bs, epochs=1)
+
+    assert et.recoveries == 1
+    assert {d.id for d in et.devices()} == {0, 1, 2, 3}
+    assert m.iteration_count == 12
+    assert m._zero_layout == {"shards": 4}  # re-sharded onto survivors
+
+    ref = simple_net()
+    DistributedTrainer(ref, zero=True).fit(
+        ListDataSetIterator(bs[:4]), epochs=1)
+    survivors = [d for d in jax.devices() if d.id < 4]
+    tr4 = DistributedTrainer(
+        ref, mesh=build_mesh(data=4, model=1, devices=survivors),
+        zero=True)
+    tr4.fit(ListDataSetIterator(bs[4:]), epochs=1)
+
+    conftest.assert_params_match(m, ref)
+    gm = nn_core.zero_gather_updater_state(m.updater_state, m.params)
+    gr = nn_core.zero_gather_updater_state(ref.updater_state,
+                                           ref.params)
+    for ln in gm:
+        for pn in gm[ln]:
+            for u, v in zip(gm[ln][pn], gr[ln][pn]):
+                np.testing.assert_array_equal(
+                    np.asarray(u), np.asarray(v),
+                    err_msg=f"{ln}/{pn}",
+                )
+
+
+@pytest.mark.chaos
 def test_chaos_total_loss_is_unrecoverable():
     conftest.require_devices(2)
     m = simple_net()

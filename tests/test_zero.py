@@ -1,0 +1,521 @@
+"""ZeRO-1 optimizer-state sharding + in-jit gradient accumulation.
+
+The contracts this file pins:
+
+- ``DistributedTrainer(zero=True)`` shards the updater moments
+  (flattened, zero-padded, ``P("data")``) so each device holds 1/N of
+  the optimizer state, while the TRAJECTORY stays bitwise identical
+  to the replicated trainer — the update math is elementwise, so the
+  flat-shard view computes exactly the canonical bits, and padding
+  slots (grad 0, state 0) step by exactly 0 under every updater rule.
+- ``fit(grad_accum=K)`` scans K microbatches inside one jitted step,
+  accumulating f32 gradients before a single updater apply. The scan
+  is asserted BITWISE against an unfused per-microbatch reference
+  (same fold order, same f32 accumulate); vs the single-big-batch
+  step it is numerically equivalent but NOT bit-equal in general —
+  the batch-dim matmul reduction regroups — so that comparison is
+  tight-tolerance, and batch-statistics layers are rejected outright
+  (each microbatch would see its own stats: different math, not just
+  different bits).
+- Checkpoints and snapshots always hold CANONICAL (gathered) updater
+  state: save on an 8-wide zero mesh, resume bitwise on 4 devices or
+  1 — the layout is a property of the trainer placement, never of
+  the persisted artifact. AOT artifacts bake the layout into their
+  fingerprint (``+zero`` / ``+accum:K``) and refuse to install into
+  a model running a different one.
+"""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+import conftest
+
+from deeplearning4j_tpu.datasets.api import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    DenseLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability.metrics import default_registry
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.trainer import DistributedTrainer
+from deeplearning4j_tpu.resilience.checkpoint import (
+    CheckpointManager,
+    restore_into,
+)
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+
+def _mlp(seed=7, updater="ADAM", lr=0.05, width=4, **transforms):
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .learning_rate(lr).updater(updater).list())
+    b.layer(DenseLayer(n_in=width, n_out=8, activation="tanh"))
+    b.layer(OutputLayer(n_in=8, n_out=3))
+    net = MultiLayerNetwork(b.build()).init()
+    if transforms:
+        net.set_transforms(**transforms)
+    return net
+
+
+def _graph(seed=9, width=6):
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .learning_rate(0.05).updater("ADAM")
+         .graph_builder().add_inputs("in"))
+    b.add_layer("d0", DenseLayer(n_in=width, n_out=width,
+                                 activation="tanh"), "in")
+    b.add_layer("out", OutputLayer(n_in=width, n_out=3), "d0")
+    b.set_outputs("out")
+    return ComputationGraph(b.build()).init()
+
+
+def _batches(n=6, batch=16, width=4, classes=3, seed=0):
+    r = np.random.RandomState(seed)
+    return [
+        DataSet(
+            features=r.randn(batch, width).astype(np.float32),
+            labels=np.eye(classes, dtype=np.float32)[
+                r.randint(0, classes, batch)
+            ],
+        )
+        for _ in range(n)
+    ]
+
+
+def _assert_updater_bitwise(a_state, b_state):
+    for ln in a_state:
+        for pn in a_state[ln]:
+            for i, (u, v) in enumerate(
+                zip(a_state[ln][pn], b_state[ln][pn])
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(u), np.asarray(v),
+                    err_msg=f"{ln}/{pn}[{i}]",
+                )
+
+
+def _upd_bytes_per_device(model):
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(model.updater_state):
+        if hasattr(leaf, "addressable_shards"):
+            total += leaf.addressable_shards[0].data.nbytes
+        else:
+            total += np.asarray(leaf).nbytes
+    return total
+
+
+# ---------------------------------------------------------------------------
+# zero layout primitives
+# ---------------------------------------------------------------------------
+
+
+def test_zero_flat_layout_roundtrip():
+    # padded flat length: rounded up to a shard multiple; scalars too
+    assert core.zero_flat_size((3, 5), 8) == 16
+    assert core.zero_flat_size((4, 4), 8) == 16
+    assert core.zero_flat_size((), 8) == 8
+    a = np.arange(15, dtype=np.float32).reshape(3, 5)
+    v = np.asarray(core.zero_flatten_leaf(a, 8))
+    assert v.shape == (16,) and v[15] == 0.0
+    back = np.asarray(core.zero_unflatten_leaf(v, (3, 5)))
+    np.testing.assert_array_equal(back, a)
+    # closures match the layout dict contract
+    f, u = core.zero_layout_closures({"shards": 8})
+    np.testing.assert_array_equal(np.asarray(f(a)), v)
+    assert core.zero_layout_closures(None) == (None, None)
+
+
+def test_zero_gather_is_idempotent_and_observed():
+    net = _mlp()
+    gathered = core.zero_gather_updater_state(
+        net.updater_state, net.params
+    )
+    _assert_updater_bitwise(gathered, net.updater_state)
+    # the gather path is timed
+    snap = default_registry().get("zero_allgather_ms").snapshot()
+    assert snap["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: zero=True trains the same bits on 1/N the state
+# ---------------------------------------------------------------------------
+
+
+def test_zero_trainer_bitwise_vs_replicated_and_sharded_bytes():
+    """The headline claim: on an 8-wide mesh, ``zero=True`` walks the
+    exact replicated trajectory while each device holds ~1/8 of the
+    ADAM moments (gauge-asserted at <= 1/4, the acceptance floor)."""
+    conftest.require_devices(8)
+    bs = _batches()
+    mesh = build_mesh(data=8, model=1)
+
+    ref = _mlp()
+    t_ref = DistributedTrainer(ref, mesh=mesh)
+    z = _mlp()
+    t_z = DistributedTrainer(z, mesh=mesh, zero=True)
+    assert z._zero_layout == {"shards": 8}
+
+    for ds in bs:
+        t_ref.fit_minibatch(ds)
+        t_z.fit_minibatch(ds)
+
+    conftest.assert_params_match(ref, z)
+    gathered = core.zero_gather_updater_state(z.updater_state, z.params)
+    _assert_updater_bitwise(ref.updater_state, gathered)
+
+    repl = _upd_bytes_per_device(ref)
+    shard = _upd_bytes_per_device(z)
+    assert shard <= repl / 4, (shard, repl)
+
+    reg = default_registry()
+    assert reg.get("updater_state_bytes_per_device").value == shard
+    assert reg.get("zero_shard_bytes").value == shard
+    # the gauge reflects whichever trainer placed params last; the
+    # replicated one published repl bytes when IT placed
+    assert repl > 0 and shard > 0
+
+
+def test_zero_rejects_incompatible_modes():
+    conftest.require_devices(2)
+    with pytest.raises(ValueError, match="tensor_parallel"):
+        DistributedTrainer(_mlp(), tensor_parallel=True, zero=True)
+    with pytest.raises(ValueError, match="batch_stats"):
+        DistributedTrainer(_mlp(), batch_stats="local", zero=True)
+
+
+def test_zero_composes_with_scan_over_layers():
+    """zero shards the moments of the SCANNED (stacked) params too —
+    the flat layout applies per leaf, stacked or not."""
+    conftest.require_devices(8)
+
+    def deep(**tf):
+        b = (NeuralNetConfiguration.Builder().seed(3)
+             .learning_rate(0.05).updater("ADAM").list())
+        for _ in range(4):
+            b.layer(DenseLayer(n_in=6, n_out=6, activation="tanh"))
+        b.layer(OutputLayer(n_in=6, n_out=3))
+        net = MultiLayerNetwork(b.build()).init()
+        if tf:
+            net.set_transforms(**tf)
+        return net
+
+    bs = _batches(n=4, width=6)
+    mesh = build_mesh(data=8, model=1)
+    ref = deep(scan_layers=True)
+    z = deep(scan_layers=True)
+    t_ref = DistributedTrainer(ref, mesh=mesh)
+    t_z = DistributedTrainer(z, mesh=mesh, zero=True)
+    for ds in bs:
+        t_ref.fit_minibatch(ds)
+        t_z.fit_minibatch(ds)
+    conftest.assert_params_match(ref, z)
+    _assert_updater_bitwise(
+        ref.updater_state,
+        core.zero_gather_updater_state(z.updater_state, z.params),
+    )
+
+
+def test_zero_composes_with_loss_scaling():
+    """f16 compute + dynamic loss scaling + sharded moments: the
+    scale/unscale/finite-probe runs on replicated grads, the update
+    on flat shards — same bits as the replicated ls trainer."""
+    conftest.require_devices(8)
+
+    def f16():
+        b = (NeuralNetConfiguration.Builder().seed(5)
+             .learning_rate(0.05).data_type("float32")
+             .compute_data_type("float16").list())
+        b.layer(DenseLayer(n_in=8, n_out=8, activation="tanh"))
+        b.layer(OutputLayer(n_in=8, n_out=3))
+        net = MultiLayerNetwork(b.build()).init()
+        net.set_transforms(loss_scale=True)
+        return net
+
+    bs = _batches(n=4, width=8)
+    mesh = build_mesh(data=8, model=1)
+    ref = f16()
+    z = f16()
+    t_ref = DistributedTrainer(ref, mesh=mesh)
+    t_z = DistributedTrainer(z, mesh=mesh, zero=True)
+    for ds in bs:
+        t_ref.fit_minibatch(ds)
+        t_z.fit_minibatch(ds)
+    conftest.assert_params_match(ref, z)
+    assert int(ref._loss_scale_state["good_steps"]) == len(bs)
+    assert int(z._loss_scale_state["good_steps"]) == len(bs)
+
+
+# ---------------------------------------------------------------------------
+# in-jit gradient accumulation
+# ---------------------------------------------------------------------------
+
+
+def test_accum_grad_step_bitwise_vs_unfused_loop():
+    """The fused scan computes EXACTLY the unfused K-step reference:
+    same microbatch row blocks, same fold_in keys, same f32
+    accumulation order, same 1/k (exact for power-of-two k)."""
+    import jax.numpy as jnp
+
+    net = _mlp()
+    bs = _batches(n=1, batch=16)[0]
+    x = jnp.asarray(bs.features)
+    y = jnp.asarray(bs.labels)
+    rng = jax.random.PRNGKey(42)
+    k = 4
+
+    def score_fn(p, st, xj, yj, mj, fj, rj):
+        return net._score_pure(p, st, xj, yj, mj, rj, train=True,
+                               fmask=fj)
+
+    (score, _), grads = jax.jit(
+        lambda p, st: core.accum_grad_step(
+            score_fn, p, st, x, y, None, None, rng, k
+        )
+    )(net.params, net.state)
+
+    # unfused reference
+    acc = jax.tree_util.tree_map(
+        lambda p: np.zeros(np.shape(p), np.float32), net.params
+    )
+    ssum = np.float32(0.0)
+    n = x.shape[0] // k
+    st = net.state
+    for j in range(k):
+        rj = jax.random.fold_in(rng, j)
+        (sj, st), gj = jax.jit(
+            lambda p, s, xj, yj, r: core.grad_step(
+                score_fn, p, s, xj, yj, None, None, r
+            )
+        )(net.params, st, x[j * n:(j + 1) * n], y[j * n:(j + 1) * n],
+          rj)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + np.asarray(g, np.float32), acc, gj
+        )
+        ssum = ssum + np.float32(sj)
+    inv = 1.0 / k
+    ref_grads = jax.tree_util.tree_map(
+        lambda a, p: (a * inv).astype(np.asarray(p).dtype),
+        acc, net.params,
+    )
+    for ga, gb in zip(jax.tree_util.tree_leaves(grads),
+                      jax.tree_util.tree_leaves(ref_grads)):
+        np.testing.assert_array_equal(np.asarray(ga), np.asarray(gb))
+    assert np.float32(ssum * inv) == np.float32(score)
+
+
+def test_grad_accum_engine_trajectory_vs_big_batch():
+    """accum=1 is bitwise the plain step; accum=K matches the
+    single-big-batch trajectory to tight tolerance (the batch-dim
+    matmul regroups its reduction — numerically equivalent, not
+    bit-equal; the bitwise contract is vs the unfused reference,
+    pinned above)."""
+    bs = _batches()
+    a = _mlp()
+    for ds in bs:
+        a.fit(ds)
+    b = _mlp()
+    for ds in bs:
+        b.fit(ds, grad_accum=1)
+    conftest.assert_params_match(a, b)
+
+    c = _mlp()
+    for ds in bs:
+        c.fit(ds, grad_accum=4)
+    assert c.grad_accum == 4
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[ln][pn]),
+                np.asarray(c.params[ln][pn]),
+                rtol=2e-5, atol=1e-7, err_msg=f"{ln}/{pn}",
+            )
+    reg = default_registry()
+    assert reg.get("grad_accum_microbatches").value == 4
+
+
+def test_grad_accum_graph_engine():
+    bs = _batches(width=6)
+    a = _graph()
+    for ds in bs:
+        a.fit(ds)
+    b = _graph()
+    for ds in bs:
+        b.fit(ds, grad_accum=2)
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[ln][pn]),
+                np.asarray(b.params[ln][pn]),
+                rtol=2e-5, atol=1e-7, err_msg=f"{ln}/{pn}",
+            )
+
+
+def test_grad_accum_rejections():
+    net = _mlp()
+    with pytest.raises(ValueError, match="grad_accum"):
+        net.fit(_batches(n=1)[0], grad_accum=0)
+    # batch must split into equal microbatches
+    with pytest.raises(ValueError, match="microbatch"):
+        net.fit(_batches(n=1, batch=10)[0], grad_accum=4)
+    # batch-statistics layers change the math per microbatch
+    b = (NeuralNetConfiguration.Builder().seed(1)
+         .learning_rate(0.05).list())
+    b.layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+    b.layer(BatchNormalization(n_out=8))
+    b.layer(OutputLayer(n_in=8, n_out=3))
+    bn_net = MultiLayerNetwork(b.build()).init()
+    with pytest.raises(ValueError, match="batch-statistics"):
+        bn_net.fit(_batches(n=1)[0], grad_accum=2)
+
+
+def test_grad_accum_trainer_gspmd_and_zero_compose():
+    """Trainer-level accumulation rides the GSPMD step; with
+    zero=True on top, the trajectory is bitwise the plain (replicated)
+    accumulated one — composition does not change bits."""
+    conftest.require_devices(8)
+    bs = _batches()
+    mesh = build_mesh(data=8, model=1)
+
+    plain = _mlp()
+    t_p = DistributedTrainer(plain, mesh=mesh)
+    t_p.fit(ListDataSetIterator(bs), epochs=1)
+
+    acc = _mlp()
+    t_a = DistributedTrainer(acc, mesh=mesh)
+    t_a.fit(ListDataSetIterator(bs), epochs=1, grad_accum=2)
+    for ln in plain.params:
+        for pn in plain.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(plain.params[ln][pn]),
+                np.asarray(acc.params[ln][pn]),
+                rtol=2e-5, atol=1e-7, err_msg=f"{ln}/{pn}",
+            )
+
+    both = _mlp()
+    t_b = DistributedTrainer(both, mesh=mesh, zero=True)
+    t_b.fit(ListDataSetIterator(bs), epochs=1, grad_accum=2)
+    conftest.assert_params_match(acc, both)
+
+    # microbatches must also split across the data axis
+    with pytest.raises(ValueError, match="grad_accum"):
+        t_a.place_minibatch(_batches(n=1, batch=12)[0])
+
+
+# ---------------------------------------------------------------------------
+# sharding-aware persistence: save on 8, resume on 4 / 1
+# ---------------------------------------------------------------------------
+
+
+def test_zero_checkpoint_cross_mesh_resume_bitwise(tmp_path):
+    """Checkpoints hold canonical updater state + record the zero
+    layout in the manifest; resume re-shards onto whatever mesh is
+    present — 4-wide and single-device (replicated fallback) resumes
+    are bitwise the replicated resume on the same mesh."""
+    conftest.require_devices(8)
+    bs = _batches(n=8, batch=8)
+    z = _mlp()
+    trz = DistributedTrainer(z, mesh=build_mesh(data=8, model=1),
+                             zero=True)
+    for ds in bs[:4]:
+        trz.fit_minibatch(ds)
+
+    mgr = CheckpointManager(tmp_path)
+    info = mgr.save(z)
+    assert info.zero == {"shards": 8}
+    # manifest round-trips the layout
+    reread = mgr.available()[-1]
+    assert reread.zero == {"shards": 8}
+    # the model keeps training sharded after the save (non-mutating)
+    assert z._zero_layout == {"shards": 8}
+
+    for ndev in (4, 1):
+        devs = [d for d in jax.devices() if d.id < ndev]
+        mesh = build_mesh(data=ndev, model=1, devices=devs)
+
+        mz = _mlp()
+        restore_into(mz, mgr)
+        assert mz._zero_layout is None  # canonical until re-placed
+        tz = DistributedTrainer(mz, mesh=mesh, zero=True)
+        assert mz._zero_layout == {"shards": ndev}
+
+        mr = _mlp()
+        restore_into(mr, mgr)
+        tr = DistributedTrainer(mr, mesh=mesh)
+
+        for ds in bs[4:]:
+            tz.fit_minibatch(ds)
+            tr.fit_minibatch(ds)
+        conftest.assert_params_match(mz, mr)
+        _assert_updater_bitwise(
+            mr.updater_state,
+            core.zero_gather_updater_state(mz.updater_state, mz.params),
+        )
+
+
+def test_zero_snapshot_ring_holds_one_canonical_copy():
+    """SnapshotRing gathers the shards: the ring entry's updater
+    leaves are canonical-shaped host arrays (one copy of each shard,
+    never N padded replicas)."""
+    conftest.require_devices(8)
+    from deeplearning4j_tpu.parallel.elastic import SnapshotRing
+
+    z = _mlp()
+    trz = DistributedTrainer(z, mesh=build_mesh(data=8, model=1),
+                             zero=True)
+    trz.fit_minibatch(_batches(n=1)[0])
+    ring = SnapshotRing(capacity=2)
+    snap = ring.push(z)
+    for ln, lp in z.params.items():
+        for pn, p in lp.items():
+            for arr in snap["updater_state"][ln][pn]:
+                assert arr.shape == np.shape(p)
+    # live model still sharded
+    assert z._zero_layout == {"shards": 8}
+    # restoring drops the layout marker (host state is canonical)
+    ring.restore_into_model(z)
+    assert z._zero_layout is None
+
+
+# ---------------------------------------------------------------------------
+# AOT: the layout is part of the step fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_aot_step_kind_encodes_zero_and_accum():
+    net = _mlp()
+    assert net._step_kind() == "step"
+    core.set_grad_accum(net, 2)
+    assert net._step_kind() == "step+accum:2"
+    net._zero_layout = {"shards": 8}
+    assert net._step_kind() == "step+accum:2+zero"
+    core.set_grad_accum(net, 1)
+    assert net._step_kind() == "step+zero"
+
+
+def test_aot_zero_fingerprint_mismatch_refused():
+    """A plain-step artifact must not install into a zero-laid-out
+    model (the compiled update math expects flat sharded moments),
+    and the refusal counts an aot fallback."""
+    reg = default_registry()
+    m = reg.get("aot_fallback_total")
+    before = m.value if m is not None else 0
+    ds = _batches(n=1)[0]
+    src = _mlp()
+    blob = src.aot_export_step(ds)
+    twin = _mlp()
+    assert twin.aot_install_step(blob) is True
+    zeroed = _mlp()
+    zeroed._zero_layout = {"shards": 8}
+    assert zeroed.aot_install_step(blob) is False
+    assert reg.get("aot_fallback_total").value > before
