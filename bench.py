@@ -1266,6 +1266,38 @@ def bench_zero_sharding(budget_s=None) -> dict:
     }
 
 
+def bench_data_defense(budget_s=None) -> dict:
+    """Bad-data defense A/B via the standalone training script
+    (subprocess — it builds its own nets, validator, quarantine store
+    and stat-guard on a realistically sized step). Reports the
+    script's ``defense`` payload; the acceptance gates are
+    ``overhead_fraction`` <= 0.05 (validator + statistical guard on
+    the clean path), ``quarantined_on_clean`` == 0, and the two
+    no-trip bitwise lemmas (``validator_bitwise``,
+    ``statguard_bitwise``) — rolled up as ``defense_ok``."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_training.py",
+    )
+    timeout = 300
+    if budget_s is not None:
+        timeout = max(30, min(timeout, int(budget_s)))
+    out = subprocess.run(
+        [sys.executable, script, "--steps", "16", "--io-ms", "0",
+         "--defense"],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ,
+             "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE or "",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_training --defense failed: {out.stderr[-2000:]}"
+        )
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    return doc.get("defense", {})
+
+
 def bench_aot_compile(budget_s=None) -> dict:
     """Cold vs warm serving boot through the compile-artifact
     subsystem, via the standalone A/B script (subprocess — it boots
@@ -1534,6 +1566,12 @@ def _section_table(budget_fn):
          "(scripts/bench_training.py --zero --grad-accum 4; bitwise "
          "trajectory_match and updater_bytes_ratio <= 0.25 are the "
          "gates)"),
+        ("data_defense",
+         lambda: bench_data_defense(budget_fn()),
+         "bad-data defense clean-path A/B: validator + statistical "
+         "anomaly guard off vs on (scripts/bench_training.py "
+         "--defense; overhead <= 5%, zero clean quarantines and the "
+         "no-trip bitwise lemmas are the gates)"),
         ("aot_compile",
          lambda: bench_aot_compile(budget_fn()),
          "cold-vs-warm serving boot-to-ready "

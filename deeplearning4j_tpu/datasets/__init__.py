@@ -12,6 +12,12 @@ from deeplearning4j_tpu.datasets.api import (  # noqa: F401
 from deeplearning4j_tpu.datasets.prefetch import (  # noqa: F401
     PrefetchIterator,
 )
+from deeplearning4j_tpu.datasets.validate import (  # noqa: F401
+    BatchSchema,
+    BatchValidator,
+    QuarantineStore,
+    ValidatingIterator,
+)
 from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     AsyncDataSetIterator,
     DevicePrefetchIterator,

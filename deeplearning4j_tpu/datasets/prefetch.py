@@ -89,13 +89,33 @@ class PrefetchIterator(AsyncDataSetIterator):
     Without ``placement`` the worker only materializes host batches —
     still worthwhile when ``base.next()`` is expensive. With it, the
     consumer receives :class:`~..api.PlacedDataSet` device batches.
+
+    ``validator`` (a :class:`~.validate.BatchValidator`) screens every
+    base batch ON THE WORKER THREAD before placement — the validation
+    host pass rides the same overlap as materialization, so a defended
+    pipeline costs the consumer nothing extra; offenders go to
+    ``quarantine`` (a :class:`~.validate.QuarantineStore`) and are
+    skipped. The wrapped validating iterator is exposed as
+    ``self.validating`` for ledger access.
     """
 
     def __init__(self, base: DataSetIterator, queue_depth: int = 2,
                  placement: Optional[Callable] = None,
-                 registry=None):
+                 registry=None, validator=None, quarantine=None):
         if queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
+        self.validating = None
+        if validator is not None:
+            from deeplearning4j_tpu.datasets.validate import (
+                ValidatingIterator,
+            )
+
+            if isinstance(base, ValidatingIterator):
+                self.validating = base
+            else:
+                self.validating = base = ValidatingIterator(
+                    base, validator, quarantine=quarantine,
+                )
         super().__init__(
             _PlacingIterator(base, placement), queue_depth
         )

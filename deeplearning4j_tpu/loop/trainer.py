@@ -50,12 +50,20 @@ class ContinualTrainer:
     def __init__(self, model, manager, *, publish_every: int = 8,
                  trainer=None, aot_buckets=None,
                  artifact_fn: Optional[Callable] = None,
-                 feature_shape=None, journal=None, registry=None):
+                 feature_shape=None, journal=None, registry=None,
+                 validator=None, quarantine=None):
         if publish_every < 1:
             raise ValueError("publish_every must be >= 1")
         self.model = model
         self.manager = manager
         self.trainer = trainer
+        # data-plane defense: a datasets.BatchValidator screens every
+        # stream batch before it reaches fit; offenders land in the
+        # datasets.QuarantineStore and the quarantine ledger rides the
+        # published manifests (bitwise kill/resume)
+        self.validator = validator
+        self.quarantine = quarantine
+        self._resume_ledger = None
         self.publish_every = int(publish_every)
         self.aot_buckets = list(aot_buckets) if aot_buckets else None
         self.feature_shape = feature_shape
@@ -100,6 +108,10 @@ class ContinualTrainer:
             (i for i in self.manager.available() if i.step == step),
             None,
         )
+        # restore_into applied the manifest's guard doc; the data
+        # ledger it left on the model tells the next run() how many
+        # BASE batches (quarantined ones included) are already handled
+        self._resume_ledger = getattr(self.model, "_data_ledger", None)
         logger.info("continual trainer resumed at step %d", step)
         return step
 
@@ -154,6 +166,33 @@ class ContinualTrainer:
 
         fit = (self.trainer.fit_minibatch if self.trainer is not None
                else self.model.fit_minibatch)
+        vit = None
+        if self.validator is not None:
+            from deeplearning4j_tpu.datasets.validate import (
+                ValidatingIterator,
+            )
+
+            if isinstance(stream, ValidatingIterator):
+                vit = stream
+            else:
+                vit = stream = ValidatingIterator(
+                    stream, self.validator, quarantine=self.quarantine,
+                )
+            led = self._resume_ledger
+            if led and vit.offset == 0:
+                # bitwise resume: the manifest ledger says the first
+                # `offset` base batches were already fit/quarantined —
+                # re-consume them unvalidated and seed the ledger so
+                # published counts keep accumulating, not restarting
+                vit.fast_forward(int(led.get("offset", 0)))
+                vit.skipped_offsets = [
+                    int(i) for i in led.get("skipped", [])
+                ]
+                vit.reason_counts = {
+                    str(k): int(v)
+                    for k, v in (led.get("reasons") or {}).items()
+                }
+            self._resume_ledger = None
         consumed = 0
         for ds in self._iter(stream):
             # preemption notice -> emergency publish through THIS
@@ -167,6 +206,11 @@ class ContinualTrainer:
             fit(ds)
             consumed += 1
             self._m_steps.inc()
+            if vit is not None:
+                # snapshot AFTER the fit so a publish (scheduled or
+                # preemption-emergency) never claims a base batch the
+                # params don't yet reflect
+                self.model._data_ledger = vit.ledger()
             if self.model.iteration_count % self.publish_every == 0:
                 self.publish()
             if max_steps is not None and consumed >= max_steps:

@@ -488,6 +488,12 @@ class SequenceVectors:
         self.device_epoch_gen = "auto"
         self._dev_base_key = None
         self._dev_corpus = None  # (key, (ids, pos, slen, kp_pos, pool, n))
+        # device-gen continuation counters: repeated fit() calls must
+        # draw FRESH epoch keys (the first fit's stream replayed
+        # verbatim before) and continue the lr schedule where the
+        # last fit stopped instead of restarting it
+        self._dev_fit_no = 0
+        self._dev_steps_done = 0
         self.lookup = InMemoryLookupTable(
             cache, layer_size, seed=seed, use_hs=use_hierarchic_softmax,
             negative=negative,
@@ -748,24 +754,37 @@ class SequenceVectors:
         n_batches = ids_d.shape[0] // B
         E = self.epochs
         lr0, lr_min = self.learning_rate, self.min_learning_rate
-        total = max(n_batches * E * B, 1)
         lk = self.lookup
         if self._dev_base_key is None:
             self._dev_base_key = jax.random.PRNGKey(self.seed)
+        # Repeated fit() calls continue training, not replay it: the
+        # fit counter folds into the base key so call #2 draws fresh
+        # epoch keys (before this, the identical sampling stream
+        # re-ran every call), and the lr schedule resumes from the
+        # steps already taken. The first call folds nothing and sees
+        # the original totals, so its trajectory stays bitwise
+        # identical to prior releases.
+        base_key = self._dev_base_key
+        if self._dev_fit_no:
+            base_key = jax.random.fold_in(base_key, self._dev_fit_no)
+        total = max((self._dev_steps_done + n_batches * E) * B, 1)
         # ALL epochs in one dispatch; the schedule rides in as 4
         # scalars and per-epoch keys fold in on device, so a fit is
         # one tiny transfer + one dispatch (per-epoch dispatching
         # paid ~20 ms of tunnel latency against ~21 ms of device
         # work; so did per-epoch host-side fold_in round trips)
         sched = jnp.asarray(
-            [lr0, lr_min, float(total), 0.0], jnp.float32
+            [lr0, lr_min, float(total), float(self._dev_steps_done)],
+            jnp.float32,
         )
         lk.syn0, lk.syn1neg, _ = _sg_device_epochs(
             lk.syn0, lk.syn1neg, ids_d, pos_d, slen_d, kp_d,
-            pool_d, self._dev_base_key, sched,
+            pool_d, base_key, sched,
             E=E, W=self.window, K=self.negative, B=B,
             dense=_dense_rows(),
         )
+        self._dev_fit_no += 1
+        self._dev_steps_done += n_batches * E
         lk.invalidate_norms()
 
     def fit(self) -> None:

@@ -815,21 +815,26 @@ def grad_step(score_fn, params, state, x, labels, mask, fmask, rng,
 
 def finish_step(updater, grads, score, new_state, params, upd_state,
                 state, lrs, t, *, guarded: bool, telemetry: bool,
-                ls=None, flatten=None, unflatten=None):
+                ls=None, flatten=None, unflatten=None,
+                sg=None, sg_cfg=None):
     """The post-gradient half shared by the engine steps AND the
     distributed trainer's shard_map/GSPMD steps: dynamic loss-scale
     unscale/adjust (when ``ls``, the incoming loss-scale state dict,
     is given — the caller already scaled the loss via ``grad_step``'s
     ``scale``), updater application (optionally through the zero
     flattened-leaf layout via ``flatten``/``unflatten``), optional
-    telemetry grad-norm, optional in-jit divergence-guard select.
+    telemetry grad-norm, optional in-jit divergence-guard select —
+    statistical when ``sg``/``sg_cfg`` (the incoming EWMA state dict
+    + its ``StatGuardConfig``) ride along: a finite-but-anomalous
+    loss or grad-norm is suppressed by the SAME select.
     Returns the step output tuple
     ``(params, upd_state, state, score[, grad_norm]
-    [, loss_scale_state][, ok])``."""
+    [, loss_scale_state][, stat_guard_state][, ok])``."""
     from deeplearning4j_tpu.resilience.guard import (
         divergence_ok,
         grad_global_norm_sq,
         select_updates,
+        stat_guard_update,
     )
 
     tail = ()
@@ -873,16 +878,26 @@ def finish_step(updater, grads, score, new_state, params, upd_state,
             flatten=flatten, unflatten=unflatten,
         )
     extras = ()
+    gnorm = None
     if telemetry:
-        extras = (jnp.sqrt(grad_global_norm_sq(grads)),)
+        gnorm = jnp.sqrt(grad_global_norm_sq(grads))
+        extras = (gnorm,)
     if not guarded:
         return (new_params, new_upd, new_state, score) + extras + tail
     ok = divergence_ok(score, grads)
+    sg_tail = ()
+    if sg is not None:
+        if gnorm is None:
+            gnorm = jnp.sqrt(grad_global_norm_sq(grads))
+        sg_ok, new_sg = stat_guard_update(sg, sg_cfg, score, gnorm, ok)
+        ok = jnp.logical_and(ok, sg_ok)
+        sg_tail = (new_sg,)
     new_params, new_upd, new_state = select_updates(
         ok, new_params, params, new_upd, upd_state, new_state, state,
     )
     return (
-        (new_params, new_upd, new_state, score) + extras + tail + (ok,)
+        (new_params, new_upd, new_state, score)
+        + extras + tail + sg_tail + (ok,)
     )
 
 
@@ -890,7 +905,7 @@ def build_step(score_fn, updater, *, cast=None, guarded: bool = False,
                telemetry: bool = False, loss_scale: bool = False,
                grad_accum: int = 1,
                recurrent_names: Sequence[str] = (),
-               zero_layout=None) -> Callable:
+               zero_layout=None, stat_guard=None) -> Callable:
     """ONE jitted SGD train step for both engines.
 
     ``score_fn(params, state, x, labels, mask, fmask, rng) ->
@@ -906,7 +921,15 @@ def build_step(score_fn, updater, *, cast=None, guarded: bool = False,
     K microbatches (``accum_grad_step``) before the ONE updater apply.
     ``zero_layout`` (``{"shards": n}``) runs the updater through the
     zero flattened-leaf layout — ``upd_state`` leaves are 1-d padded
-    vectors (see the ZeRO section above)."""
+    vectors (see the ZeRO section above). ``stat_guard`` (a
+    ``StatGuardConfig``; requires ``guarded``) threads the statistical
+    anomaly guard's EWMA state as a further trailing argument, after
+    the loss-scale state."""
+    if stat_guard is not None and not guarded:
+        raise ValueError(
+            "stat_guard requires guarded=True (it shares the "
+            "divergence guard's in-jit select and ok flag)"
+        )
     flatten, unflatten = zero_layout_closures(zero_layout)
     k = int(grad_accum)
 
@@ -915,6 +938,10 @@ def build_step(score_fn, updater, *, cast=None, guarded: bool = False,
         if cast is not None:
             x, labels, mask, fmask = cast(x, labels, mask, fmask)
         ls = ls_args[0] if loss_scale else None
+        sg = (
+            ls_args[1 if loss_scale else 0]
+            if stat_guard is not None else None
+        )
         scale = ls["scale"] if loss_scale else None
         if k > 1:
             (score, new_state), grads = accum_grad_step(
@@ -930,6 +957,7 @@ def build_step(score_fn, updater, *, cast=None, guarded: bool = False,
             updater, grads, score, new_state, params, upd_state,
             state, lrs, t, guarded=guarded, telemetry=telemetry,
             ls=ls, flatten=flatten, unflatten=unflatten,
+            sg=sg, sg_cfg=stat_guard,
         )
 
     return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -937,8 +965,8 @@ def build_step(score_fn, updater, *, cast=None, guarded: bool = False,
 
 def apply_step_out(model, out):
     """Unpack one core step's output tuple (base 4 fields, plus the
-    optional telemetry grad-norm, loss-scale state, and guard ok flag)
-    into model state; returns ``(score, ok)``."""
+    optional telemetry grad-norm, loss-scale state, stat-guard state,
+    and guard ok flag) into model state; returns ``(score, ok)``."""
     model.params, model.updater_state, model.state = out[:3]
     score = out[3]
     i = 4
@@ -947,6 +975,9 @@ def apply_step_out(model, out):
         i += 1
     if getattr(model, "_loss_scale_active", False):
         model._loss_scale_state = out[i]
+        i += 1
+    if stat_guard_active(model):
+        model._stat_guard_state = out[i]
         i += 1
     ok = (
         out[i] if getattr(model, "divergence_guard", None) is not None
@@ -1264,6 +1295,19 @@ def fit_batches(model, iterator, epochs: int) -> None:
     reset protocol."""
     if model.params is None:
         model.init()
+    validator = getattr(model, "_batch_validator", None)
+    if validator is not None:
+        from deeplearning4j_tpu.datasets.validate import (
+            ValidatingIterator,
+        )
+
+        if not isinstance(iterator, ValidatingIterator):
+            # data-plane defense: rejects are quarantined before they
+            # reach a step; the surviving stream is what trains
+            iterator = ValidatingIterator(
+                iterator, validator,
+                quarantine=getattr(model, "_quarantine_store", None),
+            )
     if model.conf.pretrain and not model._pretrain_done:
         # reference fit():1064 — layer-wise pretrain before backprop
         if not hasattr(iterator, "reset") and not isinstance(
@@ -1349,6 +1393,9 @@ def init_transforms(model, conf) -> None:
     )
     model._layer_runs_cache = None
     model._loss_scale_state = None
+    model._stat_guard_state = None
+    model._batch_validator = None
+    model._quarantine_store = None
     model.grad_accum = 1
     # {"shards": n} while the updater state lives in the zero
     # flattened-leaf layout (set/cleared by the distributed trainer's
@@ -1389,6 +1436,15 @@ def set_transforms(model, scan_layers=None, remat=None,
             model._jit_tbptt_multi_step = None
 
 
+def set_batch_validator(model, validator, quarantine=None) -> None:
+    """(Un)install the data-plane defense on a model's fit loops:
+    ``fit_batches`` wraps its iterator in a ``ValidatingIterator``
+    quarantining rejects to ``quarantine``. Host-side only — the
+    compiled step is untouched."""
+    model._batch_validator = validator
+    model._quarantine_store = quarantine
+
+
 def loss_scale_active(model) -> bool:
     """Dynamic loss scaling engages only for float16 compute (bf16
     shares f32's exponent range and needs none of it — unchanged)."""
@@ -1404,6 +1460,29 @@ def ensure_loss_scale_state(model):
     return model._loss_scale_state
 
 
+def stat_guard_active(model) -> bool:
+    """The statistical anomaly guard engages when the installed
+    divergence guard carries a ``StatGuardConfig``."""
+    guard = getattr(model, "divergence_guard", None)
+    return guard is not None and getattr(guard, "stats", None) is not None
+
+
+def stat_guard_config(model):
+    guard = getattr(model, "divergence_guard", None)
+    return getattr(guard, "stats", None) if guard is not None else None
+
+
+def ensure_stat_guard_state(model):
+    """The model's device-resident EWMA state dict, created on first
+    use (a checkpoint restore may have installed one already — the
+    bitwise-resume path)."""
+    if getattr(model, "_stat_guard_state", None) is None:
+        from deeplearning4j_tpu.resilience.guard import stat_guard_state
+
+        model._stat_guard_state = stat_guard_state()
+    return model._stat_guard_state
+
+
 def transform_kind_suffix(model) -> str:
     """AOT artifact-kind suffix for the transform knobs that change
     the compiled program (loss-scale changes the step's arity, scan/
@@ -1416,6 +1495,10 @@ def transform_kind_suffix(model) -> str:
         parts.append(f"remat:{model.remat}")
     if getattr(model, "_loss_scale_active", False):
         parts.append("lossscale")
+    if stat_guard_active(model):
+        # a +statguard executable takes (and returns) the EWMA state;
+        # refusing a stale plain artifact beats mis-dispatching it
+        parts.append("statguard")
     if int(getattr(model, "grad_accum", 1)) > 1:
         parts.append(f"accum:{model.grad_accum}")
     if getattr(model, "_zero_layout", None):

@@ -183,11 +183,19 @@ class ComputationGraph:
 
     def set_divergence_guard(self, guard) -> None:
         """(Un)install a resilience.DivergenceGuard on the train step
-        (in-jit NaN/Inf suppression + host-side skip/rollback) — the
+        (in-jit NaN/Inf suppression + host-side skip/rollback; with
+        ``guard.stats`` also the statistical anomaly guard) — the
         core step builder gives the DAG engine the same machinery as
         the sequential engine."""
         self.divergence_guard = guard
         self._jit_step = None
+
+    def set_batch_validator(self, validator, quarantine=None
+                            ) -> "ComputationGraph":
+        """(Un)install the data-plane defense (``datasets.validate``)
+        on this model's ``fit`` loops."""
+        core.set_batch_validator(self, validator, quarantine)
+        return self
 
     def enable_step_telemetry(self, enabled: bool = True) -> None:
         """(Un)install step telemetry: the jitted step additionally
@@ -385,6 +393,7 @@ class ComputationGraph:
             grad_accum=self.grad_accum,
             recurrent_names=self._recurrent_names(),
             zero_layout=self._zero_layout,
+            stat_guard=core.stat_guard_config(self),
         )
 
     def _build_multi_step(self):
@@ -638,9 +647,12 @@ class ComputationGraph:
         self._pretrain_done = True
 
     def _step_extra_args(self) -> tuple:
+        extra = ()
         if self._loss_scale_active:
-            return (core.ensure_loss_scale_state(self),)
-        return ()
+            extra += (core.ensure_loss_scale_state(self),)
+        if core.stat_guard_active(self):
+            extra += (core.ensure_stat_guard_state(self),)
+        return extra
 
     def fit_minibatch(self, ds) -> float:
         from deeplearning4j_tpu.datasets.api import ChunkedDataSet

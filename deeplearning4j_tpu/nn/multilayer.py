@@ -280,15 +280,24 @@ class MultiLayerNetwork:
             grad_accum=self.grad_accum,
             recurrent_names=self._recurrent_names(),
             zero_layout=self._zero_layout,
+            stat_guard=core.stat_guard_config(self),
         )
 
     def set_divergence_guard(self, guard) -> None:
         """(Un)install a resilience.DivergenceGuard on the SGD train
-        step (in-jit NaN/Inf suppression + host-side skip/rollback).
-        Rebuilds the jitted step: the guarded step returns an extra
-        ok flag."""
+        step (in-jit NaN/Inf suppression + host-side skip/rollback;
+        with ``guard.stats`` also the statistical anomaly guard, whose
+        EWMA state threads through the step). Rebuilds the jitted
+        step: the guarded step returns extra outputs."""
         self.divergence_guard = guard
         self._jit_step = None
+
+    def set_batch_validator(self, validator, quarantine=None
+                            ) -> "MultiLayerNetwork":
+        """(Un)install the data-plane defense (``datasets.validate``)
+        on this model's ``fit`` loops."""
+        core.set_batch_validator(self, validator, quarantine)
+        return self
 
     def enable_step_telemetry(self, enabled: bool = True) -> None:
         """(Un)install step telemetry: the jitted per-step program
@@ -675,10 +684,14 @@ class MultiLayerNetwork:
 
     def _step_extra_args(self) -> tuple:
         """Trailing jitted-step arguments for the active transforms
-        (the dynamic loss-scale state, when engaged)."""
+        (the dynamic loss-scale state, then the statistical guard's
+        EWMA state, when engaged)."""
+        extra = ()
         if self._loss_scale_active:
-            return (core.ensure_loss_scale_state(self),)
-        return ()
+            extra += (core.ensure_loss_scale_state(self),)
+        if core.stat_guard_active(self):
+            extra += (core.ensure_stat_guard_state(self),)
+        return extra
 
     def fit_minibatch(self, ds) -> float:
         """One minibatch through ``conf.iterations`` optimizer steps

@@ -32,10 +32,21 @@ def cpu_device() -> Optional["jax.Device"]:
 
 def use_pallas() -> bool:
     """Env-gated Pallas dispatch (DL4J_TPU_PALLAS=1/0/auto): kernels
-    engage only when the targeted platform is TPU."""
+    engage only when the targeted platform is TPU. A forced ``1``
+    off-TPU still routes through the kernels, but they self-arm
+    interpreter mode (``pallas_interpret``) — same code path,
+    correct-but-slow execution instead of a Mosaic lowering crash."""
     env = os.environ.get("DL4J_TPU_PALLAS", "auto").lower()
     if env in ("1", "true", "on"):
         return True
     if env in ("0", "false", "off"):
         return False
     return effective_platform() == "tpu"
+
+
+def pallas_interpret() -> bool:
+    """Whether a Pallas kernel must run in interpreter mode: anywhere
+    but a real TPU. The kernels OR this into their ``interpret`` flag
+    so ``DL4J_TPU_PALLAS=1`` on a CPU host (the classic local-repro
+    footgun) executes instead of failing to lower TPU memory spaces."""
+    return effective_platform() != "tpu"

@@ -57,7 +57,12 @@ def _peephole_kernel(xproj_ref, h_ref, c_ref, rw_ref, pi_ref, pf_ref,
 def lstm_cell(xproj, h, c, rw, peepholes=None, interpret: bool = False):
     """One fused cell step. xproj [b, 4n] (= x_t @ W + b), h/c [b, n],
     rw [n, 4n], peepholes optional (pI, pF, pO) each [n].
-    Returns (h_new, c_new)."""
+    Returns (h_new, c_new). Off-TPU (``DL4J_TPU_PALLAS=1`` forced on a
+    CPU host) the kernel self-arms interpreter mode instead of failing
+    to lower TPU memory spaces."""
+    from deeplearning4j_tpu.ops.dispatch import pallas_interpret
+
+    interpret = interpret or pallas_interpret()
     b, n = h.shape
     out_shape = (
         jax.ShapeDtypeStruct((b, n), h.dtype),
@@ -394,14 +399,26 @@ def lstm_sequence_ok(n: int, four_n: int, dtype, b: int) -> bool:
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def lstm_sequence(xproj, h0, c0, rw, interpret=False):
-    """Whole-sequence fused LSTM (no peephole, no mask):
-    xproj [T, b, 4n] = x@W+b precomputed, h0/c0 [b, n], rw [n, 4n].
-    Returns (h_seq [T, b, n], hT, cT)."""
+def _lstm_sequence_vjp(xproj, h0, c0, rw, interpret):
     hseq, _cseq, hT, cT = _lstm_sequence_fwd_call(
         xproj, h0, c0, rw, interpret, save_cseq=False
     )
     return hseq, hT, cT
+
+
+def lstm_sequence(xproj, h0, c0, rw, interpret=False):
+    """Whole-sequence fused LSTM (no peephole, no mask):
+    xproj [T, b, 4n] = x@W+b precomputed, h0/c0 [b, n], rw [n, 4n].
+    Returns (h_seq [T, b, n], hT, cT). ``interpret`` is resolved HERE,
+    before the custom-vjp boundary (it is a nondiff argument, so the
+    forward and backward kernels must agree on it): off-TPU the
+    kernels run in interpreter mode even when ``DL4J_TPU_PALLAS=1``
+    forces routing."""
+    from deeplearning4j_tpu.ops.dispatch import pallas_interpret
+
+    return _lstm_sequence_vjp(
+        xproj, h0, c0, rw, bool(interpret or pallas_interpret())
+    )
 
 
 def _lstm_sequence_fwd(xproj, h0, c0, rw, interpret):
@@ -431,4 +448,4 @@ def _lstm_sequence_bwd(interpret, res, grads):
             dc0.astype(c0.dtype), drw)
 
 
-lstm_sequence.defvjp(_lstm_sequence_fwd, _lstm_sequence_bwd)
+_lstm_sequence_vjp.defvjp(_lstm_sequence_fwd, _lstm_sequence_bwd)

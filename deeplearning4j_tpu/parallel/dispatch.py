@@ -108,7 +108,12 @@ class AsyncDispatchWindow:
         self._last_dispatch = now
         guard = self.guard_fn()
         if ok is not None and guard is not None:
-            self._flags.append(ok)
+            # remember WHICH step the flag belongs to: by consult time
+            # the model's counter has moved on by up to lag steps, and
+            # the guard's skipped-batch ledger must name the true one
+            step = (int(self.model.iteration_count) - 1
+                    if self.model is not None else -1)
+            self._flags.append((step, ok))
             lag = self._effective_lag(guard)
             while len(self._flags) > lag:
                 self._consult(self._flags.popleft(), guard)
@@ -119,11 +124,13 @@ class AsyncDispatchWindow:
 
     # -- internals ------------------------------------------------------
 
-    def _consult(self, ok, guard) -> None:
+    def _consult(self, flag, guard) -> None:
+        step, ok = flag
         if bool(ok):  # the (amortized) device sync
             guard.good_step()
         else:
-            guard.bad_step(self.model, on_restore=self.on_restore)
+            guard.bad_step(self.model, on_restore=self.on_restore,
+                           step_index=step)
 
     @staticmethod
     def _retire(score) -> None:
@@ -145,9 +152,9 @@ class AsyncDispatchWindow:
         boundary instead of mid-window)."""
         guard = self.guard_fn()
         while self._flags:
-            ok = self._flags.popleft()
+            flag = self._flags.popleft()
             if guard is not None:
-                self._consult(ok, guard)
+                self._consult(flag, guard)
         while self._inflight:
             self._retire(self._inflight.popleft())
 

@@ -190,6 +190,9 @@ class DistributedTrainer:
         # skips or rolls back to the last checkpoint. Reading the
         # ok-flag synchronizes per step.
         self.divergence_guard = divergence_guard
+        # back-reference for checkpoint capture: guard_state_doc reads
+        # it when the model carries no guard of its own
+        model._ckpt_guard = divergence_guard
         # async dispatch (fit loop only; fit_minibatch called directly
         # keeps the synchronous per-step consult): at most
         # max_in_flight steps dispatched-but-incomplete, guard flags
@@ -210,9 +213,16 @@ class DistributedTrainer:
         self._built_telemetry = self._telemetry_enabled()
         self._built_ls = core.loss_scale_active(model)
         self._built_accum = int(getattr(model, "grad_accum", 1))
+        self._built_sg = self._sg_config() is not None
 
     def _telemetry_enabled(self) -> bool:
         return bool(getattr(self.model, "_telemetry_grad_norm", False))
+
+    def _sg_config(self):
+        """StatGuardConfig of the TRAINER's guard (the trainer and
+        engine guards are separate installs by design)."""
+        guard = self.divergence_guard
+        return getattr(guard, "stats", None) if guard is not None else None
 
     def enable_step_telemetry(self, enabled: bool = True) -> None:
         """(Un)install step telemetry on the distributed steps: like
@@ -255,8 +265,10 @@ class DistributedTrainer:
         if (
             core.loss_scale_active(self.model)
             or int(getattr(self.model, "grad_accum", 1)) > 1
+            or self._sg_config() is not None
         ):
-            # loss-scale state / microbatch scans ride the GSPMD step
+            # loss-scale / stat-guard state and microbatch scans ride
+            # the GSPMD step
             return False
         if self.batch_stats == "local":
             return True
@@ -396,10 +408,12 @@ class DistributedTrainer:
         (``auto`` must see whether THIS batch carries masks)."""
         ls_now = core.loss_scale_active(self.model)
         accum_now = int(getattr(self.model, "grad_accum", 1))
+        sg_now = self._sg_config() is not None
         if (
             self._telemetry_enabled() != self._built_telemetry
             or ls_now != self._built_ls
             or accum_now != self._built_accum
+            or sg_now != self._built_sg
         ):
             # a baked-in knob flipped since the steps were built (e.g.
             # a TelemetryListener attached mid-run, fit(grad_accum=K)
@@ -407,6 +421,7 @@ class DistributedTrainer:
             self._built_telemetry = self._telemetry_enabled()
             self._built_ls = ls_now
             self._built_accum = accum_now
+            self._built_sg = sg_now
             self._jit_step_sm = None
             self._jit_step_gspmd = None
         if self._pick_shard_map(has_masks):
@@ -516,6 +531,8 @@ class DistributedTrainer:
         telemetry = self._telemetry_enabled()
         ls_active = self._built_ls
         grad_accum = self._built_accum
+        sg_cfg = self._sg_config()
+        sg_active = sg_cfg is not None
         m = self.model
         mesh = self.mesh
         rep = NamedSharding(mesh, P())
@@ -586,6 +603,7 @@ class DistributedTrainer:
         def step(params, upd_state, state, x, labels, mask, fmask, lrs,
                  t, rng, *ls_args):
             ls = ls_args[0] if ls_active else None
+            sg = ls_args[1 if ls_active else 0] if sg_active else None
             scale = ls["scale"] if ls_active else None
             if grad_accum > 1:
                 (score, new_state), grads = core.accum_grad_step(
@@ -602,6 +620,7 @@ class DistributedTrainer:
                 updater, grads, score, new_state, params, upd_state,
                 state, lrs, t, guarded=guarded, telemetry=telemetry,
                 ls=ls, flatten=flatten, unflatten=unflatten,
+                sg=sg, sg_cfg=sg_cfg,
             )
 
         out_shardings = (
@@ -611,6 +630,8 @@ class DistributedTrainer:
             out_shardings = out_shardings + (rep,)
         if ls_active:
             out_shardings = out_shardings + (rep,)
+        if sg_active:
+            out_shardings = out_shardings + (rep,)
         if guarded:
             out_shardings = out_shardings + (rep,)
         in_shardings = (
@@ -618,6 +639,8 @@ class DistributedTrainer:
             batch, batch, batch, batch, None, None, None,
         )
         if ls_active:
+            in_shardings = in_shardings + (None,)
+        if sg_active:
             in_shardings = in_shardings + (None,)
         return jax.jit(
             step,
@@ -797,7 +820,8 @@ class DistributedTrainer:
 
     def fit(self, iterator, epochs: int = 1,
             prefetch: Optional[int] = None,
-            grad_accum: Optional[int] = None) -> list:
+            grad_accum: Optional[int] = None,
+            validator=None, quarantine=None) -> list:
         """Fit ``epochs`` passes of ``iterator``, pipelined: batch
         materialization + sharded placement can run on a prefetch
         thread (``prefetch=N`` wraps the iterator in a depth-N
@@ -812,7 +836,16 @@ class DistributedTrainer:
         single device sync per epoch happens at the epoch boundary).
         ``iterator.reset()`` runs in a ``finally`` per epoch, so an
         exception that unwinds mid-epoch leaves the iterator rewound
-        and a retried epoch starts from the top, not mid-stream."""
+        and a retried epoch starts from the top, not mid-stream.
+
+        ``validator`` (a ``datasets.BatchValidator``, or the model's
+        installed ``set_batch_validator`` one by default) screens every
+        batch before it reaches the step; offenders are quarantined to
+        ``quarantine`` (a ``datasets.QuarantineStore``) and skipped
+        without advancing ``iteration_count``, so the defended
+        trajectory over the surviving batches is bitwise the clean
+        run's. With ``prefetch`` the validation runs on the prefetch
+        worker thread."""
         from deeplearning4j_tpu.parallel.dispatch import (
             AsyncDispatchWindow,
         )
@@ -823,6 +856,19 @@ class DistributedTrainer:
             # in-jit microbatch accumulation (core.accum_grad_step);
             # _step_for notices the knob change and rebuilds the step
             core.set_grad_accum(m, grad_accum)
+        if validator is None:
+            validator = getattr(m, "_batch_validator", None)
+        if validator is not None:
+            from deeplearning4j_tpu.datasets.validate import (
+                ValidatingIterator,
+            )
+
+            if quarantine is None:
+                quarantine = getattr(m, "_quarantine_store", None)
+            if not isinstance(iterator, ValidatingIterator):
+                iterator = ValidatingIterator(
+                    iterator, validator, quarantine=quarantine,
+                )
         source = iterator
         owned_prefetch = None
         if prefetch is not None and int(prefetch) > 0:
@@ -894,6 +940,8 @@ class DistributedTrainer:
             (core.ensure_loss_scale_state(m),) if self._built_ls
             else ()
         )
+        if self._built_sg:
+            extra = extra + (core.ensure_stat_guard_state(m),)
         out = step(
             m.params, m.updater_state, m.state, x, y, mask, fmask,
             {k: jnp.asarray(v, jnp.float32) for k, v in lrs.items()},
@@ -908,6 +956,9 @@ class DistributedTrainer:
             i += 1
         if self._built_ls:
             m._loss_scale_state = out[i]
+            i += 1
+        if self._built_sg:
+            m._stat_guard_state = out[i]
             i += 1
         ok = out[i] if guard is not None else None
         m._last_batch_rows = placed.num_rows  # examples/sec signal
@@ -935,6 +986,7 @@ class DistributedTrainer:
         are rebuilt on next use because the guarded step has an extra
         ok-flag output."""
         self.divergence_guard = guard
+        self.model._ckpt_guard = guard
         self._jit_step_sm = None
         self._jit_step_gspmd = None
 

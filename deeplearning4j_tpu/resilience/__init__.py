@@ -26,10 +26,16 @@ Four cooperating pieces:
 - **retrying storage** (``store.py``): ``RetryingObjectStore`` over
   any ObjectStore backend, optionally breaker-guarded;
 - **deterministic fault injection** (``chaos.py``): ``ChaosPolicy``
-  seeded failure schedules, ``FaultyObjectStore``, ``FlakyIterator``;
+  seeded failure schedules, ``FaultyObjectStore``, ``FlakyIterator``,
+  and ``PoisonIterator`` (seeded bad-data corruption for the
+  validating-pipeline storms);
 - **divergence guard** (``guard.py``): in-step NaN/Inf detection on
   loss + gradient global-norm with skip-step or
-  rollback-to-last-checkpoint policies;
+  rollback-to-last-checkpoint policies, plus the statistical anomaly
+  guard (``StatGuardConfig``): device-resident EWMA mean/variance of
+  loss and grad-norm, z-score/spike trips reusing the same in-jit
+  skip machinery, state checkpointed bitwise in the manifest
+  (``guard_state_doc``/``apply_guard_state_doc``);
 - **preemption handling** (``preemption.py``): ``PreemptionHandler``
   — SIGTERM/SIGINT (or a simulated notice) -> atomic flag -> drain +
   emergency checkpoint + ``PreemptedException`` at the next step
@@ -45,6 +51,7 @@ from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
     ChaosPolicy,
     FaultyObjectStore,
     FlakyIterator,
+    PoisonIterator,
 )
 from deeplearning4j_tpu.resilience.deadline import (  # noqa: F401
     Deadline,
@@ -58,6 +65,9 @@ from deeplearning4j_tpu.resilience.checkpoint import (  # noqa: F401
 )
 from deeplearning4j_tpu.resilience.guard import (  # noqa: F401
     DivergenceGuard,
+    StatGuardConfig,
+    apply_guard_state_doc,
+    guard_state_doc,
 )
 from deeplearning4j_tpu.resilience.preemption import (  # noqa: F401
     EXIT_PREEMPTED,

@@ -135,6 +135,113 @@ class FaultyObjectStore(ObjectStore):
         self.inner.upload(from_path, key)
 
 
+POISON_KINDS = ("wrong_shape", "wrong_dtype", "label_range",
+                "huge_values")
+
+
+class PoisonIterator(DataSetIterator):
+    """DataSetIterator decorator that CORRUPTS scheduled batches
+    instead of failing them — the bad-data analog of
+    :class:`FlakyIterator`, feeding the validating pipeline's
+    quarantine path (``datasets/validate.py``).
+
+    Two scheduling modes, composable (mirroring ``ChaosPolicy``):
+
+    - **explicit**: ``poison={3: "wrong_dtype", 7: "label_range"}``
+      corrupts exactly those 0-based batch offsets with the named
+      corruption kind;
+    - **random**: ``poison_rate=0.2, seed=1337`` corrupts each batch
+      with probability 0.2, kind drawn from ``POISON_KINDS`` — same
+      seed, same storm.
+
+    Corruption kinds (each trips a distinct validator reason code):
+
+    - ``wrong_shape``   — features lose their last column;
+    - ``wrong_dtype``   — features become strings (the corrupt-CSV
+      symptom: a header row or sentinel text lands in the payload);
+    - ``label_range``   — one label row becomes 7.0 (outside any
+      normalized/one-hot range);
+    - ``huge_values``   — one feature element becomes 1e12
+      (finite but absurd: the magnitude check's prey).
+
+    The inner batch is COPIED before corruption, so a quarantined
+    offset replayed from the store differs from the pristine source
+    batch — never the other way around. ``poisoned`` records
+    ``(offset, kind)`` of every corruption for exact-count asserts.
+    """
+
+    def __init__(self, inner: DataSetIterator, seed: int = 0,
+                 poison_rate: float = 0.0,
+                 poison: Optional[Dict[int, str]] = None):
+        if not 0.0 <= poison_rate <= 1.0:
+            raise ValueError("poison_rate must be in [0, 1]")
+        for kind in (poison or {}).values():
+            if kind not in POISON_KINDS:
+                raise ValueError(
+                    f"unknown poison kind {kind!r}; pick from "
+                    f"{POISON_KINDS}"
+                )
+        self.inner = inner
+        self.poison = dict(poison or {})
+        self.poison_rate = poison_rate
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._offset = 0
+        self.poisoned: List[tuple] = []       # (offset, kind)
+
+    def _corrupt(self, ds: DataSet, kind: str) -> DataSet:
+        import copy
+
+        import numpy as np
+
+        ds = copy.deepcopy(ds)
+        feats = ds.features
+        labels = ds.labels
+        if kind == "wrong_shape":
+            ds.features = np.asarray(feats)[..., :-1]
+        elif kind == "wrong_dtype":
+            ds.features = np.asarray(feats).astype("U8")
+        elif kind == "label_range":
+            labels = np.array(labels, copy=True)
+            labels[0, ...] = 7.0
+            ds.labels = labels
+        elif kind == "huge_values":
+            feats = np.array(feats, copy=True)
+            flat = feats.reshape(-1)
+            flat[0] = 1e12
+            ds.features = flat.reshape(feats.shape)
+        return ds
+
+    def next(self) -> DataSet:
+        ds = self.inner.next()
+        at = self._offset
+        self._offset += 1
+        kind = self.poison.get(at)
+        if kind is None and self.poison_rate > 0.0:
+            if self._rng.random() < self.poison_rate:
+                kind = POISON_KINDS[
+                    self._rng.randrange(len(POISON_KINDS))
+                ]
+        if kind is None:
+            return ds
+        self.poisoned.append((at, kind))
+        return self._corrupt(ds, kind)
+
+    def has_next(self) -> bool:
+        return self.inner.has_next()
+
+    def reset(self) -> None:
+        self.inner.reset()
+        self._offset = 0
+        self._rng = random.Random(self.seed)  # same seed, same storm
+
+    def batch(self) -> int:
+        return self.inner.batch()
+
+    def total_examples(self) -> int:
+        return self.inner.total_examples()
+
+
 class FlakyIterator(DataSetIterator):
     """DataSetIterator decorator whose ``next()`` consults a
     ChaosPolicy before delegating — the deterministic stand-in for a

@@ -115,6 +115,13 @@ class CheckpointInfo:
     # field lets operators see which runs were sharded. Manifests
     # without it parse as zero=None (old checkpoints keep restoring).
     zero: Optional[dict] = None
+    # Anomaly-defense trajectory state (resilience.guard_state_doc):
+    # statistical-guard EWMA scalars as bitwise-exact floats, the
+    # guard's skipped-batch ledger, and the data-plane quarantine
+    # ledger. Restoring it makes a killed+resumed defended run replay
+    # the identical skip decisions. Manifests without it parse as
+    # guard=None (old checkpoints keep restoring).
+    guard: Optional[dict] = None
 
     def to_manifest(self) -> dict:
         doc = {
@@ -126,6 +133,8 @@ class CheckpointInfo:
             doc["artifacts"] = self.artifacts
         if self.zero:
             doc["zero"] = self.zero
+        if self.guard:
+            doc["guard"] = self.guard
         return doc
 
     @classmethod
@@ -137,6 +146,7 @@ class CheckpointInfo:
             format=int(doc.get("format", MANIFEST_FORMAT)),
             artifacts=dict(doc.get("artifacts") or {}),
             zero=dict(doc["zero"]) if doc.get("zero") else None,
+            guard=dict(doc["guard"]) if doc.get("guard") else None,
         )
 
 
@@ -197,6 +207,7 @@ class CheckpointManager:
         ``artifacts`` map — verified on read, but never gating the
         model restore."""
         from deeplearning4j_tpu.observability.trace import get_tracer
+        from deeplearning4j_tpu.resilience.guard import guard_state_doc
         from deeplearning4j_tpu.util.model_serializer import write_model
 
         step = int(model.iteration_count)
@@ -221,6 +232,7 @@ class CheckpointManager:
                 size=size, artifacts=artifact_map,
                 zero=dict(getattr(model, "_zero_layout", None) or {})
                 or None,
+                guard=guard_state_doc(model),
             )
             # manifest lands after the zip: a crash between the two
             # leaves an orphan zip that available() ignores, never a
@@ -419,6 +431,7 @@ def restore_into(model, source, load_updater: bool = True):
     """
     from deeplearning4j_tpu.util.model_serializer import restore_model
 
+    info = None
     if isinstance(source, CheckpointManager):
         restored, info = source.restore_latest(load_updater=load_updater)
     elif (isinstance(source, tuple) and len(source) == 2
@@ -447,6 +460,15 @@ def restore_into(model, source, load_updater: bool = True):
             model._zero_layout = None
     model.iteration_count = restored.iteration_count
     model.epoch_count = restored.epoch_count
+    if info is not None and info.guard:
+        # bitwise-reproducible skips: the EWMA scalars and skip/
+        # quarantine ledgers come back exactly as saved, so a resumed
+        # defended run replays the identical trip decisions
+        from deeplearning4j_tpu.resilience.guard import (
+            apply_guard_state_doc,
+        )
+
+        apply_guard_state_doc(model, info.guard)
     return model, restored.iteration_count
 
 

@@ -1,4 +1,5 @@
-"""Divergence guard: NaN/Inf detection BEFORE the optimizer update.
+"""Divergence guard: NaN/Inf *and statistical* anomaly detection
+BEFORE the optimizer update.
 
 The reference's only defense against numerical divergence was
 ``InvalidScoreIterationTerminationCondition`` — it notices a NaN score
@@ -9,10 +10,28 @@ step is bad the parameter/updater/state updates are *not applied*
 (``jnp.where`` select on the step output — free when the flag is
 true, no host round-trip on the good path beyond the flag itself).
 
+The NaN/Inf check alone misses the bad-data failure mode the
+divergence literature treats as table stakes for long unattended
+runs: a *finite* loss spike or grad-norm explosion from one poisoned
+batch sails straight into the updater. ``StatGuardConfig`` adds the
+statistical half: an EWMA mean/variance of the loss and gradient
+global-norm rides through the step like the loss-scale state
+(device-resident, donated, no host sync), and a step whose loss or
+grad-norm lands ``z_threshold`` standard deviations out — or
+``spike_factor``x the running mean — is suppressed by the SAME
+in-jit select. Tripped/non-finite samples are NOT folded into the
+EWMA (a spike must not teach the guard that spikes are normal), and
+the first ``warmup`` clean steps only accumulate. The state is tiny
+(7 scalars) and serializes exactly through the checkpoint manifest
+(``stat_guard_state_doc``/``stat_guard_state_from_doc``: float(f32)
+-> JSON f64 -> f32 round-trips bitwise), so kill/resume replays the
+identical trip decisions.
+
 Host-side policy then decides what a bad step means:
 
 - ``"skip"``: drop the minibatch's update and keep going (counters on
-  the guard record how many were skipped);
+  the guard record how many were skipped, ``skipped_batches`` which
+  iteration indices);
 - ``"rollback"``: additionally restore the last verified checkpoint —
   for slow-onset divergence where bad state predates the first
   non-finite loss.
@@ -20,15 +39,21 @@ Host-side policy then decides what a bad step means:
 ``max_consecutive`` bounds either policy: a model that produces
 nothing but NaNs raises ``DL4JFaultException`` instead of spinning.
 
-The in-jit half (``divergence_ok``/``select_updates``) is imported by
-the step builders in ``parallel/trainer.py`` and ``nn/multilayer.py``;
-the host half is this ``DivergenceGuard`` object, shared across both
-engines.
+The in-jit half (``divergence_ok``/``select_updates``/
+``stat_guard_update``) is imported by the step builders in
+``parallel/trainer.py`` and ``nn/core.py``; the host half is this
+``DivergenceGuard`` object, shared across both engines.
+
+Metrics (catalogued in ARCHITECTURE.md):
+``guard_spike_trips_total{signal}`` plus ``guard_loss_ewma`` /
+``guard_gradnorm_ewma`` gauges, published at each consult of a
+tripped step and each checkpoint capture.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from dataclasses import dataclass
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +62,35 @@ from deeplearning4j_tpu.exceptions import DL4JFaultException
 
 SKIP = "skip"
 ROLLBACK = "rollback"
+
+_GUARD_METRICS = None
+
+
+def _guard_metrics():
+    global _GUARD_METRICS
+    if _GUARD_METRICS is None:
+        from deeplearning4j_tpu.observability.metrics import (
+            default_registry,
+        )
+
+        reg = default_registry()
+        _GUARD_METRICS = (
+            reg.counter(
+                "guard_spike_trips_total", labels=("signal",),
+                help="statistical-guard trips by signal "
+                     "(loss | gradnorm)",
+            ),
+            reg.gauge(
+                "guard_loss_ewma",
+                help="statistical guard: EWMA of the training loss",
+            )._default(),
+            reg.gauge(
+                "guard_gradnorm_ewma",
+                help="statistical guard: EWMA of the gradient "
+                     "global norm",
+            )._default(),
+        )
+    return _GUARD_METRICS
 
 
 def grad_global_norm_sq(grads) -> jax.Array:
@@ -84,17 +138,137 @@ def select_updates(ok, new_params, params, new_upd, upd_state,
     return sel_params, sel_upd, sel_state
 
 
+# ---------------------------------------------------------------------------
+# statistical anomaly guard (in-jit half)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class StatGuardConfig:
+    """Knobs of the statistical anomaly guard (hashable: the step
+    builders close over it, so it is part of the compiled program).
+
+    ``alpha`` is the EWMA smoothing factor, ``z_threshold`` the
+    z-score past which a signal trips, ``spike_factor`` the
+    multiple-of-the-mean ceiling (catches spikes before the variance
+    estimate has warmed up to them), ``warmup`` the number of clean
+    samples accumulated before either trip condition arms."""
+
+    alpha: float = 0.02
+    z_threshold: float = 6.0
+    spike_factor: float = 10.0
+    warmup: int = 20
+
+
+# stable key order — the manifest doc and the pytree both use it
+STAT_STATE_KEYS = ("loss_mean", "loss_var", "gnorm_mean", "gnorm_var",
+                   "count", "trips_loss", "trips_gnorm")
+
+
+def stat_guard_state() -> dict:
+    """Fresh device-resident EWMA state, threaded through the jitted
+    step exactly like the loss-scale state dict."""
+    z = jnp.zeros((), jnp.float32)
+    zi = jnp.zeros((), jnp.int32)
+    return {
+        "loss_mean": z, "loss_var": z,
+        "gnorm_mean": z, "gnorm_var": z,
+        "count": zi, "trips_loss": zi, "trips_gnorm": zi,
+    }
+
+
+def _signal_trip(x, mean, var, count, cfg: StatGuardConfig):
+    """Scalar bool: is this (finite) sample anomalous vs its EWMA?"""
+    warmed = count >= cfg.warmup
+    std = jnp.sqrt(jnp.maximum(var, 1e-12))
+    z = jnp.abs(x - mean) / std
+    spike = x > cfg.spike_factor * jnp.maximum(mean, 1e-12)
+    return warmed & ((z > cfg.z_threshold) | spike)
+
+
+def _ewma_fold(mean, var, x, alpha, take):
+    delta = x - mean
+    new_mean = mean + alpha * delta
+    new_var = (1.0 - alpha) * (var + alpha * delta * delta)
+    return (jnp.where(take, new_mean, mean),
+            jnp.where(take, new_var, var))
+
+
+def stat_guard_update(sg: dict, cfg: StatGuardConfig, score, gnorm,
+                      finite_ok):
+    """One in-jit statistical-guard step: trip decision + EWMA fold.
+
+    Returns ``(ok, new_state)``. ``ok`` is False when either signal
+    trips (the caller ANDs it into the select). Non-finite or tripped
+    samples are excluded from the fold — the running statistics track
+    the CLEAN trajectory only, so one spike cannot drag the mean up
+    and let the next one through."""
+    x_loss = score.astype(jnp.float32)
+    x_gn = gnorm.astype(jnp.float32)
+    count = sg["count"]
+    trip_loss = finite_ok & _signal_trip(
+        x_loss, sg["loss_mean"], sg["loss_var"], count, cfg
+    )
+    trip_gn = finite_ok & _signal_trip(
+        x_gn, sg["gnorm_mean"], sg["gnorm_var"], count, cfg
+    )
+    ok = jnp.logical_not(trip_loss | trip_gn)
+    take = finite_ok & ok
+    alpha = jnp.float32(cfg.alpha)
+    loss_mean, loss_var = _ewma_fold(
+        sg["loss_mean"], sg["loss_var"], x_loss, alpha, take
+    )
+    gn_mean, gn_var = _ewma_fold(
+        sg["gnorm_mean"], sg["gnorm_var"], x_gn, alpha, take
+    )
+    new_sg = {
+        "loss_mean": loss_mean, "loss_var": loss_var,
+        "gnorm_mean": gn_mean, "gnorm_var": gn_var,
+        "count": count + take.astype(jnp.int32),
+        "trips_loss": sg["trips_loss"] + trip_loss.astype(jnp.int32),
+        "trips_gnorm": sg["trips_gnorm"] + trip_gn.astype(jnp.int32),
+    }
+    return ok, new_sg
+
+
+def stat_guard_state_doc(state: Optional[dict]) -> Optional[dict]:
+    """Manifest form of the EWMA state. ``float(np.float32)`` is
+    exactly representable in JSON's f64 and the round trip back
+    through ``jnp.float32`` is bitwise — the property the
+    kill/resume-bitwise chaos tests lean on."""
+    if state is None:
+        return None
+    out = {}
+    for k in STAT_STATE_KEYS:
+        v = state[k]
+        out[k] = int(v) if k in ("count", "trips_loss",
+                                 "trips_gnorm") else float(v)
+    return out
+
+
+def stat_guard_state_from_doc(doc: dict) -> dict:
+    state = {}
+    for k in STAT_STATE_KEYS:
+        v = doc.get(k, 0)
+        state[k] = (jnp.asarray(int(v), jnp.int32)
+                    if k in ("count", "trips_loss", "trips_gnorm")
+                    else jnp.asarray(float(v), jnp.float32))
+    return state
+
+
 class DivergenceGuard:
     """Host-side divergence policy. Construct once, hand to
     ``MultiLayerNetwork.set_divergence_guard`` or
-    ``DistributedTrainer(divergence_guard=...)``.
+    ``DistributedTrainer(divergence_guard=...)``. With ``stats`` (a
+    :class:`StatGuardConfig`, or ``True`` for the defaults) the step
+    additionally threads the statistical anomaly guard.
 
     Note: consulting the guard reads the step's ok-flag back from the
     device, which synchronizes every step — the cost of supervision.
     """
 
     def __init__(self, policy: str = SKIP, checkpoint_manager=None,
-                 max_consecutive: int = 10):
+                 max_consecutive: int = 10, stats=None):
         if policy not in (SKIP, ROLLBACK):
             raise ValueError(
                 f"policy must be '{SKIP}' or '{ROLLBACK}', got {policy!r}"
@@ -106,17 +280,60 @@ class DivergenceGuard:
         self.policy = policy
         self.checkpoint_manager = checkpoint_manager
         self.max_consecutive = max_consecutive
+        if stats is True:
+            stats = StatGuardConfig()
+        if stats is not None and not isinstance(stats, StatGuardConfig):
+            raise ValueError(
+                "stats must be a StatGuardConfig, True, or None; "
+                f"got {stats!r}"
+            )
+        self.stats = stats
         self.skipped_steps = 0
         self.rollbacks = 0
         self.consecutive_bad = 0
+        # iteration indices whose update was suppressed (part of the
+        # checkpoint ledger: a resumed run re-reports them honestly)
+        self.skipped_batches: List[int] = []
+        # last device trip counters seen, to diff into the labeled
+        # metric without double counting
+        self._seen_trips = {"loss": 0, "gradnorm": 0}
 
     def good_step(self) -> None:
         self.consecutive_bad = 0
 
-    def bad_step(self, model, on_restore=None) -> None:
-        """One non-finite step was detected (its update was already
+    def publish_stats(self, model) -> None:
+        """Mirror the device-resident EWMA state into the gauges and
+        the labeled trip counter (diffed — idempotent per state).
+        Called on each tripped consult and at checkpoint capture; a
+        model without stat-guard state is a no-op."""
+        state = getattr(model, "_stat_guard_state", None)
+        if state is None:
+            return
+        trips, g_loss, g_gn = _guard_metrics()
+        g_loss.set(float(state["loss_mean"]))
+        g_gn.set(float(state["gnorm_mean"]))
+        for signal, key in (("loss", "trips_loss"),
+                            ("gradnorm", "trips_gnorm")):
+            now = int(state[key])
+            delta = now - self._seen_trips[signal]
+            if delta > 0:
+                trips.labels(signal).inc(delta)
+            self._seen_trips[signal] = now
+
+    def bad_step(self, model, on_restore=None,
+                 step_index=None) -> None:
+        """One bad step was detected — non-finite, or statistically
+        anomalous when ``stats`` is armed (its update was already
         suppressed in-jit). Applies the policy; ``on_restore`` runs
-        after a rollback (the trainer re-places params on its mesh)."""
+        after a rollback (the trainer re-places params on its mesh).
+        ``step_index`` names the offending step for the ledger — the
+        async dispatch window passes it because it consults flags up
+        to ``guard_lag`` steps after the counter moved on."""
+        if step_index is None:
+            step_index = int(getattr(model, "iteration_count", 0)) - 1
+        self.skipped_batches.append(int(step_index))
+        if self.stats is not None:
+            self.publish_stats(model)
         self.consecutive_bad += 1
         if self.consecutive_bad > self.max_consecutive:
             raise DL4JFaultException(
@@ -132,3 +349,62 @@ class DivergenceGuard:
         self.rollbacks += 1
         if on_restore is not None:
             on_restore()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-manifest capture/apply (the bitwise kill/resume contract)
+# ---------------------------------------------------------------------------
+
+
+def guard_state_doc(model) -> Optional[dict]:
+    """The manifest ``guard`` field for one model: statistical-guard
+    EWMA state (bitwise-exact floats), the guard's skipped-batch
+    ledger, and the data-plane quarantine ledger a ``ContinualTrainer``
+    attached (``model._data_ledger``). ``None`` when nothing is armed
+    — old manifests stay byte-identical."""
+    # DistributedTrainer keeps its guard off-model; it leaves a
+    # _ckpt_guard back-reference so manager.save(model) still captures
+    # the ledger
+    guard = (getattr(model, "divergence_guard", None)
+             or getattr(model, "_ckpt_guard", None))
+    sg = getattr(model, "_stat_guard_state", None)
+    data = getattr(model, "_data_ledger", None)
+    doc: dict = {}
+    if sg is not None:
+        doc["ewma"] = stat_guard_state_doc(sg)
+        if guard is not None:
+            guard.publish_stats(model)
+    if guard is not None:
+        doc["skipped"] = [int(i) for i in guard.skipped_batches]
+        if guard.skipped_steps:
+            doc["skipped_steps"] = int(guard.skipped_steps)
+        if guard.rollbacks:
+            doc["rollbacks"] = int(guard.rollbacks)
+    if data:
+        doc["data"] = dict(data)
+    return doc or None
+
+
+def apply_guard_state_doc(model, doc: Optional[dict]) -> None:
+    """Inverse of ``guard_state_doc``: restore the EWMA state and the
+    ledgers onto ``model`` (and its installed guard) so a resumed run
+    replays the identical trip decisions."""
+    if not doc:
+        return
+    ewma = doc.get("ewma")
+    if ewma is not None:
+        model._stat_guard_state = stat_guard_state_from_doc(ewma)
+    guard = (getattr(model, "divergence_guard", None)
+             or getattr(model, "_ckpt_guard", None))
+    if guard is not None:
+        guard.skipped_batches = [int(i) for i in doc.get("skipped", [])]
+        guard.skipped_steps = int(doc.get("skipped_steps", 0))
+        guard.rollbacks = int(doc.get("rollbacks", 0))
+        if ewma is not None:
+            # the metric diff base restarts at the restored counters
+            guard._seen_trips = {
+                "loss": int(ewma.get("trips_loss", 0)),
+                "gradnorm": int(ewma.get("trips_gnorm", 0)),
+            }
+    if doc.get("data"):
+        model._data_ledger = dict(doc["data"])

@@ -35,9 +35,12 @@ The acceptance gates this makes falsifiable on CPU:
 
 Optional A/B riders on the same seeded batches: ``--remat`` (policy
 off vs on), ``--zero`` (replicated vs ZeRO-sharded optimizer state —
-steps/sec, per-device updater bytes, bitwise trajectory), and
+steps/sec, per-device updater bytes, bitwise trajectory),
 ``--grad-accum K`` (accum=1 vs K in-jit microbatches — steps/sec +
-trajectory vs the single-big-batch run).
+trajectory vs the single-big-batch run), and ``--defense`` (data-
+plane defense off vs fully on — clean-path overhead gated <= 5 %,
+zero quarantines on a clean stream, and the no-trip bitwise
+contracts; a gate failure exits nonzero).
 
 Windows are interleaved best-of-N like ``scripts/bench_serving.py``
 (host noise only ever slows a run). Runnable standalone
@@ -320,9 +323,128 @@ def _grad_accum_ab(batches, k, windows, seed) -> dict:
     return out
 
 
+def _defense_ab(windows, seed) -> dict:
+    """Data-plane defense A/B on seeded CLEAN batches: steps/sec with
+    the defense off vs fully on (``BatchValidator`` screening every
+    batch + the statistical anomaly guard's in-jit EWMA), gating the
+    clean-path overhead at <= 5 % and the no-trip exactness contracts.
+
+    The A/B runs its own workload (64 -> 1024 -> 8 at batch 1024, not
+    the harness's toy step): the defense cost is a fixed per-step host
+    charge (the guard's ok-flag consult + the validator's numpy pass,
+    ~0.3 ms total), so the gate is only meaningful against a step big
+    enough to represent real training — against a ~1.7 ms toy step the
+    same fixed charge reads as 15 %+.
+
+    Exactness contracts:
+
+    - ``quarantined_on_clean`` must be 0 (the validator never
+      rejects a clean batch);
+    - ``validator_bitwise``: validator on vs off is BITWISE identical
+      (host-side filtering, same compiled step);
+    - ``statguard_bitwise``: stats armed vs the plain NaN guard is
+      BITWISE identical (the EWMA fold rides alongside the update
+      math without perturbing it). Together these bound the full
+      off-vs-on delta to XLA program identity — any guard changes the
+      compiled program, a pre-existing last-ulp boundary pinned by
+      the PR-11 guard tests.
+    """
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.datasets.api import (
+        DataSet, ListDataSetIterator,
+    )
+    from deeplearning4j_tpu.datasets.validate import (
+        BatchSchema, BatchValidator, QuarantineStore,
+        ValidatingIterator,
+    )
+    from deeplearning4j_tpu.parallel import (
+        DistributedTrainer, build_mesh,
+    )
+    from deeplearning4j_tpu.resilience import (
+        DivergenceGuard, StatGuardConfig,
+    )
+
+    rng = np.random.RandomState(seed)
+    batches = [
+        DataSet(
+            features=rng.randn(1024, 64).astype(np.float32),
+            labels=np.eye(8, dtype=np.float32)[
+                rng.randint(0, 8, 1024)
+            ],
+        )
+        for _ in range(12)
+    ]
+    schema = BatchSchema(feature_dim=64, label_dim=8,
+                         label_range=(0.0, 1.0), max_abs=1e6)
+
+    def mk(guard):
+        net = _make_net(seed=seed, hidden=1024, updater="ADAM")
+        return DistributedTrainer(net, mesh=build_mesh(),
+                                  divergence_guard=guard)
+
+    def fit_all(tr, validator=None, store=None):
+        tr.fit(ListDataSetIterator(batches), epochs=1,
+               validator=validator, quarantine=store)
+        jax.block_until_ready(tr.model.params)
+
+    qdir = tempfile.mkdtemp(prefix="bench-defense-q-")
+    arms = {
+        "off": (mk(None), None, None),
+        "on": (mk(DivergenceGuard(stats=StatGuardConfig())),
+               BatchValidator(schema), QuarantineStore(qdir)),
+    }
+    for tr, _, _ in arms.values():  # compile + settle
+        tr.fit_minibatch(batches[0])
+        jax.block_until_ready(tr.model.params)
+    best = {key: float("inf") for key in arms}
+    for _ in range(windows):
+        for key, (tr, v, s) in arms.items():
+            t0 = time.perf_counter()
+            fit_all(tr, v, s)
+            best[key] = min(best[key], time.perf_counter() - t0)
+    out = {
+        "steps_per_s_off": round(len(batches) / best["off"], 2),
+        "steps_per_s_on": round(len(batches) / best["on"], 2),
+    }
+    out["overhead_fraction"] = round(
+        max(0.0, best["on"] / best["off"] - 1.0), 4
+    )
+    out["overhead_ok"] = out["overhead_fraction"] <= 0.05
+
+    # -- exactness lemmas (fresh models, outside the timed windows) -----
+    vit = ValidatingIterator(ListDataSetIterator(batches),
+                             BatchValidator(schema))
+    plain, defended = mk(None), mk(None)
+    fit_all(plain)
+    defended.fit(vit, epochs=1, validator=vit.validator)
+    jax.block_until_ready(defended.model.params)
+    out["quarantined_on_clean"] = len(vit.skipped_offsets)
+    out["validator_bitwise"] = bool(np.array_equal(
+        _params_flat(plain.model), _params_flat(defended.model)
+    ))
+    nan_guard = mk(DivergenceGuard())
+    stat_guard = mk(DivergenceGuard(stats=StatGuardConfig()))
+    fit_all(nan_guard)
+    fit_all(stat_guard)
+    out["statguard_bitwise"] = bool(np.array_equal(
+        _params_flat(nan_guard.model), _params_flat(stat_guard.model)
+    ))
+    out["defense_ok"] = bool(
+        out["overhead_ok"]
+        and out["quarantined_on_clean"] == 0
+        and out["validator_bitwise"]
+        and out["statguard_bitwise"]
+    )
+    return out
+
+
 def run(steps=40, batch=256, io_ms=4.0, cost_loops=0,
         queue_depth=3, max_in_flight=3, windows=3,
-        seed=0, remat="none", zero=False, grad_accum=0) -> dict:
+        seed=0, remat="none", zero=False, grad_accum=0,
+        defense=False) -> dict:
     import jax
 
     from deeplearning4j_tpu.datasets.api import DataSet
@@ -449,6 +571,8 @@ def run(steps=40, batch=256, io_ms=4.0, cost_loops=0,
         out["grad_accum"] = _grad_accum_ab(
             batches, grad_accum, windows, seed
         )
+    if defense:
+        out["defense"] = _defense_ab(windows, seed)
     return out
 
 
@@ -479,14 +603,23 @@ def main():
                     help="also A/B in-jit gradient accumulation "
                          "accum=1 vs accum=K (steps/sec + trajectory "
                          "vs the single-big-batch run)")
+    ap.add_argument("--defense", action="store_true",
+                    help="also A/B the data-plane defense off vs on "
+                         "(validator + statistical guard): gates "
+                         "clean-path overhead <= 5%% and the no-trip "
+                         "bitwise contracts — exits nonzero on a "
+                         "gate failure")
     args = ap.parse_args()
-    print(json.dumps(run(
+    doc = run(
         steps=args.steps, batch=args.batch, io_ms=args.io_ms,
         cost_loops=args.cost_loops, queue_depth=args.queue_depth,
         max_in_flight=args.max_in_flight, windows=args.windows,
         seed=args.seed, remat=args.remat, zero=args.zero,
-        grad_accum=args.grad_accum,
-    )))
+        grad_accum=args.grad_accum, defense=args.defense,
+    )
+    print(json.dumps(doc))
+    if args.defense and not doc["defense"]["defense_ok"]:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

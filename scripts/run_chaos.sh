@@ -82,6 +82,19 @@ python scripts/lint_parity.py || exit 1
 #                                  re-shard the moments, bitwise vs a
 #                                  piecewise reference); injected
 #                                  straggler -> straggler_detected_total
+#   tests/test_data_defense.py   — bad-data storms: seeded
+#                                  PoisonIterator feeds K corrupt of N
+#                                  batches -> exactly K quarantines by
+#                                  reason and final params bitwise the
+#                                  clean run over the N-K survivors
+#                                  (both engines + distributed trainer
+#                                  with prefetch); statistical-guard
+#                                  spike trips with checkpointed EWMA
+#                                  + skipped-batch ledger (bitwise
+#                                  resume); continual trainer dies
+#                                  between publishes mid-quarantine
+#                                  and resumes bitwise off the
+#                                  manifest's data ledger
 STORMS=(
     tests/test_resilience.py
     tests/test_serving.py
@@ -92,6 +105,7 @@ STORMS=(
     tests/test_loop.py
     tests/test_preemption.py
     tests/test_elastic.py
+    tests/test_data_defense.py
 )
 
 declare -a names rcs

@@ -1,0 +1,694 @@
+"""Bad-data defense tests: the validating/quarantining input pipeline
+(``datasets/validate.py``) and the statistical anomaly guard
+(``resilience/guard.py`` stats half).
+
+The contracts under test:
+
+- the validator maps each corruption class to its stable reason code;
+- the quarantine store is atomic, CRC-verified, bounded (oldest-first
+  eviction keeps the ledger line), and replayable;
+- a defended fit over a poisoned stream quarantines EXACTLY the
+  corrupted offsets and lands on params BITWISE equal to the clean
+  run over the surviving batches — on both engines and through the
+  distributed trainer;
+- the statistical guard trips on a finite-but-anomalous batch, its
+  in-jit select suppresses the update bitwise, and its EWMA state +
+  skipped-batch ledger round-trip through the checkpoint manifest so
+  a killed run resumes with identical trip decisions;
+- ``ContinualTrainer`` threads the quarantine ledger through its
+  published manifests for bitwise kill/resume mid-poison.
+
+Storm-style tests are marked ``chaos`` (registered in
+``scripts/run_chaos.sh``) but stay fast and CPU-only for tier-1.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import conftest
+
+from test_resilience import (
+    assert_updater_state_match,
+    batches as mk_batches,
+    simple_net,
+)
+
+from deeplearning4j_tpu.datasets import (
+    BatchSchema,
+    BatchValidator,
+    QuarantineStore,
+    ValidatingIterator,
+)
+from deeplearning4j_tpu.datasets.api import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.exceptions import DL4JFaultException
+from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import DistributedTrainer
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.resilience import (
+    CheckpointManager,
+    DivergenceGuard,
+    PoisonIterator,
+    StatGuardConfig,
+)
+from deeplearning4j_tpu.resilience.checkpoint import restore_into
+from deeplearning4j_tpu.resilience.guard import (
+    stat_guard_state_doc,
+    stat_guard_state_from_doc,
+)
+
+CHAOS_SEED = int(os.environ.get("DL4J_TPU_CHAOS_SEED", "1337"))
+
+SCHEMA = BatchSchema(feature_dim=4, label_dim=3, label_range=(0.0, 1.0),
+                     max_abs=1e6)
+
+
+def graph_net(seed=7, lr=0.05):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(lr)
+        .updater("ADAM")
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("d", DenseLayer(n_in=4, n_out=8,
+                                   activation="tanh"), "in")
+        .add_layer("out", OutputLayer(n_in=8, n_out=3), "d")
+        .set_outputs("out")
+        .build()
+    )
+    return ComputationGraph(conf).init()
+
+
+def clean_batch(rng=None, batch=8):
+    rng = rng or np.random.RandomState(0)
+    x = rng.randn(batch, 4).astype(np.float32)
+    y = np.eye(3)[rng.randint(0, 3, batch)].astype(np.float32)
+    return DataSet(features=x, labels=y)
+
+
+# -- validator units: one reason code per corruption class --------------
+
+
+def test_validator_clean_batch_passes():
+    v = BatchValidator(SCHEMA)
+    assert v.validate(clean_batch()) == []
+
+
+def test_validator_wrong_feature_dim_is_shape():
+    v = BatchValidator(SCHEMA)
+    ds = clean_batch()
+    ds.features = np.asarray(ds.features)[:, :-1]
+    assert v.validate(ds) == ["shape"]
+
+
+def test_validator_batch_dim_mismatch_is_shape():
+    v = BatchValidator(SCHEMA)
+    ds = clean_batch()
+    ds.labels = np.asarray(ds.labels)[:-1]
+    assert v.validate(ds) == ["shape"]
+
+
+def test_validator_string_payload_is_dtype_and_short_circuits():
+    # dtype is checked FIRST: object/str arrays must never reach the
+    # numpy value math (isfinite on a str array raises)
+    v = BatchValidator(SCHEMA)
+    ds = clean_batch()
+    ds.features = np.asarray(ds.features).astype("U8")
+    assert v.validate(ds) == ["dtype"]
+
+
+def test_validator_nan_and_inf_are_non_finite():
+    v = BatchValidator(SCHEMA)
+    for bad in (np.nan, np.inf):
+        ds = clean_batch()
+        f = np.array(ds.features, copy=True)
+        f[0, 0] = bad
+        ds.features = f
+        assert v.validate(ds) == ["non_finite"]
+
+
+def test_validator_label_out_of_range():
+    v = BatchValidator(SCHEMA)
+    ds = clean_batch()
+    lab = np.array(ds.labels, copy=True)
+    lab[0, 0] = 7.0
+    ds.labels = lab
+    assert v.validate(ds) == ["label_range"]
+
+
+def test_validator_finite_but_huge_is_magnitude():
+    # the poison a NaN/Inf guard never sees
+    v = BatchValidator(SCHEMA)
+    ds = clean_batch()
+    f = np.array(ds.features, copy=True)
+    f[0, 0] = 1e12
+    ds.features = f
+    assert v.validate(ds) == ["magnitude"]
+
+
+def test_validator_mask_batch_mismatch():
+    v = BatchValidator(SCHEMA)
+    ds = clean_batch()
+    ds.features_mask = np.ones((3,), np.float32)  # batch is 8
+    assert v.validate(ds) == ["mask_mismatch"]
+
+
+def test_validator_multiple_value_reasons_accumulate():
+    v = BatchValidator(SCHEMA)
+    ds = clean_batch()
+    f = np.array(ds.features, copy=True)
+    f[0, 0] = np.nan
+    ds.features = f
+    lab = np.array(ds.labels, copy=True)
+    lab[0, 0] = 7.0
+    ds.labels = lab
+    assert v.validate(ds) == ["non_finite", "label_range"]
+
+
+def test_schema_inferred_from_model_conf():
+    m = simple_net()
+    s = BatchSchema.from_model(m)
+    assert s.feature_dim == 4
+    assert s.label_dim == 3
+    assert s.label_range == (0.0, 1.0)  # softmax output
+    assert BatchValidator(s).validate(clean_batch()) == []
+
+
+# -- quarantine store ---------------------------------------------------
+
+
+def test_store_put_replay_roundtrip(tmp_path):
+    store = QuarantineStore(tmp_path / "q")
+    ds = clean_batch()
+    entry = store.put(ds, ["magnitude"], offset=5)
+    assert entry["file"] and entry["size"] > 0
+    assert entry["crc32"] is not None
+    # manifest landed atomically and re-opens
+    doc = json.loads((tmp_path / "q" / "manifest.json").read_text())
+    assert len(doc["entries"]) == 1
+    replayed = list(store.replay())
+    assert len(replayed) == 1
+    e, got = replayed[0]
+    assert e["reasons"] == ["magnitude"] and e["offset"] == 5
+    np.testing.assert_array_equal(np.asarray(got.features),
+                                  np.asarray(ds.features))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(ds.labels))
+
+
+def test_store_reopen_continues_sequence(tmp_path):
+    store = QuarantineStore(tmp_path / "q")
+    store.put(clean_batch(), ["shape"], offset=0)
+    again = QuarantineStore(tmp_path / "q")
+    assert len(again.entries()) == 1
+    again.put(clean_batch(), ["dtype"], offset=3)
+    files = sorted(e["file"] for e in again.entries())
+    assert files == ["q-00000000.npz", "q-00000001.npz"]
+
+
+def test_store_bounded_eviction_keeps_ledger_line(tmp_path):
+    one = len(clean_batch().to_npz_bytes())
+    store = QuarantineStore(tmp_path / "q", max_bytes=2 * one + 16)
+    for i in range(4):
+        store.put(clean_batch(), ["magnitude"], offset=i)
+    entries = store.entries()
+    # every reject stays on the ledger even after its bytes age out
+    assert len(entries) == 4
+    assert [e["offset"] for e in entries] == [0, 1, 2, 3]
+    evicted = [e for e in entries if e.get("evicted")]
+    live = [e for e in entries if e["file"]]
+    assert evicted and live
+    assert store.total_bytes() <= store.max_bytes
+    # oldest-first: the survivors are the newest
+    assert [e["offset"] for e in live] == [2, 3]
+    blobs = [p.name for p in (tmp_path / "q").glob("*.npz")]
+    assert len(blobs) == len(live)
+
+
+def test_store_corrupt_blob_fails_crc_on_replay(tmp_path):
+    store = QuarantineStore(tmp_path / "q")
+    entry = store.put(clean_batch(), ["shape"], offset=1)
+    blob = tmp_path / "q" / entry["file"]
+    blob.write_bytes(b"garbage" + blob.read_bytes()[7:])
+    (e,), (ds,) = zip(*store.replay())
+    assert ds is None and e["offset"] == 1
+
+
+# -- validating iterator ------------------------------------------------
+
+
+def test_validating_iterator_filters_and_ledgers():
+    rng = np.random.RandomState(CHAOS_SEED)
+    bs = mk_batches(rng, n_batches=6)
+    bad = clean_batch()
+    bad.features = np.asarray(bad.features).astype("U8")
+    stream = [bs[0], bad, bs[1], bs[2], bad, bs[3], bs[4], bs[5]]
+    vit = ValidatingIterator(ListDataSetIterator(stream),
+                             BatchValidator(SCHEMA))
+    out = []
+    while vit.has_next():
+        out.append(vit.next())
+    assert len(out) == 6
+    assert vit.skipped_offsets == [1, 4]
+    assert vit.ledger() == {"offset": 8, "skipped": [1, 4],
+                            "reasons": {"dtype": 2}}
+
+
+def test_validating_iterator_poison_tail_ends_stream():
+    # the lookahead keeps has_next() honest when every remaining base
+    # batch is poison
+    bad = clean_batch()
+    bad.features = np.asarray(bad.features)[:, :-1]
+    stream = [clean_batch(), bad, bad]
+    vit = ValidatingIterator(ListDataSetIterator(stream),
+                             BatchValidator(SCHEMA))
+    assert vit.has_next()
+    vit.next()
+    assert not vit.has_next()
+    assert vit.skipped_offsets == [1, 2]
+
+
+def test_validating_iterator_plain_list_base():
+    bs = mk_batches(np.random.RandomState(0), n_batches=3)
+    vit = ValidatingIterator(bs, BatchValidator(SCHEMA))
+    n = 0
+    while vit.has_next():
+        vit.next()
+        n += 1
+    assert n == 3 and vit.offset == 3
+
+
+def test_validating_iterator_fast_forward_skips_unvalidated():
+    bad = clean_batch()
+    bad.features = np.asarray(bad.features)[:, :-1]
+    stream = [bad, clean_batch(), clean_batch()]
+    vit = ValidatingIterator(ListDataSetIterator(stream),
+                             BatchValidator(SCHEMA))
+    vit.fast_forward(2)  # the poison at 0 is NOT validated
+    assert vit.offset == 2 and vit.skipped_offsets == []
+    assert vit.has_next()
+    vit.next()
+    assert not vit.has_next()
+
+
+def test_validating_iterator_max_quarantined_aborts():
+    bad = clean_batch()
+    bad.features = np.asarray(bad.features)[:, :-1]
+    vit = ValidatingIterator(ListDataSetIterator([bad] * 5),
+                             BatchValidator(SCHEMA), max_quarantined=2)
+    with pytest.raises(DL4JFaultException, match="systematically"):
+        while vit.has_next():
+            vit.next()
+
+
+def test_validating_iterator_quarantines_to_store(tmp_path):
+    store = QuarantineStore(tmp_path / "q")
+    bad = clean_batch()
+    f = np.array(bad.features, copy=True)
+    f[0, 0] = np.inf
+    bad.features = f
+    vit = ValidatingIterator(
+        ListDataSetIterator([clean_batch(), bad]),
+        BatchValidator(SCHEMA), quarantine=store,
+    )
+    while vit.has_next():
+        vit.next()
+    (entry,) = store.entries()
+    assert entry["reasons"] == ["non_finite"] and entry["offset"] == 1
+
+
+# -- poison iterator (the storm generator) ------------------------------
+
+
+def test_poison_iterator_kinds_trip_matching_reasons():
+    v = BatchValidator(SCHEMA)
+    expected = {"wrong_shape": "shape", "wrong_dtype": "dtype",
+                "label_range": "label_range", "huge_values": "magnitude"}
+    for kind, reason in expected.items():
+        rng = np.random.RandomState(CHAOS_SEED)
+        it = PoisonIterator(ListDataSetIterator(mk_batches(rng, 2)),
+                            poison={1: kind})
+        assert v.validate(it.next()) == []
+        assert v.validate(it.next()) == [reason]
+        assert it.poisoned == [(1, kind)]
+
+
+def test_poison_iterator_copies_before_corrupting():
+    bs = mk_batches(np.random.RandomState(0), 1)
+    pristine = np.array(bs[0].features, copy=True)
+    it = PoisonIterator(ListDataSetIterator(bs), poison={0: "huge_values"})
+    it.next()
+    np.testing.assert_array_equal(np.asarray(bs[0].features), pristine)
+
+
+def test_poison_iterator_seeded_storm_replays_on_reset():
+    rng = np.random.RandomState(CHAOS_SEED)
+    bs = mk_batches(rng, n_batches=20)
+    it = PoisonIterator(ListDataSetIterator(bs), seed=CHAOS_SEED,
+                        poison_rate=0.3)
+    while it.has_next():
+        it.next()
+    storm = list(it.poisoned)
+    assert storm  # 20 draws at 0.3: the seed makes this deterministic
+    it.reset()
+    it.poisoned.clear()
+    while it.has_next():
+        it.next()
+    assert it.poisoned == storm
+
+
+def test_poison_iterator_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown poison kind"):
+        PoisonIterator(ListDataSetIterator([]), poison={0: "acid"})
+
+
+# -- chaos storms: defended fit is bitwise the clean run ----------------
+
+
+POISON = {2: "wrong_dtype", 5: "label_range", 9: "huge_values",
+          11: "wrong_shape"}
+WANT_REASONS = {"dtype": 1, "label_range": 1, "magnitude": 1, "shape": 1}
+
+
+@pytest.mark.chaos
+def test_chaos_poison_storm_multilayer_bitwise(tmp_path):
+    """K corrupt of N -> exactly K quarantines with the right reason
+    codes, and final params BITWISE equal to the clean run over the
+    N-K survivors."""
+    rng = np.random.RandomState(CHAOS_SEED)
+    bs = mk_batches(rng, n_batches=14)
+    store = QuarantineStore(tmp_path / "q")
+
+    defended = simple_net()
+    defended.set_batch_validator(BatchValidator(SCHEMA), store)
+    poisoned = PoisonIterator(ListDataSetIterator(bs), poison=POISON)
+    defended.fit(poisoned, epochs=1)
+
+    survivors = [b for i, b in enumerate(bs) if i not in POISON]
+    clean = simple_net()
+    clean.fit(ListDataSetIterator(survivors), epochs=1)
+
+    conftest.assert_params_match(defended, clean)
+    assert_updater_state_match(defended, clean)
+    assert defended.iteration_count == clean.iteration_count == 10
+
+    entries = store.entries()
+    assert [e["offset"] for e in entries] == sorted(POISON)
+    got = {}
+    for e in entries:
+        for r in e["reasons"]:
+            got[r] = got.get(r, 0) + 1
+    assert got == WANT_REASONS
+    # forensics: every quarantined blob replays
+    assert sum(ds is not None for _, ds in store.replay()) == 4
+
+
+@pytest.mark.chaos
+def test_chaos_poison_storm_graph_engine_bitwise(tmp_path):
+    rng = np.random.RandomState(CHAOS_SEED + 1)
+    bs = mk_batches(rng, n_batches=14)
+    store = QuarantineStore(tmp_path / "q")
+
+    defended = graph_net()
+    defended.set_batch_validator(BatchValidator(SCHEMA), store)
+    defended.fit(PoisonIterator(ListDataSetIterator(bs), poison=POISON),
+                 epochs=1)
+
+    survivors = [b for i, b in enumerate(bs) if i not in POISON]
+    clean = graph_net()
+    clean.fit(ListDataSetIterator(survivors), epochs=1)
+
+    conftest.assert_params_match(defended, clean)
+    assert defended.iteration_count == clean.iteration_count == 10
+    assert [e["offset"] for e in store.entries()] == sorted(POISON)
+
+
+@pytest.mark.chaos
+def test_chaos_poison_storm_distributed_prefetch_bitwise(tmp_path):
+    """Defense through ``DistributedTrainer.fit(validator=...)`` with
+    the prefetch worker live: validation runs on the worker thread and
+    the hot path still lands bitwise on the clean trajectory."""
+    rng = np.random.RandomState(CHAOS_SEED + 2)
+    bs = mk_batches(rng, n_batches=14)
+    store = QuarantineStore(tmp_path / "q")
+
+    defended = simple_net()
+    tr = DistributedTrainer(defended, mesh=build_mesh())
+    tr.fit(PoisonIterator(ListDataSetIterator(bs), poison=POISON),
+           epochs=1, prefetch=2,
+           validator=BatchValidator(SCHEMA), quarantine=store)
+
+    survivors = [b for i, b in enumerate(bs) if i not in POISON]
+    clean = simple_net()
+    DistributedTrainer(clean, mesh=build_mesh()).fit(
+        ListDataSetIterator(survivors), epochs=1)
+
+    conftest.assert_params_match(defended, clean)
+    assert defended.iteration_count == clean.iteration_count == 10
+    assert [e["offset"] for e in store.entries()] == sorted(POISON)
+
+
+@pytest.mark.chaos
+def test_chaos_random_storm_exact_counts(tmp_path):
+    """Seeded random storm: the PoisonIterator's own (offset, kind)
+    record is the oracle for exact-count asserts."""
+    rng = np.random.RandomState(CHAOS_SEED + 3)
+    bs = mk_batches(rng, n_batches=24)
+    store = QuarantineStore(tmp_path / "q")
+    it = PoisonIterator(ListDataSetIterator(bs), seed=CHAOS_SEED,
+                        poison_rate=0.25)
+
+    m = simple_net()
+    m.set_batch_validator(BatchValidator(SCHEMA), store)
+    m.fit(it, epochs=1)
+
+    assert it.poisoned
+    assert [e["offset"] for e in store.entries()] == [
+        at for at, _ in it.poisoned
+    ]
+    assert m.iteration_count == 24 - len(it.poisoned)
+
+
+# -- statistical anomaly guard ------------------------------------------
+
+
+SG_CFG = StatGuardConfig(alpha=0.05, z_threshold=4.0, spike_factor=5.0,
+                         warmup=10)
+
+
+def spike_batch(rng, scale=50.0):
+    """Finite but absurd labels: the loss and the output-layer
+    gradient explode while every value stays finite — the anomaly a
+    NaN guard never sees. (Scaling FEATURES would saturate the tanh
+    layer and shrink the gradient instead.)"""
+    ds = clean_batch(rng)
+    ds.labels = np.asarray(ds.labels) * np.float32(scale)
+    return ds
+
+
+def test_stat_guard_trips_and_suppresses_update_bitwise():
+    rng = np.random.RandomState(CHAOS_SEED)
+    warm = mk_batches(rng, n_batches=20)
+    m = simple_net()
+    guard = DivergenceGuard(stats=SG_CFG)
+    m.set_divergence_guard(guard)
+    m.fit(ListDataSetIterator(warm), epochs=1)
+    assert guard.skipped_batches == []
+    before = {ln: {pn: np.array(m.params[ln][pn], copy=True)
+                   for pn in m.params[ln]} for ln in m.params}
+
+    m.fit(ListDataSetIterator([spike_batch(rng)]), epochs=1)
+    # the true offending step lands on the ledger even though the
+    # async window consults the flag late
+    assert guard.skipped_batches == [20]
+    st = m._stat_guard_state
+    assert int(st["trips_loss"]) + int(st["trips_gnorm"]) >= 1
+    assert m.iteration_count == 21  # skips still advance the counter
+    for ln in m.params:
+        for pn in m.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(m.params[ln][pn]), before[ln][pn],
+                err_msg=f"{ln}/{pn} moved on a tripped step",
+            )
+    # the spike is excluded from the EWMA fold: the clean statistics
+    # cannot be dragged up by the anomaly they rejected
+    assert int(m._stat_guard_state["count"]) == 20
+
+
+def test_stat_guard_state_doc_roundtrip_bitwise():
+    rng = np.random.RandomState(CHAOS_SEED)
+    m = simple_net()
+    m.set_divergence_guard(DivergenceGuard(stats=SG_CFG))
+    m.fit(ListDataSetIterator(mk_batches(rng, 6)), epochs=1)
+    state = m._stat_guard_state
+    doc = stat_guard_state_doc(state)
+    back = stat_guard_state_from_doc(json.loads(json.dumps(doc)))
+    for k in state:
+        assert np.asarray(back[k]).tobytes() == \
+            np.asarray(state[k]).tobytes(), k
+
+
+@pytest.mark.chaos
+def test_chaos_stat_guard_checkpoint_resume_bitwise(tmp_path):
+    """Kill after a trip: the manifest carries the EWMA state and the
+    skipped ledger, and the resumed model continues bitwise with the
+    original's trip decisions intact."""
+    rng = np.random.RandomState(CHAOS_SEED + 4)
+    warm = mk_batches(rng, n_batches=16)
+    spike = spike_batch(rng)
+    tail = mk_batches(rng, n_batches=4)
+
+    m = simple_net()
+    guard = DivergenceGuard(stats=SG_CFG)
+    m.set_divergence_guard(guard)
+    m.fit(ListDataSetIterator(warm + [spike]), epochs=1)
+    assert guard.skipped_batches == [16]
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(m)
+
+    m2 = simple_net()
+    guard2 = DivergenceGuard(stats=SG_CFG)
+    m2.set_divergence_guard(guard2)
+    _, step = restore_into(m2, mgr)
+    assert step == 17
+    assert guard2.skipped_batches == [16]
+    for k in m._stat_guard_state:
+        assert np.asarray(m2._stat_guard_state[k]).tobytes() == \
+            np.asarray(m._stat_guard_state[k]).tobytes(), k
+
+    m.fit(ListDataSetIterator(tail), epochs=1)
+    m2.fit(ListDataSetIterator(tail), epochs=1)
+    conftest.assert_params_match(m, m2)
+    assert_updater_state_match(m, m2)
+
+
+def test_stat_guard_no_trips_is_bitwise_no_op():
+    """With no anomalies, arming the statistical guard on top of the
+    NaN/Inf guard computes the BITWISE identical trajectory: the EWMA
+    fold rides alongside the update math without perturbing it. (The
+    baseline is the plain guard, not the unguarded step: any guard
+    changes the compiled program, and two different XLA programs may
+    differ in last-ulp fusion — that pre-existing boundary is covered
+    by the PR-11 guard tests.)"""
+    rng = np.random.RandomState(CHAOS_SEED)
+    bs = mk_batches(rng, n_batches=8)
+    a = simple_net()
+    a.set_divergence_guard(DivergenceGuard(stats=SG_CFG))
+    a.fit(ListDataSetIterator(bs), epochs=1)
+    b = simple_net()
+    b.set_divergence_guard(DivergenceGuard())
+    b.fit(ListDataSetIterator(bs), epochs=1)
+    conftest.assert_params_match(a, b)
+    assert_updater_state_match(a, b)
+
+
+@pytest.mark.chaos
+def test_chaos_stat_guard_distributed_trainer_ledger():
+    rng = np.random.RandomState(CHAOS_SEED + 5)
+    bs = mk_batches(rng, n_batches=25) + [spike_batch(rng)]
+    m = simple_net()
+    guard = DivergenceGuard(stats=SG_CFG)
+    tr = DistributedTrainer(m, mesh=build_mesh(), divergence_guard=guard)
+    tr.fit(ListDataSetIterator(bs), epochs=1)
+    assert guard.skipped_batches == [25]
+    st = m._stat_guard_state
+    assert int(st["trips_loss"]) + int(st["trips_gnorm"]) >= 1
+
+
+def test_stat_guard_composes_with_grad_accum():
+    rng = np.random.RandomState(CHAOS_SEED)
+    bs = mk_batches(rng, n_batches=4)
+    m = simple_net()
+    m.set_divergence_guard(DivergenceGuard(stats=SG_CFG))
+    m.fit(ListDataSetIterator(bs), epochs=1, grad_accum=2)
+    assert core.transform_kind_suffix(m) == "+statguard+accum:2"
+    assert m.iteration_count == 4  # counter ticks per microbatch
+    assert m._stat_guard_state is not None
+
+
+@pytest.mark.chaos
+def test_chaos_stat_guard_composes_with_zero():
+    rng = np.random.RandomState(CHAOS_SEED + 6)
+    bs = mk_batches(rng, n_batches=8, batch=8)
+    mesh = build_mesh(data=8, model=1)
+    a = simple_net()
+    DistributedTrainer(a, mesh=mesh, zero=True,
+                       divergence_guard=DivergenceGuard(stats=SG_CFG)
+                       ).fit(ListDataSetIterator(bs), epochs=1)
+    b = simple_net()
+    DistributedTrainer(b, mesh=build_mesh(data=8, model=1), zero=True,
+                       divergence_guard=DivergenceGuard()
+                       ).fit(ListDataSetIterator(bs), epochs=1)
+    conftest.assert_params_match(a, b)
+
+
+# -- kill/resume mid-poison: the continual trainer's ledger -------------
+
+
+@pytest.mark.chaos
+def test_chaos_continual_trainer_kill_resume_mid_poison(tmp_path):
+    """A run dies between publishes while quarantining: the published
+    manifest's data ledger makes the resumed stream line up (base
+    offsets, not clean offsets), and the resumed run lands bitwise on
+    the uninterrupted trajectory."""
+    from deeplearning4j_tpu.loop import ContinualTrainer
+
+    rng = np.random.RandomState(CHAOS_SEED + 7)
+    bs = mk_batches(rng, n_batches=12)
+    poison = {1: "huge_values", 4: "wrong_dtype", 8: "label_range"}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    store = QuarantineStore(tmp_path / "q")
+
+    m1 = simple_net()
+    ct1 = ContinualTrainer(m1, mgr, publish_every=2,
+                           validator=BatchValidator(SCHEMA),
+                           quarantine=store)
+    # dies after 5 optimizer steps; newest publish is step 4 (no
+    # trailing publish — the process never got to exit cleanly)
+    ct1.run(PoisonIterator(ListDataSetIterator(bs), poison=poison),
+            max_steps=5, publish_trailing=False)
+    assert mgr.latest_step() == 4
+
+    m2 = simple_net()
+    ct2 = ContinualTrainer(m2, mgr, publish_every=2,
+                           validator=BatchValidator(SCHEMA),
+                           quarantine=store)
+    step = ct2.resume()
+    assert step == 4
+    led = m2._data_ledger
+    # 4 clean steps consumed 6 base batches (poison at 1 and 4)
+    assert led["offset"] == 6 and led["skipped"] == [1, 4]
+    # replay the SAME storm from the top; the ledger fast-forwards
+    # past everything already handled
+    ct2.run(PoisonIterator(ListDataSetIterator(bs), poison=poison))
+    assert m2._data_ledger["skipped"] == [1, 4, 8]
+    assert m2._data_ledger["reasons"] == {
+        "magnitude": 1, "dtype": 1, "label_range": 1,
+    }
+
+    clean = simple_net()
+    survivors = [b for i, b in enumerate(bs) if i not in poison]
+    clean.fit(ListDataSetIterator(survivors), epochs=1)
+    conftest.assert_params_match(m2, clean)
+    assert_updater_state_match(m2, clean)
+    assert m2.iteration_count == clean.iteration_count == 9
+
+
+# -- metrics ------------------------------------------------------------
+
+
+def test_quarantine_metrics_account_by_reason(tmp_path):
+    from deeplearning4j_tpu.observability.metrics import default_registry
+
+    reg = default_registry()
+    counter = reg.counter("batches_quarantined_total", labels=("reason",))
+    before = counter.labels("magnitude").value
+    store = QuarantineStore(tmp_path / "q")
+    store.put(clean_batch(), ["magnitude"], offset=0)
+    assert counter.labels("magnitude").value == before + 1
+    assert reg.gauge("quarantine_bytes").value == store.total_bytes()
