@@ -622,8 +622,13 @@ def bench_lstm_saturated(batch=256, seq=128, vocab=256, hidden=1024,
     batches = _to_hbm(batches)
 
     def run(pallas_flag):
+        from deeplearning4j_tpu.ops import dispatch
+
         prev = os.environ.get("DL4J_TPU_PALLAS")
         os.environ["DL4J_TPU_PALLAS"] = pallas_flag
+        # dispatch caches the env read once per process; the A/B flip
+        # must go through the explicit test/bench hook
+        dispatch.reset_for_tests()
         try:
             net = MultiLayerNetwork(
                 graves_lstm_char_rnn(vocab=vocab, hidden=hidden,
@@ -654,6 +659,7 @@ def bench_lstm_saturated(batch=256, seq=128, vocab=256, hidden=1024,
                 os.environ.pop("DL4J_TPU_PALLAS", None)
             else:
                 os.environ["DL4J_TPU_PALLAS"] = prev
+            dispatch.reset_for_tests()
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -1359,6 +1365,38 @@ def bench_remat_memory(budget_s=None) -> dict:
     return _bench_transforms("remat_memory", budget_s)
 
 
+def bench_fused_kernels(budget_s=None) -> dict:
+    """Pallas fused-kernel library A/B via the standalone script
+    (scripts/bench_kernels.py — interleaved kernel vs XLA windows per
+    config: conv stack, resnet50 bottleneck, MLP). Gates: kernel
+    forward parity <= 1e-5 vs the XLA reference (interpret mode
+    exercises the same code path on CPU) and the compiled-op evidence
+    that the fused epilogue eliminates the separate bias/BN/activation
+    HBM round-trips (executable + entry-op counts, round-trip bytes).
+    On CPU the run is correctness-only (``timing_skipped``); on a real
+    TPU it also reports step time, achieved FLOP/s and the MFU delta
+    per config."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_kernels.py",
+    )
+    timeout = 300
+    if budget_s is not None:
+        timeout = max(30, min(timeout, int(budget_s)))
+    out = subprocess.run(
+        [sys.executable, script, "--budget-s", str(max(10, timeout - 10))],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ,
+             "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE or ""},
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_kernels failed (parity or fusion-evidence gate): "
+            f"{out.stderr[-2000:] or out.stdout[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_observability(iters=300, windows=5) -> dict:
     """Overhead of the observability substrate on the two hot paths.
 
@@ -1591,6 +1629,12 @@ def _section_table(budget_fn):
          "activation working set + max-fitting batch at fixed "
          "budget, remat off vs on "
          "(scripts/bench_transforms.py; >=1.5x batch is the gate)"),
+        ("fused_kernels",
+         lambda: bench_fused_kernels(budget_fn()),
+         "Pallas conv/matmul epilogue kernels vs XLA, interleaved "
+         "A/B per config (scripts/bench_kernels.py; parity <= 1e-5 "
+         "and compiled-op round-trip evidence are the gates; "
+         "timing + MFU delta on real TPUs only)"),
     ]
 
 

@@ -476,6 +476,28 @@ def sequential_forward(conf, layer_names, params, state, x, *,
                     new_state[rn] = state.get(rn, {})
                 i = end
                 continue
+        if (not train and not collect and i + 1 < n
+                and (i + 1) not in conf.preprocessors
+                and (i + 1) not in run_at
+                and getattr(layer, "kernel_size", None) is not None):
+            # inference peephole: Conv(identity) -> BN(act) as ONE
+            # fused kernel call (None when the fused path doesn't
+            # engage — then the ordinary walk below runs unchanged)
+            from deeplearning4j_tpu.nn.layers.convolution import (
+                maybe_fused_conv_bn,
+            )
+
+            nxt = layer_names[i + 1]
+            fused = maybe_fused_conv_bn(
+                layer, conf.layers[i + 1], params.get(name, {}),
+                params.get(nxt, {}), state.get(nxt, {}), x,
+            )
+            if fused is not None:
+                x = fused
+                new_state[name] = state.get(name, {})
+                new_state[nxt] = state.get(nxt, {})
+                i += 2
+                continue
         lrng = jax.random.fold_in(rng, i) if rng is not None else None
         if i == n - 1 and hasattr(layer, "pre_output") and layer.has_loss():
             xin = layer.maybe_dropout(x, train=train, rng=lrng)
@@ -1506,4 +1528,41 @@ def transform_kind_suffix(model) -> str:
         # layout; a stale plain-step artifact must be refused, not
         # fed flat state (and vice versa)
         parts.append("zero")
+    if conv_block_dispatch_active(model):
+        # Pallas fused conv/dense kernels produce different HLO than
+        # the plain XLA walk; an executable compiled with the kernels
+        # off must be refused when dispatch is on (and vice versa)
+        parts.append("convblock")
     return ("+" + "+".join(parts)) if parts else ""
+
+
+def _model_layer_confs(model):
+    """Layer specs of either engine's config: the sequential list, or
+    the layer-bearing vertices of a graph."""
+    conf = model.conf
+    layers = getattr(conf, "layers", None)
+    if layers is not None:
+        return list(layers)
+    verts = getattr(conf, "vertices", None) or {}
+    return [lc for lc in (v.layer() for v in verts.values())
+            if lc is not None]
+
+
+def conv_block_dispatch_active(model) -> bool:
+    """True when Pallas fused-kernel dispatch is on AND the model has
+    layers that route through it (conv/dense families). Deliberately
+    coarse — a model whose only dense head is softmax over-refuses a
+    stale artifact and falls back to JIT, which is safe; the converse
+    (mis-dispatching an executable traced with different kernels)
+    is not."""
+    from deeplearning4j_tpu.ops.dispatch import use_pallas
+
+    if not use_pallas():
+        return False
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+
+    return any(
+        isinstance(lc, (ConvolutionLayer, DenseLayer))
+        for lc in _model_layer_confs(model)
+    )

@@ -868,7 +868,11 @@ class ComputationGraph:
         return tuple(tuple(int(d) for d in s) for s in shapes)
 
     def _output_kind(self) -> str:
-        return "output" + ("+scan" if self.scan_layers else "")
+        # scan AND kernel dispatch both change the compiled inference
+        # program (conv/dense kernels + the eval conv->BN peephole)
+        return ("output" + ("+scan" if self.scan_layers else "")
+                + ("+convblock"
+                   if core.conv_block_dispatch_active(self) else ""))
 
     def aot_fingerprint(self, shapes, kind: Optional[str] = None) -> str:
         from deeplearning4j_tpu.compile.aot import artifact_fingerprint

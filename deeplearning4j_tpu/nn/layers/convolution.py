@@ -127,9 +127,35 @@ class ConvolutionLayer(LayerSpec):
     def supports_drop_connect(self) -> bool:
         return True
 
+    def _kernel_eligible(self, params, x, activation: str) -> bool:
+        """Whether the fused Pallas conv kernel can take this apply
+        call: supported epilogue and a VMEM-fitting tiling (see
+        ``ops.conv_block.conv_block_ok``)."""
+        from deeplearning4j_tpu.ops import SUPPORTED_EPILOGUES, conv_block_ok
+
+        return (
+            x.ndim == 4
+            and activation in SUPPORTED_EPILOGUES
+            and conv_block_ok(
+                x.shape, params["W"].shape, _pair(self.stride),
+                _pair(self.padding), x.dtype,
+            )
+        )
+
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
         params = self.maybe_drop_connect(params, train=train, rng=rng)
+        from deeplearning4j_tpu.ops import conv_block, dispatch
+
+        act = self.activation.lower()
+        if dispatch.route("conv_block",
+                          self._kernel_eligible(params, x, act)):
+            y = conv_block(
+                x, params["W"], params["b"],
+                stride=_pair(self.stride), padding=_pair(self.padding),
+                activation=act,
+            )
+            return y, state
         return self.activate_fn()(self.pre_output(params, x)), state
 
 
@@ -280,16 +306,29 @@ class BatchNormalization(LayerSpec):
         # fold to a per-channel affine (y = a*x + b): the apply pass
         # is then a single fused elementwise read-modify-write, and
         # the [C]-sized coefficient math stays off the hot pass
-        inv = lax.rsqrt(var + self.eps)
-        if self.lock_gamma_beta:
-            a = inv
-            b = -mean * inv
-        else:
-            a = params["gamma"].astype(inv.dtype) * inv
-            b = params["beta"].astype(inv.dtype) - mean * a
+        a, b = self._affine_from_stats(params, mean, var)
         y = x * a.astype(x.dtype).reshape(bshape) + \
             b.astype(x.dtype).reshape(bshape)
         return self.activate_fn()(y), new_state
+
+    def _affine_from_stats(self, params, mean, var):
+        inv = lax.rsqrt(var + self.eps)
+        if self.lock_gamma_beta:
+            return inv, -mean * inv
+        a = params["gamma"].astype(inv.dtype) * inv
+        b = params["beta"].astype(inv.dtype) - mean * a
+        return a, b
+
+    def folded_affine(self, params, state):
+        """The eval-mode normalization folded to per-channel ``(a, b)``
+        with ``y = a*x + b`` — the same coefficients the eval branch of
+        ``apply`` uses, exposed so the conv->BN inference peephole can
+        hand them to the fused conv kernel's epilogue."""
+        acc_dt = jnp.promote_types(state["mean"].dtype, jnp.float32)
+        return self._affine_from_stats(
+            params, state["mean"].astype(acc_dt),
+            state["var"].astype(acc_dt),
+        )
 
 
 @register_layer
@@ -323,3 +362,41 @@ class LocalResponseNormalization(LayerSpec):
         )
         denom = (self.k + self.alpha * summed) ** self.beta
         return x / denom, state
+
+
+def maybe_fused_conv_bn(conv, bn, conv_params, bn_params, bn_state, x):
+    """Inference peephole: Conv(identity) -> BatchNormalization(act)
+    collapsed into ONE fused kernel call — the BN running stats fold to
+    a per-channel affine (``folded_affine``) that rides the conv
+    kernel's epilogue, deleting the separate normalize+activate HBM
+    round-trip. Returns the fused activation, or None when the fused
+    path does not engage (wrong layer pair, unsupported epilogue,
+    no VMEM-fitting tiling, or Pallas dispatch off) — the caller then
+    falls back to the ordinary layer-by-layer walk, which keeps
+    kernel-off trajectories bitwise untouched. Training never fuses:
+    batch stats depend on the conv output itself."""
+    if not (isinstance(conv, ConvolutionLayer)
+            and isinstance(bn, BatchNormalization)
+            and conv.activation.lower() == "identity"
+            and x.ndim == 4
+            and bn.n_out == conv.n_out
+            and bn_state):
+        return None
+    from deeplearning4j_tpu.ops import conv_block, dispatch
+
+    act = bn.activation.lower()
+    if not (conv._kernel_eligible(conv_params, x, act)
+            and dispatch.use_pallas()):
+        # no metric here: the unfused walk's own conv_block route
+        # records the decision for this conv
+        return None
+    dispatch.note_dispatch(
+        "conv_bn_block",
+        "interpret" if dispatch.pallas_interpret() else "pallas",
+    )
+    a, b = bn.folded_affine(bn_params, bn_state)
+    return conv_block(
+        x, conv_params["W"], conv_params["b"], a, b,
+        stride=_pair(conv.stride), padding=_pair(conv.padding),
+        activation=act,
+    )

@@ -49,6 +49,27 @@ class DenseLayer(FeedForwardLayerSpec):
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
         params = self.maybe_drop_connect(params, train=train, rng=rng)
+        from deeplearning4j_tpu.ops import (
+            SUPPORTED_EPILOGUES,
+            dispatch,
+            matmul_block,
+            matmul_block_ok,
+        )
+
+        act = self.activation.lower()
+        # softmax heads (OutputLayer) stay on the XLA path: the row
+        # reduction is not a per-element epilogue the kernel supports
+        eligible = (
+            x.ndim == 2
+            and act in SUPPORTED_EPILOGUES
+            and matmul_block_ok(
+                x.shape[0], x.shape[1], params["W"].shape[1], x.dtype
+            )
+        )
+        if dispatch.route("matmul_block", eligible):
+            return matmul_block(
+                x, params["W"], params["b"], activation=act
+            ), state
         return self.activate_fn()(self.pre_output(params, x)), state
 
 

@@ -996,10 +996,14 @@ class MultiLayerNetwork:
     # -- AOT export/install (compile/aot.py) ---------------------------
 
     def _output_kind(self) -> str:
-        """AOT kind for the inference forward: scan-over-layers
-        changes the compiled program (remat/loss-scale do not touch
-        inference), so it is part of the artifact identity."""
-        return "output" + ("+scan" if self.scan_layers else "")
+        """AOT kind for the inference forward: scan-over-layers and
+        Pallas kernel dispatch change the compiled program (the
+        conv/dense kernels plus the eval conv->BN peephole;
+        remat/loss-scale do not touch inference), so both are part of
+        the artifact identity."""
+        return ("output" + ("+scan" if self.scan_layers else "")
+                + ("+convblock"
+                   if core.conv_block_dispatch_active(self) else ""))
 
     def aot_fingerprint(self, shape, kind: Optional[str] = None) -> str:
         """Validity fingerprint for this model's AOT artifacts at

@@ -5,12 +5,25 @@ embedding scatter-add and negative-sampling updates lower to native
 TPU scatter ops via ``jnp.ndarray.at``/``segment_sum``, so a custom
 kernel would only re-derive what the compiler emits."""
 
+from deeplearning4j_tpu.ops.conv_block import (
+    SUPPORTED_EPILOGUES,
+    conv_block,
+    conv_block_ok,
+    conv_block_reference,
+)
 from deeplearning4j_tpu.ops.flash_attention import flash_attention, mha
 from deeplearning4j_tpu.ops.lstm_cell import (
     lstm_cell,
     lstm_cell_diff,
     use_pallas_lstm,
 )
+from deeplearning4j_tpu.ops.matmul_block import (
+    matmul_block,
+    matmul_block_ok,
+    matmul_block_reference,
+)
 
 __all__ = ["flash_attention", "mha", "lstm_cell", "lstm_cell_diff",
-           "use_pallas_lstm"]
+           "use_pallas_lstm", "conv_block", "conv_block_ok",
+           "conv_block_reference", "matmul_block", "matmul_block_ok",
+           "matmul_block_reference", "SUPPORTED_EPILOGUES"]

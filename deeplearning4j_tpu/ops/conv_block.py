@@ -1,0 +1,312 @@
+"""Fused convolution Pallas kernel: ``activation(BN_affine(conv2d(x, w)
++ bias))`` as ONE kernel (ROADMAP: the kernel half of the MFU campaign;
+``artifacts/resnet50_roofline_r5.md`` shows conv owns 61.6% of the step
+and the separate bias/BN/activation passes around it are pure HBM
+round-trips).
+
+Design (register/cache blocking per "Anatomy of High-Performance Deep
+Learning Convolutions on SIMD Architectures"): im2col-free direct
+convolution, grid = (batch, out-channel blocks, out-row blocks) with the
+spatial axis innermost — the weight block's index is constant over it,
+so Mosaic's pipeline fetches each [kh, kw, C, oc_b] weight tile once and
+keeps it VMEM-resident while output rows stream. The kh*kw taps unroll
+at trace time; each tap is one MXU matmul ([oh_b*OW, C] x [C, oc_b])
+accumulated in f32 (half-precision inputs stay bf16/f16 into the MXU).
+The epilogue — bias add, the folded per-channel ``a*x + b`` BN affine,
+then identity/relu/leaky-relu/tanh — applies to the f32 accumulator
+in-register, followed by a single cast + HBM writeback.
+
+Layout: NCHW at the API (layer/checkpoint parity); internally NHWC +
+HWIO so the channel axis is the (contiguous) lane axis of every MXU
+operand. The transposes and the explicit zero-pad sit OUTSIDE the
+kernel where XLA fuses them; the epilogue round-trips are what this
+kernel deletes, not the relayout.
+
+Backward falls back to XLA (``jax.vjp`` through the reference math):
+the transposed convolutions lower straight to MXU convs that XLA
+already schedules well, so a hand kernel is not justified there —
+measured-first per the r5 roofline, same policy as ``lstm_cell``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+# Epilogue nonlinearities the kernel applies in-register (in f32,
+# before the single cast + writeback). Numerics must match
+# nn/activations.py exactly — the parity tests compare against the
+# layer path (leaky_relu's reference slope is 0.01).
+_EPILOGUES = {
+    "identity": lambda z: z,
+    "relu": lambda z: jnp.maximum(z, 0.0),
+    "leakyrelu": lambda z: jnp.where(z >= 0, z, z * 0.01),
+    "tanh": jnp.tanh,
+}
+SUPPORTED_EPILOGUES = tuple(_EPILOGUES)
+
+# Per-core VMEM is ~16 MB; leave headroom for Mosaic's own pipeline
+# buffers (same policy as lstm_cell's sequence kernel).
+_VMEM_BUDGET = 13 * 2 ** 20
+
+
+def _largest_divisor_leq(n: int, cap: int) -> int:
+    for d in range(min(n, cap), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def _conv_geometry(x_shape, w_shape, stride, padding):
+    n, c, h, w = (int(v) for v in x_shape)
+    o, ci, kh, kw = (int(v) for v in w_shape)
+    sh, sw = stride
+    ph, pw = padding
+    hp, wp = h + 2 * ph, w + 2 * pw
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
+    return n, c, hp, wp, o, kh, kw, oh, ow
+
+
+def _pick_blocks(x_shape, w_shape, stride, padding, itemsize):
+    """(oc_block, oh_block) tiling, or None when nothing fits VMEM.
+
+    Residents: the full padded image of one batch item (its block index
+    is constant over the channel/spatial grid dims, so it is fetched
+    once per item), one weight tile, the f32 accumulator and the output
+    block. oc_block is capped at 128 (one MXU tile of output lanes);
+    oh_block shrinks toward 1 until the budget holds — odd geometries
+    always admit oh_block=1 unless the image itself overflows."""
+    n, c, hp, wp, o, kh, kw, oh, ow = _conv_geometry(
+        x_shape, w_shape, stride, padding
+    )
+    if oh <= 0 or ow <= 0:
+        return None
+    oc_b = _largest_divisor_leq(o, 128)
+    fixed = (hp * wp * c * itemsize            # padded image (resident)
+             + kh * kw * c * oc_b * itemsize   # weight tile
+             + 2 * oc_b * 4)                   # f32 scale/shift
+    if fixed > _VMEM_BUDGET:
+        return None
+    cols = (ow - 1) * stride[1] + 1
+    for oh_b in range(oh, 0, -1):
+        if oh % oh_b:
+            continue
+        rows = (oh_b - 1) * stride[0] + 1
+        per = (oh_b * ow * oc_b * (4 + itemsize)  # f32 acc + out block
+               + rows * cols * c * itemsize       # tap window view
+               + oh_b * ow * c * itemsize)        # matmul operand
+        if fixed + per <= _VMEM_BUDGET:
+            return oc_b, oh_b
+    return None
+
+
+def conv_block_ok(x_shape, w_shape, stride=(1, 1), padding=(0, 0),
+                  dtype=jnp.float32) -> bool:
+    """Gate: 4-d NCHW/OIHW geometry with matching channels and a
+    VMEM-fitting tiling. Callers route to ``conv_block`` only when
+    this holds (else the plain XLA layer path)."""
+    if len(x_shape) != 4 or len(w_shape) != 4:
+        return False
+    if int(x_shape[1]) != int(w_shape[1]):
+        return False
+    try:
+        itemsize = np.dtype(dtype).itemsize
+        return _pick_blocks(x_shape, w_shape,
+                            (int(stride[0]), int(stride[1])),
+                            (int(padding[0]), int(padding[1])),
+                            itemsize) is not None
+    except (TypeError, ValueError):
+        return False
+
+
+def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, out_ref, *,
+                 kh, kw, sh, sw, act):
+    k = pl.program_id(2)
+    oh_b, ow, oc_b = (out_ref.shape[1], out_ref.shape[2],
+                      out_ref.shape[3])
+    c = x_ref.shape[3]
+    rows = (oh_b - 1) * sh + 1
+    cols = (ow - 1) * sw + 1
+    row0 = k * (oh_b * sh)
+    acc = jnp.zeros((oh_b * ow, oc_b), jnp.float32)
+    for dh in range(kh):
+        for dw in range(kw):
+            # one tap: the strided window of the resident image that
+            # feeds this output block, flattened to an MXU matmul
+            patch = x_ref[0, pl.ds(row0 + dh, rows), pl.ds(dw, cols), :]
+            if sh > 1 or sw > 1:
+                patch = patch[::sh, ::sw, :]
+            acc = acc + jnp.dot(
+                patch.reshape(oh_b * ow, c), w_ref[dh, dw],
+                preferred_element_type=jnp.float32,
+            )
+    z = acc * scale_ref[0] + shift_ref[0]
+    out_ref[0] = act(z).reshape(oh_b, ow, oc_b).astype(out_ref.dtype)
+
+
+def _conv_block_call(x, w, scale, shift, sh, sw, ph, pw, activation,
+                     interpret):
+    n, c, hp, wp, o, kh, kw, oh, ow = _conv_geometry(
+        x.shape, w.shape, (sh, sw), (ph, pw)
+    )
+    blocks = _pick_blocks(x.shape, w.shape, (sh, sw), (ph, pw),
+                          jnp.dtype(x.dtype).itemsize)
+    if blocks is None:
+        raise ValueError("conv_block: no VMEM-fitting tiling (callers "
+                         "must gate on conv_block_ok)")
+    oc_b, oh_b = blocks
+    xh = jnp.transpose(x, (0, 2, 3, 1))        # NCHW -> NHWC
+    if ph or pw:
+        xh = jnp.pad(xh, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    wh = jnp.transpose(w, (2, 3, 1, 0))        # OIHW -> HWIO
+    scale2 = scale.astype(jnp.float32).reshape(1, o)
+    shift2 = shift.astype(jnp.float32).reshape(1, o)
+    kern = functools.partial(_conv_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
+                             act=_EPILOGUES[activation])
+    out = pl.pallas_call(
+        kern,
+        grid=(n, o // oc_b, oh // oh_b),
+        in_specs=[
+            pl.BlockSpec((1, hp, wp, c), lambda i, j, k: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kh, kw, c, oc_b),
+                         lambda i, j, k: (0, 0, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, oc_b), lambda i, j, k: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, oc_b), lambda i, j, k: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, oh_b, ow, oc_b),
+                               lambda i, j, k: (i, k, 0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, oh, ow, o), x.dtype),
+        interpret=interpret,
+    )(xh, wh, scale2, shift2)
+    return jnp.transpose(out, (0, 3, 1, 2))    # NHWC -> NCHW
+
+
+def _reference_core(sh, sw, ph, pw, activation, x, w, scale, shift):
+    """XLA reference math — also the backward path (pallas_call has no
+    automatic transpose, so grads recompute through this; the
+    transposed convs it produces are already MXU-optimal). Same
+    semantics as the kernel: f32 accumulation, f32 epilogue, one final
+    cast. The CPU branch mirrors the layer's NHWC detour (Eigen has no
+    fast NCHW conv)."""
+    from deeplearning4j_tpu.ops.dispatch import effective_platform
+
+    if effective_platform() == "tpu":
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        y = jax.lax.conv_general_dilated(
+            jnp.transpose(x, (0, 2, 3, 1)),
+            jnp.transpose(w, (2, 3, 1, 0)),
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        y = jnp.transpose(y, (0, 3, 1, 2))
+    z = (y * scale.astype(jnp.float32).reshape(1, -1, 1, 1)
+         + shift.astype(jnp.float32).reshape(1, -1, 1, 1))
+    return _EPILOGUES[activation](z).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _conv_block_vjp(meta, x, w, scale, shift):
+    sh, sw, ph, pw, activation, interpret = meta
+    return _conv_block_call(x, w, scale, shift, sh, sw, ph, pw,
+                            activation, interpret)
+
+
+def _conv_block_fwd(meta, x, w, scale, shift):
+    sh, sw, ph, pw, activation, interpret = meta
+    return (
+        _conv_block_call(x, w, scale, shift, sh, sw, ph, pw,
+                         activation, interpret),
+        (x, w, scale, shift),
+    )
+
+
+def _conv_block_bwd(meta, res, g):
+    sh, sw, ph, pw, activation, _ = meta
+    x, w, scale, shift = res
+    _, vjp = jax.vjp(
+        lambda *a: _reference_core(sh, sw, ph, pw, activation, *a),
+        x, w, scale, shift,
+    )
+    return vjp(g)
+
+
+_conv_block_vjp.defvjp(_conv_block_fwd, _conv_block_bwd)
+
+
+def _fold_epilogue(o, bias, bn_scale, bn_shift):
+    """Collapse bias + BN affine to one f32 (scale, shift) pair OUTSIDE
+    the kernel boundary: activation((conv+bias)*a + b) ==
+    activation(conv*a + (bias*a + b)). The fold is ordinary traced ops,
+    so grads flow to bias/gamma/beta automatically while the kernel
+    sees exactly two [O] vectors."""
+    scale = (bn_scale.astype(jnp.float32) if bn_scale is not None
+             else jnp.ones((o,), jnp.float32))
+    shift = (bn_shift.astype(jnp.float32) if bn_shift is not None
+             else jnp.zeros((o,), jnp.float32))
+    if bias is not None:
+        shift = shift + bias.astype(jnp.float32) * scale
+    return scale, shift
+
+
+def conv_block(x, w, bias=None, bn_scale=None, bn_shift=None, *,
+               stride=(1, 1), padding=(0, 0), activation="identity",
+               interpret: bool = False):
+    """Fused ``activation((conv2d(x, w) + bias) * bn_scale + bn_shift)``
+    via ONE Pallas kernel. x NCHW [n,c,h,w], w OIHW [o,c,kh,kw], bias/
+    bn_scale/bn_shift per-channel [o] (each optional). Differentiable
+    (backward recomputes through the XLA reference). ``interpret`` is
+    resolved HERE, before the custom-vjp boundary (nondiff argument:
+    forward and backward must agree on it) — off-TPU the kernel
+    self-arms interpreter mode even when ``DL4J_TPU_PALLAS=1`` forces
+    routing."""
+    from deeplearning4j_tpu.ops.dispatch import pallas_interpret
+
+    if activation not in _EPILOGUES:
+        raise ValueError(
+            f"conv_block: unsupported epilogue '{activation}' "
+            f"(supported: {SUPPORTED_EPILOGUES})"
+        )
+    scale, shift = _fold_epilogue(int(w.shape[0]), bias, bn_scale,
+                                  bn_shift)
+    meta = (int(stride[0]), int(stride[1]), int(padding[0]),
+            int(padding[1]), activation,
+            bool(interpret or pallas_interpret()))
+    return _conv_block_vjp(meta, x, w, scale, shift)
+
+
+def conv_block_reference(x, w, bias=None, bn_scale=None, bn_shift=None,
+                         *, stride=(1, 1), padding=(0, 0),
+                         activation="identity"):
+    """The XLA-fused reference path: identical semantics, no Pallas —
+    the A/B baseline for ``scripts/bench_kernels.py`` and the parity
+    tests, and the math the backward pass recomputes through."""
+    if activation not in _EPILOGUES:
+        raise ValueError(
+            f"conv_block: unsupported epilogue '{activation}' "
+            f"(supported: {SUPPORTED_EPILOGUES})"
+        )
+    scale, shift = _fold_epilogue(int(w.shape[0]), bias, bn_scale,
+                                  bn_shift)
+    return _reference_core(int(stride[0]), int(stride[1]),
+                           int(padding[0]), int(padding[1]),
+                           activation, x, w, scale, shift)

@@ -30,13 +30,39 @@ def cpu_device() -> Optional["jax.Device"]:
         return None
 
 
+# DL4J_TPU_PALLAS is read ONCE per process and cached: use_pallas()
+# sits on every conv/dense/LSTM forward trace, and an os.environ read
+# per call is both a needless syscall-shaped cost and a footgun (a
+# mid-process setenv silently flipping kernel paths between traces of
+# the same program). Tests flip the knob through reset_for_tests().
+_ENV_CACHE: Optional[str] = None
+
+
+def _pallas_env() -> str:
+    global _ENV_CACHE
+    if _ENV_CACHE is None:
+        _ENV_CACHE = os.environ.get(
+            "DL4J_TPU_PALLAS", "auto"
+        ).strip().lower()
+    return _ENV_CACHE
+
+
+def reset_for_tests() -> None:
+    """Drop the cached ``DL4J_TPU_PALLAS`` read so the NEXT
+    ``use_pallas()`` call re-reads the environment. The only supported
+    way to flip kernel dispatch mid-process (tests, bench A/Bs);
+    production processes read the knob once at first dispatch."""
+    global _ENV_CACHE
+    _ENV_CACHE = None
+
+
 def use_pallas() -> bool:
     """Env-gated Pallas dispatch (DL4J_TPU_PALLAS=1/0/auto): kernels
     engage only when the targeted platform is TPU. A forced ``1``
     off-TPU still routes through the kernels, but they self-arm
     interpreter mode (``pallas_interpret``) — same code path,
     correct-but-slow execution instead of a Mosaic lowering crash."""
-    env = os.environ.get("DL4J_TPU_PALLAS", "auto").lower()
+    env = _pallas_env()
     if env in ("1", "true", "on"):
         return True
     if env in ("0", "false", "off"):
@@ -50,3 +76,56 @@ def pallas_interpret() -> bool:
     so ``DL4J_TPU_PALLAS=1`` on a CPU host (the classic local-repro
     footgun) executes instead of failing to lower TPU memory spaces."""
     return effective_platform() != "tpu"
+
+
+# --- dispatch observability -----------------------------------------------
+#
+# Routing decisions happen at trace time (Python), once per compiled
+# program — cheap enough to meter every one. The counter answers "which
+# kernels actually engaged, and in which mode" without a TPU profiler;
+# the gauge flags the classic silent-slowness footgun (forced-on Pallas
+# interpreting on CPU).
+
+_METRICS_FOR = None  # (registry, counter family, gauge child)
+
+
+def _dispatch_metrics():
+    global _METRICS_FOR
+    from deeplearning4j_tpu.observability.metrics import default_registry
+
+    reg = default_registry()
+    if _METRICS_FOR is None or _METRICS_FOR[0] is not reg:
+        counter = reg.counter(
+            "pallas_dispatch_total",
+            help="kernel routing decisions at dispatch (trace) time, "
+                 "by kernel and mode (pallas/interpret/xla)",
+            labels=("kernel", "mode"),
+        )
+        gauge = reg.gauge(
+            "pallas_interpret_mode",
+            help="1 when Pallas kernels run in interpreter mode "
+                 "(off-TPU host) — correct but slow",
+        )._default()
+        _METRICS_FOR = (reg, counter, gauge)
+    return _METRICS_FOR[1], _METRICS_FOR[2]
+
+
+def note_dispatch(kernel: str, mode: str) -> None:
+    """Record one kernel routing decision:
+    ``pallas_dispatch_total{kernel, mode}`` (mode is ``pallas``,
+    ``interpret`` or ``xla``) and the ``pallas_interpret_mode``
+    gauge."""
+    counter, gauge = _dispatch_metrics()
+    counter.labels(kernel=kernel, mode=mode).inc()
+    gauge.set(1.0 if pallas_interpret() else 0.0)
+
+
+def route(kernel: str, eligible: bool = True) -> bool:
+    """One-stop gate + telemetry for a kernel call site: returns
+    whether ``kernel`` takes the Pallas path (``eligible`` carries the
+    caller's shape/activation/VMEM gates) and meters the decision."""
+    use = bool(eligible) and use_pallas()
+    mode = ("interpret" if pallas_interpret() else "pallas") if use \
+        else "xla"
+    note_dispatch(kernel, mode)
+    return use

@@ -1,0 +1,359 @@
+#!/usr/bin/env python
+"""Fused-kernel A/B: the Pallas conv/matmul epilogue kernels vs the
+plain XLA path (ROADMAP: the kernel half of the MFU campaign).
+
+Per config — a conv stack, a ResNet-50 bottleneck block, and an MLP —
+this measures three variants of the same math:
+
+``kernel``     ``ops.conv_block``/``ops.matmul_block`` (ONE Pallas
+               kernel per stage: MXU contraction + in-register
+               bias/BN-affine/activation epilogue, single HBM
+               writeback).
+``fused``      the XLA reference path: one jitted expression per
+               chain; XLA fuses the epilogue into the conv/matmul
+               consumer, so it is the fair same-compiler baseline.
+``unfused``    the historical op-at-a-time decomposition: conv,
+               +bias, BN affine, and activation each compiled as a
+               SEPARATE executable. Every executable boundary is a
+               real HBM materialization — these are exactly the
+               round-trips the fused epilogue deletes. (An in-jit
+               "unfused" build is not honest evidence: XLA elides
+               optimization barriers on some backends and re-fuses.)
+
+Round-trip evidence is compiled-op/executable counts, not wall clock:
+the unfused pipeline must carry more executables and more total
+entry-computation instructions than the fused build, and the bytes of
+its intermediate buffers (``epilogue_roundtrip_bytes``) quantify the
+HBM traffic the epilogue fusion eliminates per step.
+
+Gates:
+  * forward parity: max |kernel - fused| <= 1e-5 (f32; interpret mode
+    on CPU exercises the identical kernel code path).
+  * epilogue fusion: fused executables (1 per chain) < unfused stage
+    executables, fused entry ops < unfused total, round-trip bytes
+    positive — for every config.
+
+Timing (interleaved A/B windows, step time + achieved FLOP/s + MFU
+delta) runs only on a real TPU: in interpreter mode the kernel
+executes as a correctness shim, so CPU runs report correctness-only
+and set ``timing_skipped``. Prints ONE JSON line; runnable standalone
+or from ``bench.py``'s ``fused_kernels`` section (PR-5 SIGALRM budget
+box + PR-6 compile-stats sidecar ride along in the bench harness).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PARITY_TOL = 1e-5  # f32 forward gate vs the XLA reference
+
+
+def _entry_op_count(fn, *args) -> int:
+    """Instructions in the compiled module's ENTRY computation — the
+    backend-honest surviving-op count (post-fusion, post-DCE)."""
+    import jax
+
+    txt = jax.jit(fn).lower(*args).compile().as_text()
+    count, in_entry = 0, False
+    for line in txt.splitlines():
+        s = line.strip()
+        if s.startswith("ENTRY"):
+            in_entry = True
+            continue
+        if in_entry:
+            if s.startswith("}"):
+                break
+            if "=" in s:
+                count += 1
+    return count
+
+
+def _interleaved_times(fn_a, fn_b, args_a, args_b, inner=8, rounds=3):
+    """Alternating timed windows (A, B, A, B, ...) so drift hits both
+    sides equally; returns (best_a_seconds, best_b_seconds) per call."""
+    import jax
+
+    jax.block_until_ready(fn_a(*args_a))
+    jax.block_until_ready(fn_b(*args_b))
+    best_a = best_b = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            r = fn_a(*args_a)
+        jax.block_until_ready(r)
+        best_a = min(best_a, (time.perf_counter() - t0) / inner)
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            r = fn_b(*args_b)
+        jax.block_until_ready(r)
+        best_b = min(best_b, (time.perf_counter() - t0) / inner)
+    return best_a, best_b
+
+
+def _conv_config(name, x_shape, stage_specs, strides, pads, dtype):
+    """One conv-chain config. Every stage is conv + bias + BN affine +
+    relu; ``unfused`` dispatches the four sub-ops as separate
+    executables per stage (the op-at-a-time decomposition)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.ops import conv_block, conv_block_reference
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(*x_shape), dtype)
+    params = []
+    for (o, c, kh, kw) in stage_specs:
+        params.append((
+            jnp.asarray(rng.randn(o, c, kh, kw) * 0.1, dtype),
+            jnp.asarray(rng.randn(o) * 0.1, jnp.float32),
+            jnp.asarray(rng.rand(o) + 0.5, jnp.float32),
+            jnp.asarray(rng.randn(o) * 0.1, jnp.float32),
+        ))
+
+    def run_kernel(x, params):
+        for (w, b, a, bb), s, p in zip(params, strides, pads):
+            x = conv_block(x, w, b, a, bb, stride=s, padding=p,
+                           activation="relu")
+        return x
+
+    def run_fused(x, params):
+        for (w, b, a, bb), s, p in zip(params, strides, pads):
+            x = conv_block_reference(x, w, b, a, bb, stride=s,
+                                     padding=p, activation="relu")
+        return x
+
+    def _conv_only(x, w, s, p):
+        y = jax.lax.conv_general_dilated(
+            jnp.transpose(x, (0, 2, 3, 1)),
+            jnp.transpose(w, (2, 3, 1, 0)),
+            window_strides=s,
+            padding=((p[0], p[0]), (p[1], p[1])),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        return jnp.transpose(y, (0, 3, 1, 2))
+
+    # one executable per sub-op, chained at the Python level: each
+    # boundary materializes its output — the round-trips under test
+    stages = []
+    for (w, b, a, bb), s, p in zip(params, strides, pads):
+        stages.append(jax.jit(
+            lambda x, w=w, s=s, p=p: _conv_only(x, w, s, p)))
+        stages.append(jax.jit(
+            lambda y, b=b: y + b.reshape(1, -1, 1, 1)))
+        stages.append(jax.jit(
+            lambda y, a=a, bb=bb: y * a.reshape(1, -1, 1, 1)
+            + bb.reshape(1, -1, 1, 1)))
+        stages.append(jax.jit(
+            lambda y: jnp.maximum(y, 0.0).astype(dtype)))
+
+    def run_unfused(x, params):
+        del params  # stages close over their own
+        for f in stages:
+            x = f(x)
+        return x
+
+    # analytic MXU work: 2 * N * OH * OW * KH * KW * C * O per stage
+    flops = 0
+    h, w_ = x_shape[2], x_shape[3]
+    for (o, c, kh, kw), s, p in zip(stage_specs, strides, pads):
+        oh = (h + 2 * p[0] - kh) // s[0] + 1
+        ow = (w_ + 2 * p[1] - kw) // s[1] + 1
+        flops += 2 * x_shape[0] * oh * ow * kh * kw * c * o
+        h, w_ = oh, ow
+
+    return _measure(name, run_kernel, run_fused, run_unfused, stages,
+                    (x, params), flops)
+
+
+def _mlp_config(name, m, dims, dtype):
+    """Dense-chain config (activation(x @ w + b) per stage)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.ops import matmul_block, matmul_block_reference
+
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(m, dims[0]), dtype)
+    params = [
+        (jnp.asarray(rng.randn(k, n) * 0.05, dtype),
+         jnp.asarray(rng.randn(n) * 0.1, jnp.float32))
+        for k, n in zip(dims[:-1], dims[1:])
+    ]
+
+    def run_kernel(x, params):
+        for w, b in params:
+            x = matmul_block(x, w, b, activation="relu")
+        return x
+
+    def run_fused(x, params):
+        for w, b in params:
+            x = matmul_block_reference(x, w, b, activation="relu")
+        return x
+
+    stages = []
+    for w, b in params:
+        stages.append(jax.jit(
+            lambda x, w=w: jnp.dot(
+                x, w, preferred_element_type=jnp.float32)))
+        stages.append(jax.jit(lambda y, b=b: y + b))
+        stages.append(jax.jit(
+            lambda y: jnp.maximum(y, 0.0).astype(dtype)))
+
+    def run_unfused(x, params):
+        del params
+        for f in stages:
+            x = f(x)
+        return x
+
+    flops = sum(2 * m * k * n for k, n in zip(dims[:-1], dims[1:]))
+    return _measure(name, run_kernel, run_fused, run_unfused, stages,
+                    (x, params), flops)
+
+
+def _measure(name, run_kernel, run_fused, run_unfused, stages, args,
+             flops):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.dispatch import pallas_interpret
+    from deeplearning4j_tpu.util.flops import device_peak_flops
+
+    jk = jax.jit(run_kernel)
+    jf = jax.jit(run_fused)
+
+    yk = jax.block_until_ready(jk(*args))
+    yf = jax.block_until_ready(jf(*args))
+    err = float(jnp.max(jnp.abs(
+        yk.astype(jnp.float32) - yf.astype(jnp.float32)
+    )))
+
+    # round-trip accounting: replay the stage chain, lowering each
+    # executable with its real input and summing the intermediate
+    # buffers it materializes (everything but the final output)
+    ops_unfused = 0
+    roundtrip_bytes = 0
+    y = args[0]
+    for i, f in enumerate(stages):
+        ops_unfused += _entry_op_count(f, y)
+        y = f(y)
+        if i + 1 < len(stages):
+            roundtrip_bytes += int(y.size * y.dtype.itemsize)
+    ops_fused = _entry_op_count(run_fused, *args)
+
+    out = {
+        "mode": "interpret" if pallas_interpret() else "pallas",
+        "parity_max_err": err,
+        "parity_ok": bool(err <= PARITY_TOL),
+        "flops_per_step": flops,
+        "executables_fused": 1,
+        "executables_unfused": len(stages),
+        "entry_ops_fused": ops_fused,
+        "entry_ops_unfused": ops_unfused,
+        "epilogue_roundtrip_bytes": roundtrip_bytes,
+        # the evidence: op-at-a-time needs more executables AND more
+        # surviving instructions; the byte count is the HBM traffic
+        # the in-register epilogue deletes
+        "epilogue_fusion_verified": bool(
+            len(stages) > 1
+            and ops_fused < ops_unfused
+            and roundtrip_bytes > 0
+        ),
+    }
+    if pallas_interpret():
+        # interpreter mode is a correctness shim, not a kernel — wall
+        # clock would compare the interpreter loop to native XLA
+        out["timing_skipped"] = True
+        return name, out
+    ju = jax.jit(run_unfused)
+    t_kernel, t_fused = _interleaved_times(jk, jf, args, args)
+    _, t_unfused = _interleaved_times(jk, ju, args, args)
+    peak, peak_src = device_peak_flops()
+    out.update({
+        "timing_skipped": False,
+        "step_ms_kernel": round(t_kernel * 1e3, 4),
+        "step_ms_xla_fused": round(t_fused * 1e3, 4),
+        "step_ms_xla_unfused": round(t_unfused * 1e3, 4),
+        "flops_per_sec_kernel": flops / t_kernel,
+        "flops_per_sec_xla": flops / t_fused,
+        "speedup_vs_fused": round(t_fused / t_kernel, 3),
+        "speedup_vs_unfused": round(t_unfused / t_kernel, 3),
+    })
+    if peak:
+        mfu_k = flops / t_kernel / peak
+        mfu_f = flops / t_fused / peak
+        out.update({
+            "mfu_kernel": round(mfu_k, 4),
+            "mfu_xla": round(mfu_f, 4),
+            "mfu_delta": round(mfu_k - mfu_f, 4),
+            "peak_flops_source": peak_src,
+        })
+    return name, out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget-s", type=float, default=None,
+                    help="soft budget hint (sizes are fixed; the "
+                         "bench harness owns the hard SIGALRM box)")
+    ap.add_argument("--config", choices=["conv_stack", "resnet50_block",
+                                         "mlp"], default=None)
+    args = ap.parse_args()
+
+    configs = {}
+
+    def want(key):
+        return args.config is None or args.config == key
+
+    if want("conv_stack"):
+        k, v = _conv_config(
+            "conv_stack",
+            x_shape=(8, 8, 16, 16),
+            stage_specs=[(16, 8, 3, 3), (16, 16, 3, 3), (32, 16, 3, 3)],
+            strides=[(1, 1), (1, 1), (2, 2)],
+            pads=[(1, 1), (1, 1), (0, 0)],
+            dtype="float32",
+        )
+        configs[k] = v
+    if want("resnet50_block"):
+        # the conv14 bottleneck: 1x1 reduce, 3x3, 1x1 expand
+        k, v = _conv_config(
+            "resnet50_block",
+            x_shape=(4, 64, 14, 14),
+            stage_specs=[(16, 64, 1, 1), (16, 16, 3, 3),
+                         (64, 16, 1, 1)],
+            strides=[(1, 1), (1, 1), (1, 1)],
+            pads=[(0, 0), (1, 1), (0, 0)],
+            dtype="float32",
+        )
+        configs[k] = v
+    if want("mlp"):
+        k, v = _mlp_config("mlp", m=64, dims=(128, 256, 256, 128),
+                           dtype="float32")
+        configs[k] = v
+
+    doc = {
+        "configs": configs,
+        "kernel_parity_ok": all(c["parity_ok"]
+                                for c in configs.values()),
+        "epilogue_fusion_verified": all(
+            c["epilogue_fusion_verified"] for c in configs.values()
+        ),
+        "parity_tol": PARITY_TOL,
+    }
+    print(json.dumps(doc))
+    return 0 if doc["kernel_parity_ok"] and \
+        doc["epilogue_fusion_verified"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,6 +143,49 @@ def check_engine(name: str, path: Path, errors: list) -> None:
         )
 
 
+def check_pallas_locality(errors: list) -> None:
+    """All Pallas entry points live in ``deeplearning4j_tpu/ops/`` and
+    go through the dispatch gate. A layer (or any other) module calling
+    ``pl.pallas_call`` directly has grown a private kernel outside the
+    library: it bypasses ``dispatch.use_pallas()``/``pallas_interpret``
+    (the off-TPU interpreter arming), the dispatch metrics, and the
+    interleaved A/B in ``scripts/bench_kernels.py``."""
+    pkg = REPO / "deeplearning4j_tpu"
+    ops_dir = pkg / "ops"
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        calls_pallas = [
+            node.lineno for node in ast.walk(tree)
+            if isinstance(node, ast.Call)
+            and call_name(node) == "pallas_call"
+        ]
+        if not calls_pallas:
+            continue
+        if ops_dir not in path.parents:
+            errors.append(
+                f"{path.relative_to(REPO)}:{calls_pallas[0]}: calls "
+                "pallas_call() outside deeplearning4j_tpu/ops/ — "
+                "Pallas kernels live in the ops/ library behind "
+                "dispatch.use_pallas()"
+            )
+            continue
+        # an ops kernel module must reference the dispatch gate (its
+        # public wrappers resolve interpret/use_pallas before the call)
+        names = {
+            n.attr if isinstance(n, ast.Attribute) else
+            getattr(n, "id", "")
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.Attribute, ast.Name))
+        }
+        if not names & {"use_pallas", "pallas_interpret"}:
+            errors.append(
+                f"{path.relative_to(REPO)}: calls pallas_call() but "
+                "never consults dispatch.use_pallas()/"
+                "pallas_interpret() — forced-on CPU runs would crash "
+                "in Mosaic lowering instead of interpreting"
+            )
+
+
 def check_core(errors: list) -> None:
     tree = ast.parse(CORE.read_text(), filename=str(CORE))
     defined = {
@@ -161,6 +204,7 @@ def main() -> int:
     check_core(errors)
     for name, path in ENGINES.items():
         check_engine(name, path, errors)
+    check_pallas_locality(errors)
     if errors:
         print("engine/core parity violations:", file=sys.stderr)
         for e in errors:
@@ -168,7 +212,7 @@ def main() -> int:
         return 1
     print(
         "lint_parity: both engines delegate step/apply/fit hot paths "
-        "to nn/core.py"
+        "to nn/core.py; Pallas kernels stay in ops/ behind dispatch"
     )
     return 0
 

@@ -95,6 +95,15 @@ python scripts/lint_parity.py || exit 1
 #                                  between publishes mid-quarantine
 #                                  and resumes bitwise off the
 #                                  manifest's data ledger
+#   tests/test_conv_block.py     — Pallas fused-kernel library: seeded
+#                                  random conv geometries (channels/
+#                                  kernel/stride/padding/activation
+#                                  from DL4J_TPU_CHAOS_SEED) — every
+#                                  geometry the VMEM gate admits must
+#                                  match the XLA reference at kernel
+#                                  tolerance; plus the full dispatch/
+#                                  trajectory/AOT-refusal suite rides
+#                                  along (fast, CPU interpret mode)
 STORMS=(
     tests/test_resilience.py
     tests/test_serving.py
@@ -106,6 +115,7 @@ STORMS=(
     tests/test_preemption.py
     tests/test_elastic.py
     tests/test_data_defense.py
+    tests/test_conv_block.py
 )
 
 declare -a names rcs

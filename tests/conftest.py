@@ -53,6 +53,18 @@ def rng():
     return np.random.RandomState(12345)
 
 
+@pytest.fixture(autouse=True)
+def _reset_pallas_dispatch():
+    """``ops.dispatch`` caches DL4J_TPU_PALLAS once per process; any
+    test that monkeypatches the env must not leak a stale cache into
+    (or inherit one from) its neighbours, so re-read around each test."""
+    from deeplearning4j_tpu.ops import dispatch
+
+    dispatch.reset_for_tests()
+    yield
+    dispatch.reset_for_tests()
+
+
 def assert_params_match(net_a, net_b) -> None:
     """Param-tree equality across two engines/paths: bitwise on the
     CPU profile (identical programs -> identical bits), small-tolerance

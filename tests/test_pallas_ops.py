@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 
 from conftest import kernel_tols, pallas_interpret
+from deeplearning4j_tpu.ops import dispatch
 from deeplearning4j_tpu.ops.flash_attention import flash_attention
 from deeplearning4j_tpu.ops.lstm_cell import _reference_cell, lstm_cell
 from deeplearning4j_tpu.parallel.sequence import attention
@@ -94,6 +95,7 @@ class TestDispatch:
 
         def run(flag):
             monkeypatch.setenv("DL4J_TPU_PALLAS", flag)
+            dispatch.reset_for_tests()  # env is cached once per process
             conf = (
                 NeuralNetConfiguration.Builder().seed(3)
                 .learning_rate(0.1).updater("SGD").list()
@@ -321,8 +323,10 @@ class TestLstmSequenceKernel:
             np.random.RandomState(1).randn(4, 12, 9), jnp.float32
         )
         monkeypatch.setenv("DL4J_TPU_PALLAS", "0")
+        dispatch.reset_for_tests()
         y_ref, _ = layer.apply(params, x, {}, train=False)
         monkeypatch.setenv("DL4J_TPU_PALLAS", "1")
+        dispatch.reset_for_tests()
         orig = lc.lstm_sequence
 
         calls = {}
