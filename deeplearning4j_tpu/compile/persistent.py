@@ -137,10 +137,16 @@ def _trace_event(outcome: str, **attrs) -> None:
     # same xla.compile family the serving recompile guard uses; the
     # process-global tracer is disabled by default (one branch)
     from deeplearning4j_tpu.observability.trace import get_tracer
+    from deeplearning4j_tpu.observability import flightrec
 
     get_tracer().event(
         "xla.compile.cache", attrs={"outcome": outcome, **attrs}
     )
+    # compile events join the flight-recorder timeline too: a dump
+    # whose last steps bracket a compile_or_load explains its own
+    # step-time spike
+    flightrec.record_event("xla_compile_cache", outcome=outcome,
+                           **attrs)
 
 
 def _on_event(event: str, **kw) -> None:

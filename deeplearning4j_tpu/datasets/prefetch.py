@@ -52,6 +52,7 @@ from typing import Callable, Optional
 from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
 from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
 from deeplearning4j_tpu.exceptions import DL4JFaultException
+from deeplearning4j_tpu.observability import profiler
 
 # fine buckets at the bottom (a fed pipeline waits ~0) and coarse at
 # the top (a starved one waits a whole batch-materialization)
@@ -142,7 +143,13 @@ class PrefetchIterator(AsyncDataSetIterator):
     def _advance(self) -> None:
         t0 = time.perf_counter()
         super()._advance()
-        self._wait_hist.observe((time.perf_counter() - t0) * 1000.0)
+        wait_ms = (time.perf_counter() - t0) * 1000.0
+        self._wait_hist.observe(wait_ms)
+        prof = profiler.get_active_profiler()
+        if prof is not None:
+            # the step profiler folds this into the current step's
+            # input_stall_ms decomposition slot
+            prof.note_input_wait_ms(wait_ms)
         q = self._queue
         if q is not None:
             self._depth_gauge.set(q.qsize())

@@ -352,6 +352,11 @@ class QuarantineStore:
         counter = _quarantine_metrics()[0]
         for reason in (reasons or ("unknown",)):
             counter.labels(reason).inc()
+        from deeplearning4j_tpu.observability import flightrec
+        flightrec.record_event(
+            "quarantine", reasons=list(reasons or ("unknown",)),
+            offset=int(offset), bytes=int(entry["size"]),
+        )
         return entry
 
     def _evict(self) -> None:

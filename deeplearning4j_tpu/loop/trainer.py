@@ -194,32 +194,46 @@ class ContinualTrainer:
                 }
             self._resume_ledger = None
         consumed = 0
-        for ds in self._iter(stream):
-            # preemption notice -> emergency publish through THIS
-            # trainer's publish() (AOT artifacts attached, journal
-            # retention honored), then PreemptedException
-            preemption.check_fit(
-                self.model, checkpoint_fn=self.publish,
-                prefetch=stream
-                if hasattr(stream, "shutdown") else None,
-            )
-            fit(ds)
-            consumed += 1
-            self._m_steps.inc()
-            if vit is not None:
-                # snapshot AFTER the fit so a publish (scheduled or
-                # preemption-emergency) never claims a base batch the
-                # params don't yet reflect
-                self.model._data_ledger = vit.ledger()
-            if self.model.iteration_count % self.publish_every == 0:
+        from deeplearning4j_tpu.observability.trace import get_tracer
+
+        run_span = get_tracer().start_span(
+            "train.continual.run",
+            attrs={"start_step": int(self.model.iteration_count),
+                   "publish_every": int(self.publish_every)},
+        )
+        try:
+            for ds in self._iter(stream):
+                # preemption notice -> emergency publish through THIS
+                # trainer's publish() (AOT artifacts attached, journal
+                # retention honored), then PreemptedException
+                preemption.check_fit(
+                    self.model, checkpoint_fn=self.publish,
+                    prefetch=stream
+                    if hasattr(stream, "shutdown") else None,
+                )
+                fit(ds)
+                consumed += 1
+                self._m_steps.inc()
+                if vit is not None:
+                    # snapshot AFTER the fit so a publish (scheduled
+                    # or preemption-emergency) never claims a base
+                    # batch the params don't yet reflect
+                    self.model._data_ledger = vit.ledger()
+                if self.model.iteration_count % self.publish_every == 0:
+                    self.publish()
+                if max_steps is not None and consumed >= max_steps:
+                    break
+            if publish_trailing and consumed and (
+                self.last_published is None
+                or self.last_published.step < self.model.iteration_count
+            ):
                 self.publish()
-            if max_steps is not None and consumed >= max_steps:
-                break
-        if publish_trailing and consumed and (
-            self.last_published is None
-            or self.last_published.step < self.model.iteration_count
-        ):
-            self.publish()
+        except BaseException as e:
+            run_span.set_attr("steps", consumed)
+            run_span.end(status=type(e).__name__)
+            raise
+        run_span.set_attr("steps", consumed)
+        run_span.end()
         return consumed
 
     @staticmethod

@@ -1187,13 +1187,26 @@ def _wants_last_features(model) -> bool:
     return bool(fn()) if fn is not None else False
 
 
+def _chunk_rows(xs) -> int:
+    """Rows per sub-step of a stacked [k, b, ...] chunk payload."""
+    leaf = jax.tree_util.tree_leaves(xs)[0]
+    return int(leaf.shape[1]) if getattr(leaf, "ndim", 0) > 1 else 0
+
+
 def run_scan_chunk(model, stacked) -> None:
     """One fused k-step dispatch from pre-stacked device arrays
     ``(x, y, labels_mask, features_mask, k)`` — the same driver for
     both engines (the arrays are plain arrays for the sequential
     engine, lists for the DAG engine)."""
+    from deeplearning4j_tpu.observability import profiler as _prof_mod
+
     xs, ys, masks, fmasks, k = stacked
     it0 = model.iteration_count
+    prof = _prof_mod.get_active_profiler()
+    if prof is not None:
+        # one fused dispatch = one profiler "step" covering k
+        # optimizer steps (the record carries the final step index)
+        prof.begin_step(it0 + k)
     lr_stack, it0_dev = scan_consts(model, k, it0)
     if model._jit_multi_step is None:
         model._jit_multi_step = model._build_multi_step()
@@ -1208,11 +1221,19 @@ def run_scan_chunk(model, stacked) -> None:
     model.iteration_count += k
     model._last_score = scores[-1]
     if model.listeners:
+        lt0 = time.perf_counter()
         for i in range(k):
             model._last_score = scores[i]
             for listener in model.listeners:
                 listener.iteration_done(model, it0 + i + 1)
         model._last_score = scores[-1]
+        if prof is not None:
+            prof.note_listener_ms((time.perf_counter() - lt0) * 1e3)
+    if prof is not None:
+        # no per-chunk cost model: the fused multi-step program has
+        # its own HLO — decomposition + record only
+        prof.end_step(score=model._last_score,
+                      rows=k * _chunk_rows(xs))
 
 
 def flush_scan_chunk(model, batches: List[Any]) -> None:
@@ -1391,8 +1412,23 @@ def fit_batches(model, iterator, epochs: int) -> None:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(model)
             model.epoch_count += 1
-    except BaseException:
+    except BaseException as e:
         window.abandon()  # keep the original exception
+        from deeplearning4j_tpu.observability import flightrec
+        from deeplearning4j_tpu.observability import (
+            profiler as _prof_mod,
+        )
+        from deeplearning4j_tpu.resilience.preemption import (
+            PreemptedException,
+        )
+
+        prof = _prof_mod.get_active_profiler()
+        if prof is not None:
+            prof.abandon_step()
+        if not isinstance(e, PreemptedException):
+            # preemption already attached the ring to the emergency
+            # checkpoint manifest; everything else dumps to disk here
+            flightrec.dump_on_crash("fit_exception")
         raise
 
 

@@ -20,6 +20,7 @@ engine, which previously only the sequential engine wired in.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -27,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.observability import profiler
 from deeplearning4j_tpu.nn.conf.graph_conf import (
     ComputationGraphConfiguration,
     DuplicateToTimeSeriesVertex,
@@ -706,6 +708,9 @@ class ComputationGraph:
         core.check_grad_accum_batch(
             self.grad_accum, int(inputs[0].shape[0])
         )
+        prof = profiler.get_active_profiler()
+        if prof is not None:
+            prof.begin_step(self.iteration_count + 1)
         score = None
         for _ in range(self.conf.iterations):
             if self._jit_step is None:
@@ -732,9 +737,19 @@ class ComputationGraph:
                     guard.good_step()
                 else:
                     guard.bad_step(self)
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration_count)
+            if self.listeners:
+                lt0 = time.perf_counter()
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration_count)
+                if prof is not None:
+                    prof.note_listener_ms(
+                        (time.perf_counter() - lt0) * 1e3)
             self._reset_recurrent_state()
+        if prof is not None:
+            prof.end_step(model=self, ds=ds, score=self._last_score,
+                          grad_norm=getattr(self, "_last_grad_norm",
+                                            None),
+                          rows=self._last_batch_rows)
         return score  # 0-d device array; float() to sync
 
     def _fit_tbptt(self, inputs, labels, lmasks, fmasks) -> float:

@@ -27,6 +27,7 @@ are all implemented there ONCE and shared with ``ComputationGraph``
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -34,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.observability import profiler
 from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.preprocessors import ShapeContext
 from deeplearning4j_tpu.nn.updaters import MultiLayerUpdaterDef
@@ -747,6 +749,9 @@ class MultiLayerNetwork:
             self._last_features = ds.features  # activation listeners
         self._last_batch_rows = int(x.shape[0])  # examples/sec signal
         core.check_grad_accum_batch(self.grad_accum, int(x.shape[0]))
+        prof = profiler.get_active_profiler()
+        if prof is not None:
+            prof.begin_step(self.iteration_count + 1)
         score = None
         for _ in range(self.conf.iterations):
             if self._jit_step is None:
@@ -778,12 +783,22 @@ class MultiLayerNetwork:
                     guard.good_step()
                 else:
                     guard.bad_step(self)
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration_count)
+            if self.listeners:
+                lt0 = time.perf_counter()
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration_count)
+                if prof is not None:
+                    prof.note_listener_ms(
+                        (time.perf_counter() - lt0) * 1e3)
             # Reset per optimizer iteration: each pass over the same
             # minibatch starts from zero recurrent carry (also keeps
             # the step's state pytree structure stable -> no recompile)
             self._reset_recurrent_state()
+        if prof is not None:
+            prof.end_step(model=self, ds=ds, score=self._last_score,
+                          grad_norm=getattr(self, "_last_grad_norm",
+                                            None),
+                          rows=self._last_batch_rows)
         return score  # 0-d device array; float() to sync
 
     def _wants_last_features(self) -> bool:

@@ -18,7 +18,13 @@ compile accounting all publish here):
   JSON snapshots;
 - ``runtime.py`` — JAX device memory gauges and the
   ``TelemetryListener`` publishing step time / loss / grad
-  global-norm / examples-per-sec from both engines' fit loops.
+  global-norm / examples-per-sec from both engines' fit loops;
+- ``profiler.py`` — hardware-truth step profiling: per-executable
+  ``CostModel`` from XLA cost analysis, MFU/roofline gauges, and the
+  ``{input_stall, host, dispatch, device}`` wall-time decomposition;
+- ``flightrec.py`` — the crash-dumping flight recorder: a bounded
+  lock-free ring of step records + subsystem events with atomic
+  JSONL dumps (guard trips, fit exceptions, preemption manifests).
 """
 
 from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
@@ -46,4 +52,16 @@ from deeplearning4j_tpu.observability.runtime import (  # noqa: F401
     TelemetryListener,
     device_memory_stats,
     publish_device_memory,
+)
+from deeplearning4j_tpu.observability.profiler import (  # noqa: F401
+    CostModel,
+    CostModelCache,
+    StepProfiler,
+    get_active_profiler,
+    set_active_profiler,
+)
+from deeplearning4j_tpu.observability.flightrec import (  # noqa: F401
+    FlightRecorder,
+    get_flight_recorder,
+    set_flight_recorder,
 )

@@ -265,6 +265,15 @@ class TelemetryListener(IterationListener):
                     self._ls_overflows.inc(
                         seen - self._ls_overflows_seen
                     )
+                    from deeplearning4j_tpu.observability import (
+                        flightrec,
+                    )
+                    flightrec.record_event(
+                        "loss_scale_overflow",
+                        overflows=seen,
+                        new=seen - self._ls_overflows_seen,
+                        scale=float(ls["scale"]),
+                    )
                 self._ls_overflows_seen = seen
             except Exception:
                 pass

@@ -45,6 +45,8 @@ from typing import Callable, Optional
 
 import time
 
+from deeplearning4j_tpu.observability import profiler
+
 GAP_MS_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                   1000.0)
 
@@ -119,8 +121,17 @@ class AsyncDispatchWindow:
                 self._consult(self._flags.popleft(), guard)
         if score is not None:
             self._inflight.append(score)
-            while len(self._inflight) > self.max_in_flight:
-                self._retire(self._inflight.popleft())
+            if len(self._inflight) > self.max_in_flight:
+                # blocked here = device back-pressure: the window is
+                # full and the host must wait for the oldest step —
+                # the step profiler's dispatch_ms decomposition slot
+                t0 = time.perf_counter()
+                while len(self._inflight) > self.max_in_flight:
+                    self._retire(self._inflight.popleft())
+                prof = profiler.get_active_profiler()
+                if prof is not None:
+                    prof.note_dispatch_ms(
+                        (time.perf_counter() - t0) * 1e3)
 
     # -- internals ------------------------------------------------------
 
@@ -155,8 +166,16 @@ class AsyncDispatchWindow:
             flag = self._flags.popleft()
             if guard is not None:
                 self._consult(flag, guard)
+        t0 = time.perf_counter()
+        had = bool(self._inflight)
         while self._inflight:
             self._retire(self._inflight.popleft())
+        if had:
+            prof = profiler.get_active_profiler()
+            if prof is not None:
+                # drain happens at epoch/fit boundaries: the wait is
+                # device completion time, attributed to the last step
+                prof.note_device_ms((time.perf_counter() - t0) * 1e3)
 
     def abandon(self) -> None:
         """Drop outstanding work without consulting the guard — the

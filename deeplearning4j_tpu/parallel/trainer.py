@@ -18,6 +18,7 @@ Two modes, matching the reference's semantics split:
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -26,6 +27,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.observability import profiler
+from deeplearning4j_tpu.observability.trace import get_tracer
 from deeplearning4j_tpu.parallel.mesh import build_mesh
 
 
@@ -200,6 +203,7 @@ class DistributedTrainer:
         # rollback policy forces 0 — see parallel/dispatch.py)
         self.max_in_flight = max(int(max_in_flight), 1)
         self.guard_lag = guard_lag
+        self._epoch_span = None  # live train.epoch span during fit
         self._is_graph = hasattr(model.conf, "vertices")
         if model.params is None:
             model.init()
@@ -888,8 +892,20 @@ class DistributedTrainer:
             guard_lag=self.guard_lag,
         )
         epoch_scores = []
+        tracer = get_tracer()
+        fit_span = tracer.start_span(
+            "train.fit",
+            attrs={"epochs": int(epochs),
+                   "engine": type(m).__name__,
+                   "max_in_flight": int(self.max_in_flight)},
+        )
         try:
-            for _ in range(epochs):
+            for epoch_i in range(epochs):
+                epoch_span = tracer.start_span(
+                    "train.epoch", parent=fit_span.context,
+                    attrs={"epoch": int(m.epoch_count)},
+                )
+                self._epoch_span = epoch_span
                 for listener in m.listeners:
                     if hasattr(listener, "on_epoch_start"):
                         listener.on_epoch_start(m)
@@ -919,16 +935,31 @@ class DistributedTrainer:
                     if hasattr(listener, "on_epoch_end"):
                         listener.on_epoch_end(m)
                 m.epoch_count += 1
-        except BaseException:
+                epoch_span.set_attr("score", epoch_scores[-1])
+                epoch_span.end()
+                self._epoch_span = None
+        except BaseException as e:
             window.abandon()  # keep the original exception
+            span, self._epoch_span = self._epoch_span, None
+            if span is not None:
+                span.end(status=type(e).__name__)
+            fit_span.end(status=type(e).__name__)
             raise
         finally:
             if owned_prefetch is not None:
                 owned_prefetch.shutdown()
+        fit_span.end()
         return epoch_scores
 
     def fit_minibatch(self, ds, _window=None) -> float:
         m = self.model
+        prof = profiler.get_active_profiler()
+        if prof is not None:
+            span = self._epoch_span
+            prof.begin_step(
+                m.iteration_count + 1,
+                parent=span.context if span is not None else None,
+            )
         placed = self.place_minibatch(ds)
         x, y = placed.features, placed.labels
         mask, fmask = placed.labels_mask, placed.features_mask
@@ -975,10 +1006,22 @@ class DistributedTrainer:
                 # in-jit select already suppressed the update; the
                 # guard now applies skip/rollback policy host-side
                 guard.bad_step(m, on_restore=self._place_params)
-        for listener in m.listeners:
-            listener.iteration_done(m, m.iteration_count)
+        if m.listeners:
+            lt0 = time.perf_counter()
+            for listener in m.listeners:
+                listener.iteration_done(m, m.iteration_count)
+            if prof is not None:
+                prof.note_listener_ms(
+                    (time.perf_counter() - lt0) * 1e3
+                )
         if hasattr(m, "_reset_recurrent_state"):
             m._reset_recurrent_state()
+        if prof is not None:
+            prof.end_step(
+                model=m, ds=ds, score=score,
+                grad_norm=getattr(m, "_last_grad_norm", None),
+                rows=placed.num_rows,
+            )
         return score  # 0-d device array; float() to sync
 
     def set_divergence_guard(self, guard) -> None:

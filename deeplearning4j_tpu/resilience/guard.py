@@ -335,6 +335,15 @@ class DivergenceGuard:
         if self.stats is not None:
             self.publish_stats(model)
         self.consecutive_bad += 1
+        from deeplearning4j_tpu.observability import flightrec
+
+        flightrec.record_event(
+            "guard_trip", step=int(step_index), policy=self.policy,
+            consecutive=self.consecutive_bad,
+        )
+        # a guard trip is exactly the moment the last-N-steps context
+        # matters: dump the ring (best-effort; never masks the abort)
+        flightrec.dump_on_crash("guard_trip")
         if self.consecutive_bad > self.max_consecutive:
             raise DL4JFaultException(
                 f"divergence guard: {self.consecutive_bad} consecutive "

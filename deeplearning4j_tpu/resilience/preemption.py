@@ -324,6 +324,12 @@ class PreemptionHandler:
         info = None
         failed = False
         mgr = manager if manager is not None else self.manager
+        from deeplearning4j_tpu.observability import flightrec
+
+        rec = flightrec.get_flight_recorder()
+        if rec is not None and rec.enabled:
+            rec.event("preemption_notice",
+                      reason=self._reason or "notice", step=step)
         try:
             if checkpoint_fn is not None:
                 info = checkpoint_fn()
@@ -331,6 +337,22 @@ class PreemptionHandler:
                 arts = artifacts
                 if arts is None and self.artifact_fn is not None:
                     arts = self.artifact_fn(model)
+                if rec is not None and rec.enabled:
+                    # the ring rides the emergency manifest as a
+                    # CRC-verified artifact: the postmortem (last-N
+                    # steps, timings, MFU, events) travels WITH the
+                    # checkpoint the resume will load. The dump runs
+                    # after the drain above, so its last step record
+                    # is the step the checkpoint (and resume) is at.
+                    try:
+                        arts = dict(arts) if arts else {}
+                        arts.setdefault(
+                            "flightrec.jsonl",
+                            rec.dump_bytes(reason="preemption"),
+                        )
+                    except Exception:  # never cost us the checkpoint
+                        logger.exception(
+                            "flight-recorder artifact dump failed")
                 info = mgr.save(model, artifacts=arts)
         except Exception:
             failed = True

@@ -69,6 +69,7 @@ from deeplearning4j_tpu.observability.export import (
     parse_format_query,
     prometheus_text,
 )
+from deeplearning4j_tpu.observability import flightrec, profiler
 from deeplearning4j_tpu.observability.trace import Tracer
 from deeplearning4j_tpu.resilience.breaker import OPEN, CircuitBreaker
 from deeplearning4j_tpu.resilience.deadline import Deadline
@@ -288,6 +289,36 @@ class ModelServer:
             self.batch_workers = workers
             occupancy = None
         self.metrics = ServingMetrics(reservoir_size, occupancy)
+        # hardware-truth accounting per serving bucket: cost models
+        # built off the request path at warmup ((model, bucket) ->
+        # CostModel or None), published as bucket-labeled gauges on
+        # the per-server registry per dispatch
+        self._bucket_costs: dict = {}
+        self._peak_flops = profiler.peak_flops()[0]
+        self._peak_bw = profiler.peak_bytes_per_sec()[0]
+        try:
+            reg = self.metrics.registry
+            self._g_bucket_mfu = reg.gauge(
+                "step_mfu", labels=("bucket",),
+                help="per-bucket MFU of the last batched forward",
+            )
+            self._g_bucket_fps = reg.gauge(
+                "step_flops_per_sec", labels=("bucket",),
+                help="per-bucket achieved FLOP/s (XLA cost model / "
+                     "forward wall)",
+            )
+            self._g_bucket_bps = reg.gauge(
+                "step_bytes_per_sec", labels=("bucket",),
+                help="per-bucket achieved memory bytes/s",
+            )
+            self._g_bucket_roofline = reg.gauge(
+                "step_roofline_class", labels=("bucket",),
+                help="per-bucket roofline class (0 unknown / 1 "
+                     "compute / 2 memory bound)",
+            )
+        except Exception:  # registry already holds the unlabeled kind
+            self._g_bucket_mfu = self._g_bucket_fps = None
+            self._g_bucket_bps = self._g_bucket_roofline = None
         # disabled by default: every span operation is a no-op costing
         # one branch; pass a Tracer(sink=JsonlSink(...)) to record
         self.tracer = tracer if tracer is not None else Tracer(
@@ -493,7 +524,8 @@ class ModelServer:
             try:
                 self._warm_model(entry.current.model,
                                  entry.current.shapes,
-                                 self._ladder_for(entry))
+                                 self._ladder_for(entry),
+                                 name=entry.name)
             except Exception:
                 logger.exception(
                     "bucket warmup failed for model %r; serving "
@@ -816,6 +848,7 @@ class ModelServer:
         for sp in pspans:
             sp.end()
         self.breaker.record_success()
+        self._publish_bucket_cost(entry.name, bucket, fwd_ms)
         self.metrics.record_batch(n_valid, bucket, entry.name)
         self.metrics.incr("batched_predictions_total", len(chunk))
         self.metrics.incr("predictions_total", len(chunk))
@@ -841,6 +874,31 @@ class ModelServer:
             self.metrics.incr("abandoned_total", abandoned)
         self._offer_shadow(entry, stacked, out[:n_valid], fwd_ms)
 
+    def _publish_bucket_cost(self, name: str, bucket: int,
+                             fwd_ms: float) -> None:
+        """Publish bucket-labeled MFU/throughput gauges from the
+        warmup-built cost model; a dict lookup + a division on the
+        dispatch path, nothing when no cost model exists."""
+        cm = self._bucket_costs.get((name, bucket))
+        if cm is None or self._g_bucket_mfu is None:
+            return
+        try:
+            got = cm.achieved(fwd_ms / 1e3, self._peak_flops)
+            label = str(bucket)
+            self._g_bucket_fps.labels(label).set(
+                got["flops_per_sec"]
+            )
+            self._g_bucket_bps.labels(label).set(
+                got["bytes_per_sec"]
+            )
+            if got["mfu"] is not None:
+                self._g_bucket_mfu.labels(label).set(got["mfu"])
+            self._g_bucket_roofline.labels(label).set(
+                cm.roofline_class(self._peak_flops, self._peak_bw)
+            )
+        except Exception:  # accounting must never fail a predict
+            logger.debug("bucket cost publish failed", exc_info=True)
+
     def _padded_forward(self, model, padded, n_valid: int):
         """Run the model on a bucket-padded batch and return the valid
         rows. Engines expose ``output_padded`` (same jitted program as
@@ -857,7 +915,8 @@ class ModelServer:
         out = out[0] if isinstance(out, (list, tuple)) else out
         return np.asarray(out)[:n_valid]
 
-    def _warm_model(self, model, shapes, ladder=None) -> int:
+    def _warm_model(self, model, shapes, ladder=None,
+                    name=None) -> int:
         """Eagerly run every ladder bucket through the padded forward
         so all steady-state executables exist BEFORE the model takes
         traffic. Returns the number of warmup forwards (0 when
@@ -884,6 +943,17 @@ class ModelServer:
             self.compile_cache.note(shapes, padded.shape)
             self._padded_forward(model, padded, padded.shape[0])
             self.metrics.incr("warmup_predicts_total")
+            # hardware-truth bucket accounting: the cost model is
+            # built HERE, off the request path; per-dispatch MFU is
+            # then a dict lookup + division
+            try:
+                self._bucket_costs[(name, b)] = (
+                    profiler.output_cost_model(
+                        model, padded.shape, str(padded.dtype)
+                    )
+                )
+            except Exception:
+                self._bucket_costs[(name, b)] = None
             n += 1
         shapes.mark_warmed()
         return n
@@ -1146,7 +1216,8 @@ class ModelServer:
                 # swap: the new version has compiled all its shapes
                 # before it sees its first request
                 self._warm_model(model, shapes,
-                                 self._ladder_for(entry))
+                                 self._ladder_for(entry),
+                                 name=entry.name)
             except _NoReloadSource as e:
                 return 400, error_envelope("no_reload_source", 400,
                                            str(e))
@@ -1434,6 +1505,66 @@ class ModelServer:
         }
         return out
 
+    def debug_snapshot(self) -> dict:
+        """``GET /debugz``: one read-only, bounded JSON page with
+        everything a first responder wants before attaching a
+        debugger — versions, config, per-model state, the
+        hardware-truth cost models, and the flight-recorder tail
+        (capped at ``flightrec.DEBUG_TAIL_LIMIT`` records)."""
+        import jax
+        import jaxlib
+
+        from deeplearning4j_tpu import __version__ as pkg_version
+
+        out: dict = {
+            "versions": {
+                "deeplearning4j_tpu": pkg_version,
+                "jax": jax.__version__,
+                "jaxlib": jaxlib.__version__,
+            },
+            "backend": jax.default_backend(),
+            "config": {
+                "host": self._httpd.server_address[0],
+                "port": self.port,
+                "workers": self.workers,
+                "queue_depth": self.queue_depth,
+                "aot_enabled": self.aot,
+                "compile_cache_dir": self.compile_cache_dir,
+                "batching": self.batcher is not None,
+            },
+            "models": self.models_snapshot(),
+            "metrics": self.metrics_snapshot(),
+            "roofline": {
+                "peak_flops": self._peak_flops,
+                "peak_bytes_per_sec": self._peak_bw,
+                "bucket_cost_models": {
+                    f"{name}:{bucket}": {
+                        "key": cm.key,
+                        "flops": cm.flops,
+                        "bytes_accessed": cm.bytes_accessed,
+                        "arithmetic_intensity": round(
+                            cm.arithmetic_intensity, 3),
+                    }
+                    for (name, bucket), cm
+                    in sorted(self._bucket_costs.items())
+                    if cm is not None
+                },
+            },
+        }
+        prof = profiler.get_active_profiler()
+        if prof is not None:
+            out["profiler"] = prof.snapshot()
+        rec = flightrec.get_flight_recorder()
+        if rec is not None:
+            out["flight_recorder"] = {
+                "capacity": rec.capacity,
+                "last_step": rec.last_step(),
+                "tail": flightrec._jsonable(
+                    rec.tail(flightrec.DEBUG_TAIL_LIMIT)
+                ),
+            }
+        return out
+
     # -- request validation ---------------------------------------------
 
     def parse_predict(self, data: bytes):
@@ -1547,6 +1678,21 @@ def _make_handler(server: ModelServer):
                 return
             if route == "/models":
                 self._json(server.models_snapshot())
+                return
+            if route == "/debugz":
+                try:
+                    self._json(server.debug_snapshot())
+                except Exception as e:
+                    eid = error_id_for(e)
+                    logger.error(
+                        "debugz failed (error_id=%s)", eid,
+                        exc_info=True,
+                    )
+                    self._json(error_envelope(
+                        "debug_error", 500,
+                        "debug snapshot failed; see server log",
+                        error_id=eid,
+                    ), 500)
                 return
             self._json(error_envelope("not_found", 404, "not found"),
                        404)

@@ -13,6 +13,7 @@ sections fed by the data StatsListener already records."""
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import urllib.request
@@ -37,6 +38,8 @@ from deeplearning4j_tpu.ui.model import (
     decode_record,
     StatsInitializationReport,
 )
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_PORT = 9000
 PORT_ENV_VAR = "DL4J_UI_PORT"  # analog of org.deeplearning4j.ui.port
@@ -394,6 +397,16 @@ def _make_handler(server: "UIServer"):
             if url.path == "/train/activations":
                 self._json(server.activations())
                 return
+            if url.path == "/debugz":
+                try:
+                    self._json(server.debug_snapshot())
+                except Exception:
+                    logger.exception("debugz failed")
+                    self._json(error_envelope(
+                        "debug_error", 500,
+                        "debug snapshot failed; see server log",
+                    ), 500)
+                return
             if url.path == "/metrics":
                 # training-side registry (TelemetryListener /
                 # StatsListener publish here): JSON by default,
@@ -532,6 +545,48 @@ class UIServer:
         with UIServer._lock:
             if UIServer._instance is self:
                 UIServer._instance = None
+
+    def debug_snapshot(self) -> dict:
+        """``GET /debugz``: read-only, bounded first-responder page —
+        versions, attached sessions, the training-side registry, the
+        active profiler state, and the flight-recorder tail (capped at
+        ``flightrec.DEBUG_TAIL_LIMIT``)."""
+        import jax
+        import jaxlib
+
+        from deeplearning4j_tpu import __version__ as pkg_version
+        from deeplearning4j_tpu.observability import (
+            flightrec,
+            profiler,
+        )
+
+        out: dict = {
+            "versions": {
+                "deeplearning4j_tpu": pkg_version,
+                "jax": jax.__version__,
+                "jaxlib": jaxlib.__version__,
+            },
+            "backend": jax.default_backend(),
+            "config": {
+                "port": self.port,
+                "remote_enabled": self.remote_enabled,
+            },
+            "sessions": self.session_ids(),
+            "metrics": registry_snapshot(self.registry),
+        }
+        prof = profiler.get_active_profiler()
+        if prof is not None:
+            out["profiler"] = prof.snapshot()
+        rec = flightrec.get_flight_recorder()
+        if rec is not None:
+            out["flight_recorder"] = {
+                "capacity": rec.capacity,
+                "last_step": rec.last_step(),
+                "tail": flightrec._jsonable(
+                    rec.tail(flightrec.DEBUG_TAIL_LIMIT)
+                ),
+            }
+        return out
 
     # -- data for the page ----------------------------------------------
 

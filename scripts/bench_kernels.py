@@ -225,8 +225,8 @@ def _measure(name, run_kernel, run_fused, run_unfused, stages, args,
     import jax
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu.observability import profiler
     from deeplearning4j_tpu.ops.dispatch import pallas_interpret
-    from deeplearning4j_tpu.util.flops import device_peak_flops
 
     jk = jax.jit(run_kernel)
     jf = jax.jit(run_fused)
@@ -250,11 +250,24 @@ def _measure(name, run_kernel, run_fused, run_unfused, stages, args,
             roundtrip_bytes += int(y.size * y.dtype.itemsize)
     ops_fused = _entry_op_count(run_fused, *args)
 
+    # MFU accounting goes through the profiler's CostModel (XLA's own
+    # scheduled cost for the fused reference executable), not the
+    # analytic count — which stays as a sanity cross-check only
+    cm = profiler.CostModel.from_jitted(jf, *args, key=name)
+    peak, peak_src = profiler.peak_flops()
+    peak_bw, _ = profiler.peak_bytes_per_sec()
+
     out = {
         "mode": "interpret" if pallas_interpret() else "pallas",
         "parity_max_err": err,
         "parity_ok": bool(err <= PARITY_TOL),
-        "flops_per_step": flops,
+        "cost_model": {
+            "flops": cm.flops,
+            "bytes_accessed": cm.bytes_accessed,
+            "arithmetic_intensity": round(cm.arithmetic_intensity, 3),
+            "roofline_class": cm.roofline_class(peak, peak_bw),
+        },
+        "flops_per_step_analytic": flops,
         "executables_fused": 1,
         "executables_unfused": len(stages),
         "entry_ops_fused": ops_fused,
@@ -277,24 +290,26 @@ def _measure(name, run_kernel, run_fused, run_unfused, stages, args,
     ju = jax.jit(run_unfused)
     t_kernel, t_fused = _interleaved_times(jk, jf, args, args)
     _, t_unfused = _interleaved_times(jk, ju, args, args)
-    peak, peak_src = device_peak_flops()
+    # achieved rates + MFU from the CostModel (both variants compute
+    # the same math, so the fused executable's cost is the work done)
+    ach_k = cm.achieved(t_kernel, peak)
+    ach_f = cm.achieved(t_fused, peak)
     out.update({
         "timing_skipped": False,
         "step_ms_kernel": round(t_kernel * 1e3, 4),
         "step_ms_xla_fused": round(t_fused * 1e3, 4),
         "step_ms_xla_unfused": round(t_unfused * 1e3, 4),
-        "flops_per_sec_kernel": flops / t_kernel,
-        "flops_per_sec_xla": flops / t_fused,
+        "flops_per_sec_kernel": ach_k["flops_per_sec"],
+        "flops_per_sec_xla": ach_f["flops_per_sec"],
+        "bytes_per_sec_kernel": ach_k["bytes_per_sec"],
         "speedup_vs_fused": round(t_fused / t_kernel, 3),
         "speedup_vs_unfused": round(t_unfused / t_kernel, 3),
     })
-    if peak:
-        mfu_k = flops / t_kernel / peak
-        mfu_f = flops / t_fused / peak
+    if ach_k["mfu"] is not None:
         out.update({
-            "mfu_kernel": round(mfu_k, 4),
-            "mfu_xla": round(mfu_f, 4),
-            "mfu_delta": round(mfu_k - mfu_f, 4),
+            "mfu_kernel": round(ach_k["mfu"], 4),
+            "mfu_xla": round(ach_f["mfu"], 4),
+            "mfu_delta": round(ach_k["mfu"] - ach_f["mfu"], 4),
             "peak_flops_source": peak_src,
         })
     return name, out

@@ -1,0 +1,117 @@
+"""Perf regression gate: compare the newest two BENCH_*.json rounds.
+
+Each bench round writes ``BENCH_rNN.json`` with a ``parsed.configs``
+map of section -> {value, unit, mfu, ...}. This gate diffs the two
+newest rounds section-by-section and fails (exit 1) only when a
+section's headline ``value`` (a throughput: bigger is better) fell by
+more than the tolerance band — generous by default because CPU CI
+timings are noisy and a bench round may be budget-truncated.
+
+Tolerant by design: fewer than two rounds, unparsed rounds (rc != 0 /
+timeout), sections missing from either side, or error-marked sections
+all pass with a note — the gate only ever fails on evidence, never on
+absence of it.
+
+Knobs: ``DL4J_TPU_PERF_GATE_TOL`` (fractional drop allowed, default
+0.30), ``DL4J_TPU_PERF_GATE_DIR`` (where the BENCH files live,
+default repo root).
+
+Usage: python scripts/perf_gate.py [dir]
+"""
+import glob
+import json
+import os
+import re
+import sys
+
+DEFAULT_TOL = 0.30
+
+
+def find_rounds(d):
+    """BENCH_*.json sorted by round number, oldest first."""
+    out = []
+    for p in glob.glob(os.path.join(d, "BENCH_*.json")):
+        m = re.search(r"BENCH_r?(\d+)\.json$", os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return [p for _, p in sorted(out)]
+
+
+def load_configs(path):
+    """section -> numeric headline value, or None when the round has
+    no usable parse (timeout, truncated run)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: unreadable {path}: {e}")
+        return None
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict):
+        return None
+    configs = parsed.get("configs")
+    if not isinstance(configs, dict):
+        return None
+    vals = {}
+    for name, sec in configs.items():
+        if not isinstance(sec, dict) or "error" in sec:
+            continue
+        v = sec.get("value")
+        if isinstance(v, (int, float)) and v > 0:
+            vals[name] = float(v)
+    return vals or None
+
+
+def main(argv):
+    d = (argv[0] if argv
+         else os.environ.get("DL4J_TPU_PERF_GATE_DIR") or ".")
+    tol = float(os.environ.get("DL4J_TPU_PERF_GATE_TOL",
+                               DEFAULT_TOL))
+    rounds = find_rounds(d)
+    if len(rounds) < 2:
+        print(f"perf_gate: {len(rounds)} bench round(s) in {d!r}; "
+              "need 2 to compare — pass")
+        return 0
+    new_path, old_path = rounds[-1], rounds[-2]
+    new = load_configs(new_path)
+    # walk back past unusable rounds so one truncated run doesn't
+    # blind the gate forever
+    old = None
+    for p in reversed(rounds[:-1]):
+        old = load_configs(p)
+        if old is not None:
+            old_path = p
+            break
+    if new is None or old is None:
+        which = new_path if new is None else old_path
+        print(f"perf_gate: no usable parse in {which}; pass "
+              "(nothing to compare)")
+        return 0
+    print(f"perf_gate: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)} (tolerance -{tol:.0%})")
+    regressions = []
+    for name in sorted(set(new) & set(old)):
+        ratio = new[name] / old[name]
+        flag = ""
+        if ratio < 1.0 - tol:
+            flag = "  REGRESSION"
+            regressions.append((name, old[name], new[name], ratio))
+        print(f"  {name:24s} {old[name]:14.1f} -> {new[name]:14.1f} "
+              f"({ratio:6.2%}){flag}")
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    if only_old:
+        print(f"  (sections gone in new round, not gated: "
+              f"{', '.join(only_old)})")
+    if only_new:
+        print(f"  (new sections, no baseline: {', '.join(only_new)})")
+    if regressions:
+        print(f"perf_gate: FAIL — {len(regressions)} section(s) "
+              f"regressed beyond -{tol:.0%}")
+        return 1
+    print("perf_gate: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

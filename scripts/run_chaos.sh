@@ -27,6 +27,10 @@ python scripts/lint_metrics.py || exit 1
 # the unified functional core, nn/core.py (no reintroduced duplicate
 # step/scan/remat implementations — see scripts/lint_parity.py).
 python scripts/lint_parity.py || exit 1
+# ... and the newest bench round must not have regressed beyond the
+# tolerance band vs the previous one (scripts/perf_gate.py; passes
+# when fewer than two comparable rounds exist).
+python scripts/perf_gate.py || exit 1
 
 # Registered chaos storms (suite -> what the storm asserts):
 #   tests/test_resilience.py     — training runtime (retry/checkpoint/
@@ -116,6 +120,7 @@ STORMS=(
     tests/test_elastic.py
     tests/test_data_defense.py
     tests/test_conv_block.py
+    tests/test_profiler.py
 )
 
 declare -a names rcs
