@@ -1139,6 +1139,161 @@ def bench_elastic_recovery(budget_s=None) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+_HOST_RECOVERY_WORKER = r"""
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.extend.backend as _jeb
+_jeb.clear_backends()
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except Exception:
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+_jeb.clear_backends()
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.control_plane import WorkerAgent
+from deeplearning4j_tpu.parallel.elastic import HostElasticTrainer
+from deeplearning4j_tpu.parallel.mesh import (
+    build_mesh, init_distributed_elastic,
+)
+from deeplearning4j_tpu.resilience.chaos import KillAtStep
+
+rank = int(os.environ["HR_RANK"])
+kill_at = int(os.environ.get("HR_KILL_AT", "-1"))
+n_batches = int(os.environ["HR_NBATCH"])
+snap_every = int(os.environ["HR_SNAP_EVERY"])
+
+agent = WorkerAgent(os.environ["HR_CONTROL"], rank_hint=rank)
+grant = agent.join(timeout_s=60)
+agent.start_renewals()
+init_distributed_elastic(grant.jax_coordinator, grant.num,
+                         grant.rank, timeout_s=60)
+
+conf = (NeuralNetConfiguration.Builder().seed(42).learning_rate(0.05)
+        .updater("ADAM").list()
+        .layer(DenseLayer(n_in=16, n_out=64, activation="tanh"))
+        .layer(OutputLayer(n_out=4, loss="MCXENT"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+mesh = build_mesh(data=len(jax.devices()), model=1)
+tr = HostElasticTrainer(net, agent, mesh=mesh,
+                        snapshot_every=snap_every)
+rng = np.random.RandomState(0)
+data = [DataSet(features=rng.randn(32, 16).astype(np.float32),
+                labels=np.eye(4, dtype=np.float32)[
+                    rng.randint(0, 4, 32)])
+        for _ in range(n_batches)]
+
+marks = {}
+_recover = tr.recover
+def recover(plan):
+    marks["t_plan"] = time.monotonic()
+    marks["step_at_plan"] = int(net.iteration_count)
+    snap = _recover(plan)
+    marks["t_recovered"] = time.monotonic()
+    return snap
+tr.recover = recover
+
+class FirstStepAfterRecovery:
+    def iteration_done(self, model, iteration):
+        if "t_recovered" in marks and "t_first_step" not in marks:
+            marks["t_first_step"] = time.monotonic()
+
+net.listeners.append(FirstStepAfterRecovery())
+if kill_at >= 0:
+    net.listeners.append(KillAtStep(kill_at))
+tr.fit(data, epochs=1)
+agent.close()
+
+rec = tr.last_recovery or {}
+print(json.dumps({
+    "recovery_s": round(marks["t_recovered"] - marks["t_plan"], 4),
+    "time_to_first_step_s": round(
+        marks["t_first_step"] - marks["t_plan"], 4),
+    "steps_lost": marks["step_at_plan"] - rec.get("rolled_back_to", 0),
+    "rolled_back_to": rec.get("rolled_back_to"),
+    "snapshot_every": snap_every,
+    "hosts_before": 2, "hosts_after": rec.get("survivors"),
+    "final_step": int(net.iteration_count),
+    "recoveries": tr.recoveries,
+}))
+"""
+
+
+def bench_host_recovery(budget_s=None) -> dict:
+    """HOST-loss recovery latency: two real processes form a
+    ``jax.distributed`` CPU mesh under the lease control plane, rank 1
+    is SIGKILLed mid-run, and the survivor re-forms a 1-process
+    runtime. Measures, on the survivor, plan-received ->
+    trainer-rebuilt (``recovery_s``, including the jax runtime
+    teardown + re-init) and -> first completed optimizer step on the
+    re-formed mesh (``time_to_first_step_s``, including the re-jit).
+    ``steps_lost`` must stay under ``snapshot_every``: recovery
+    replays from the host-RAM snapshot ring, no disk I/O."""
+    from deeplearning4j_tpu.parallel.control_plane import (
+        LeaseCoordinator,
+    )
+
+    n_batches, snap_every, kill_at = 12, 4, 7
+    repo = os.path.dirname(os.path.abspath(__file__))
+    timeout = 300
+    if budget_s is not None:
+        timeout = max(60, min(timeout, int(budget_s)))
+    coord = LeaseCoordinator(2, lease_s=1.0,
+                             barrier_timeout_s=60.0).start()
+    procs = []
+    try:
+        for rank in range(2):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.update({
+                "PYTHONPATH": os.pathsep.join(
+                    [repo] + env.get("PYTHONPATH", "").split(
+                        os.pathsep)),
+                "HR_RANK": str(rank),
+                "HR_CONTROL": coord.address,
+                "HR_NBATCH": str(n_batches),
+                "HR_SNAP_EVERY": str(snap_every),
+                "HR_KILL_AT": str(kill_at if rank == 1 else -1),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _HOST_RECOVERY_WORKER],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        out0, err0 = procs[0].communicate(timeout=timeout)
+        procs[1].wait(timeout=30)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        coord.stop()
+    if procs[0].returncode != 0:
+        raise RuntimeError(
+            f"host-recovery survivor failed: {err0[-2000:]}")
+    if procs[1].returncode != -9:
+        raise RuntimeError(
+            "host-recovery victim was not SIGKILLed "
+            f"(rc={procs[1].returncode})")
+    return json.loads(out0.strip().splitlines()[-1])
+
+
 # ---------------------------------------------------------------------------
 # 8. Serving micro-batch throughput (scripts/bench_serving.py)
 # ---------------------------------------------------------------------------
@@ -1669,6 +1824,13 @@ def _section_table(budget_fn):
          "the 8-device virtual mesh mid-run (host-RAM snapshot "
          "ring; steps_lost < snapshot_every is the gate), plus "
          "preemption-notice -> emergency-checkpoint wall time"),
+        ("host_recovery",
+         lambda: bench_host_recovery(budget_fn()),
+         "HOST-loss -> survivor re-formation latency: 2 real "
+         "processes under the lease control plane, rank 1 "
+         "SIGKILLed mid-run; plan-received -> trainer-rebuilt and "
+         "-> first step on the re-formed mesh (steps_lost < "
+         "snapshot_every is the gate)"),
         ("serving_microbatch",
          lambda: bench_serving(budget_fn()),
          "batched-vs-solo serving req/s at concurrency 32 "

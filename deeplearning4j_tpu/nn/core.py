@@ -656,13 +656,34 @@ def zero_layout_closures(zero_layout):
     return (lambda a: zero_flatten_leaf(a, shards)), zero_unflatten_leaf
 
 
+def _host_gather_leaf(a):
+    """Device->host copy of one (possibly sharded) leaf. A leaf whose
+    shards span OTHER processes (zero on a multi-process mesh) is not
+    locally readable — replicate it first via a jitted identity, a
+    real all-gather collective, which is safe because every caller
+    (snapshot push, checkpoint save, re-shard) runs in barrier-kept
+    lockstep across ranks."""
+    import jax
+
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = a.sharding.mesh
+        a = jax.jit(
+            lambda x: x,
+            out_shardings=NamedSharding(mesh, PartitionSpec()),
+        )(a)
+    return np.asarray(a)
+
+
 def zero_gather_updater_state(upd_state, params):
     """Gather a zero-laid-out updater state back to canonical
     per-param shapes on HOST (numpy) — the checkpoint / snapshot /
     cross-mesh re-shard form. Idempotent: a leaf already in canonical
     shape passes through (modulo the host copy), so callers may apply
     it without knowing the live layout; ``np.asarray`` on a sharded
-    leaf performs the device->host all-gather."""
+    leaf performs the device->host all-gather (cross-process shards
+    ride a replicating collective first, see ``_host_gather_leaf``)."""
     t0 = time.perf_counter()
     out: Dict[str, Any] = {}
     for ln, lp in upd_state.items():
@@ -672,7 +693,7 @@ def zero_gather_updater_state(upd_state, params):
             n = int(np.prod(shape)) if len(shape) else 1
             gathered = []
             for a in tup:
-                h = np.asarray(a)
+                h = _host_gather_leaf(a)
                 if h.shape != shape:
                     h = h.reshape(-1)[:n].reshape(shape)
                 gathered.append(h)
@@ -1252,6 +1273,7 @@ def fit_epoch_scan(model, it) -> int:
     an input pipeline) feed the dispatch directly."""
     from deeplearning4j_tpu.datasets.api import ChunkedDataSet
 
+    from deeplearning4j_tpu.parallel import control_plane
     from deeplearning4j_tpu.resilience import preemption
 
     model._reset_recurrent_state()  # scan carries empty rnn entries
@@ -1263,6 +1285,7 @@ def fit_epoch_scan(model, it) -> int:
         # un-flushed buffer holds no dispatched work, so an emergency
         # checkpoint here is consistent at the last flushed step
         preemption.check_fit(model)
+        control_plane.check_fit(model)
         if isinstance(ds, ChunkedDataSet):
             if buf:
                 flush_scan_chunk(model, buf)
@@ -1309,10 +1332,12 @@ def fit_epochs_device_cached(model, iterator, epochs: int, arrays_of,
             if hasattr(listener, "on_epoch_start"):
                 listener.on_epoch_start(model)
         model._reset_recurrent_state()
+        from deeplearning4j_tpu.parallel import control_plane
         from deeplearning4j_tpu.resilience import preemption
 
         for kind, item, last in plan:
             preemption.check_fit(model)
+            control_plane.check_fit(model)
             if kind == "chunk":
                 if _wants_last_features(model):
                     model._last_features = last.features
@@ -1362,6 +1387,7 @@ def fit_batches(model, iterator, epochs: int) -> None:
         return
     if model._fit_epochs_device_cached(iterator, epochs):
         return
+    from deeplearning4j_tpu.parallel import control_plane
     from deeplearning4j_tpu.parallel.dispatch import (
         AsyncDispatchWindow,
     )
@@ -1394,6 +1420,7 @@ def fit_batches(model, iterator, epochs: int) -> None:
                             prefetch=iterator
                             if hasattr(iterator, "shutdown") else None,
                         )
+                        control_plane.check_fit(model)
                         model.fit_minibatch(ds)
                         n_batches += 1
                 finally:

@@ -6,8 +6,22 @@ from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding,
     build_mesh,
     init_distributed,
+    init_distributed_elastic,
     process_local_batch,
+    reform_distributed,
     replicated,
+    shutdown_distributed,
+)
+from deeplearning4j_tpu.parallel.control_plane import (  # noqa: F401
+    ControlPlaneException,
+    CoordinatorLostException,
+    HostFencedException,
+    LeaseCoordinator,
+    LeaseState,
+    LocalTransport,
+    RecoveryPlan,
+    TcpTransport,
+    WorkerAgent,
 )
 from deeplearning4j_tpu.parallel.cluster import (  # noqa: F401
     ClusterComputationGraph,
@@ -50,6 +64,7 @@ from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
     DeviceLostException,
     ElasticTrainer,
     HeartbeatMonitor,
+    HostElasticTrainer,
     SnapshotRing,
     StragglerDetector,
 )

@@ -41,7 +41,9 @@ from __future__ import annotations
 
 import collections
 import logging
+import random
 import time
+import zlib
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -73,20 +75,33 @@ class DeviceLostException(DL4JFaultException):
 class HeartbeatMonitor:
     """Liveness ledger: every shard must beat every step; silence
     past ``timeout`` seconds means dead. The clock is injectable so
-    tests advance time manually instead of sleeping."""
+    tests advance time manually instead of sleeping.
+
+    ``epoch`` tracks the control-plane membership epoch the ledger
+    belongs to: ``reset`` advances it, and :meth:`clear` un-declares a
+    shard only when the caller proves it holds the CURRENT epoch — a
+    rejoined member is welcomed back, a zombie clearing itself with a
+    stale epoch is not."""
 
     def __init__(self, shards: Sequence[str], timeout: float = 30.0,
-                 clock=time.monotonic, registry=None):
+                 clock=time.monotonic, jitter: float = 0.0,
+                 seed: Optional[int] = None, registry=None):
         if timeout <= 0:
             raise ValueError("heartbeat timeout must be > 0")
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError("jitter must be in [0, 1)")
         self.timeout = float(timeout)
         self.clock = clock
+        self.jitter = float(jitter)
+        self.epoch = 0
         registry = registry if registry is not None else _default_registry()
         self._m_missed = registry.counter(
             "heartbeat_missed_total",
             help="shards declared dead after a heartbeat timeout",
             labels=("shard",),
         )
+        self._seed = 0 if seed is None else int(seed)
+        self._rngs: Dict[str, random.Random] = {}
         self._last: Dict[str, float] = {}
         self._declared: set = set()
         self._counted: set = set()
@@ -94,11 +109,53 @@ class HeartbeatMonitor:
 
     def reset(self, shards: Sequence[str]) -> None:
         """Restart the ledger over ``shards`` (post-recovery: the
-        survivor set). Everyone gets a fresh grace period."""
+        survivor set) and advance the epoch. Everyone gets a fresh
+        grace period."""
         now = self.clock()
         self._last = {str(s): now for s in shards}
         self._declared = set()
         self._counted = set()
+        self.epoch += 1
+        # per-shard rng seeded by (seed, shard id): each shard's beat
+        # cadence decorrelates from its peers' (the
+        # ServingRouter.health_jitter pattern) so a fleet's renewals
+        # don't synchronize into thundering-herd bursts; crc32, not
+        # hash() — the latter is salted per process
+        self._rngs = {s: random.Random(self._shard_seed(s))
+                      for s in self._last}
+
+    def _shard_seed(self, shard: str) -> int:
+        return (self._seed << 32) ^ zlib.crc32(str(shard).encode())
+
+    def next_interval(self, shard) -> float:
+        """The shard's next beat interval: a third of the timeout,
+        jittered by its own seeded rng. Deterministic per (seed,
+        shard) — two ranks never share a schedule."""
+        s = str(shard)
+        rng = self._rngs.get(s)
+        if rng is None:
+            raise KeyError(f"unknown shard {s!r}")
+        base = self.timeout / 3.0
+        if self.jitter <= 0.0:
+            return base
+        return base * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
+
+    def clear(self, shard, epoch: int) -> bool:
+        """Epoch-fenced un-declare: a member readmitted at control
+        epoch ``epoch`` stops being sticky-dead — but only if that IS
+        the current epoch (a zombie's stale clear is refused).
+        Returns whether the shard is alive afterwards."""
+        s = str(shard)
+        if int(epoch) != self.epoch:
+            logger.warning(
+                "heartbeat clear refused for shard %s: epoch %d != "
+                "current %d", s, int(epoch), self.epoch)
+            return False
+        self._declared.discard(s)
+        self._counted.discard(s)
+        self._last[s] = self.clock()
+        self._rngs.setdefault(s, random.Random(self._shard_seed(s)))
+        return True
 
     @property
     def shards(self) -> List[str]:
@@ -451,4 +508,223 @@ class ElasticTrainer:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(m)
             m.epoch_count += 1
+        return epoch_scores
+
+
+class HostElasticTrainer:
+    """Cross-HOST elastic training: one of these per worker process,
+    driven by a ``control_plane.WorkerAgent``. Extends the
+    :class:`ElasticTrainer` recipe from device loss inside one
+    process to the loss of a whole process:
+
+    - every step ends at a coordinator **barrier** (which doubles as
+      a lease renewal), so all survivors agree on the recovery point;
+    - every K steps each worker pushes a host-RAM snapshot — in
+      lockstep, because the barrier keeps step counters aligned;
+    - when the barrier returns a :class:`~.control_plane.RecoveryPlan`
+      (a peer's lease expired, or a member was admitted), recovery
+      runs: adopt the plan (renewals continue under the new epoch
+      while we rebuild), tear down + re-form the jax runtime over the
+      survivor set (``mesh.reform_distributed`` — new term, fresh
+      port), roll back to the newest ring snapshot, and hand the
+      restored canonical state to a fresh
+      :class:`~.trainer.DistributedTrainer`, which re-places — and
+      for ZeRO, re-shards — it onto the smaller mesh;
+    - **coordinator loss** degrades gracefully: checkpoint (when a
+      manager is configured) and raise a ``PreemptedException`` so
+      ``exit_on_preemption`` exits 75/76 instead of hanging;
+    - a **fence** (this host was declared dead but is actually alive,
+      e.g. un-partitioned) propagates: zombie state must not be
+      checkpointed.
+
+    Trajectory equivalence is the same piecewise claim as
+    ``ElasticTrainer``, proven bitwise in
+    ``tests/test_control_plane.py``'s real 2-process SIGKILL storm:
+    full-width to the snapshot, survivor-width after."""
+
+    def __init__(self, model, agent, *, mesh=None,
+                 snapshot_every: int = 8, ring_capacity: int = 2,
+                 checkpoint_manager=None, reform=None,
+                 reform_timeout_s: float = 30.0, clock=time.monotonic,
+                 registry=None, **trainer_kwargs):
+        if trainer_kwargs.get("tensor_parallel"):
+            raise ValueError(
+                "HostElasticTrainer is data-parallel only: a dead "
+                "host's tensor-parallel weight shard has no "
+                "surviving replica (use checkpoint restore instead)"
+            )
+        self.model = model
+        self.agent = agent
+        self.clock = clock
+        self._trainer_kwargs = dict(trainer_kwargs)
+        self.trainer = DistributedTrainer(model, mesh=mesh,
+                                          **self._trainer_kwargs)
+        self.snapshot_every = max(int(snapshot_every), 1)
+        registry = registry if registry is not None else _default_registry()
+        self.ring = SnapshotRing(ring_capacity, registry=registry)
+        self.manager = checkpoint_manager
+        self._reform = reform
+        self.reform_timeout_s = float(reform_timeout_s)
+        self._m_recoveries = registry.counter(
+            "host_recoveries_total",
+            help="host-loss recoveries: mesh re-formed over the "
+                 "survivor process set",
+        )._default()
+        self._m_recovery_ms = registry.summary(
+            "host_recovery_ms",
+            help="host-loss recovery latency: runtime re-formation + "
+                 "snapshot rollback + re-placement (ms)",
+        )._default()
+        self.recoveries = 0
+        self.last_recovery: Optional[dict] = None
+        self.last_recovery_snapshot: Optional[dict] = None
+
+    @property
+    def mesh(self):
+        return self.trainer.mesh
+
+    # -- recovery --------------------------------------------------------
+
+    def _reform_mesh(self, plan):
+        if self._reform is not None:
+            return self._reform(plan)
+        from deeplearning4j_tpu.parallel.mesh import reform_distributed
+
+        return reform_distributed(plan, data=None, model=1,
+                                  timeout_s=self.reform_timeout_s)
+
+    def recover(self, plan) -> dict:
+        """Execute a recovery plan: new epoch adopted first (so the
+        renewal thread keeps the lease alive under the new epoch while
+        the runtime re-forms), then runtime re-formation, then ring
+        rollback + fresh trainer. Returns the snapshot restored."""
+        from deeplearning4j_tpu.observability import flightrec
+        from deeplearning4j_tpu.observability.trace import get_tracer
+
+        t0 = self.clock()
+        with get_tracer().start_span(
+                "control.host_recover",
+                attrs={"epoch": plan.epoch,
+                       "survivors": plan.num}) as span:
+            self.agent.adopt(plan)
+            new_mesh = self._reform_mesh(plan)
+            snap = self.ring.restore_into_model(self.model)
+            self.trainer = DistributedTrainer(
+                self.model, mesh=new_mesh, **self._trainer_kwargs)
+            span.set_attr("rolled_back_to", snap["step"])
+        dt_ms = (self.clock() - t0) * 1000.0
+        self.recoveries += 1
+        self._m_recoveries.inc()
+        self._m_recovery_ms.observe(dt_ms)
+        self.last_recovery = {
+            "epoch": plan.epoch, "term": plan.term,
+            "dead": list(plan.dead), "admitted": list(plan.admitted),
+            "survivors": plan.num,
+            "rolled_back_to": snap["step"],
+        }
+        self.last_recovery_snapshot = snap
+        flightrec.record_event(
+            "host_recovery", epoch=plan.epoch, dead=list(plan.dead),
+            survivors=plan.num, rolled_back_to=snap["step"],
+            ms=round(dt_ms, 3))
+        logger.warning(
+            "host recovery: epoch %d, dead=%s, %d survivors, rolled "
+            "back to step %d in %.0fms", plan.epoch, list(plan.dead),
+            plan.num, snap["step"], dt_ms)
+        return snap
+
+    def _coordinator_lost(self, step: int, cause) -> None:
+        """Membership truth is gone: checkpoint what we have and exit
+        through the preemption machinery (75 with a checkpoint, 76
+        without) instead of hanging or training a partitioned
+        brain."""
+        from deeplearning4j_tpu.observability import flightrec
+        from deeplearning4j_tpu.resilience.preemption import (
+            PreemptedException,
+        )
+
+        info = None
+        failed = False
+        if self.manager is not None:
+            try:
+                info = self.manager.save(self.model)
+            except Exception as e:
+                failed = True
+                logger.error(
+                    "coordinator lost AND the exit checkpoint "
+                    "failed: %r", e)
+        flightrec.record_event("coordinator_lost", step=int(step),
+                               checkpointed=info is not None)
+        raise PreemptedException(
+            f"control coordinator lost at step {step}; "
+            + ("checkpoint saved" if info is not None
+               else "no checkpoint manager configured" if not failed
+               else "checkpoint FAILED"),
+            step=int(step), checkpoint=info, checkpoint_failed=failed,
+            reason="coordinator-lost",
+        ) from cause
+
+    def _step_barrier(self, step: int):
+        from deeplearning4j_tpu.parallel.control_plane import (
+            CoordinatorLostException,
+        )
+
+        try:
+            return self.agent.step_barrier(step)
+        except CoordinatorLostException as e:
+            self._coordinator_lost(step, e)
+        # HostFencedException propagates: zombie state stays un-saved
+
+    # -- the cross-host fit loop ----------------------------------------
+
+    def fit(self, batches, epochs: int = 1) -> list:
+        """Fit ``epochs`` passes over ``batches`` (materialized), one
+        optimizer step per batch, a coordinator barrier at every step
+        boundary, a lockstep snapshot every ``snapshot_every`` steps.
+        Returns per-epoch mean scores, matching
+        ``DistributedTrainer.fit``."""
+        from deeplearning4j_tpu.parallel import control_plane
+        from deeplearning4j_tpu.resilience import preemption
+
+        batches = list(batches)
+        m = self.model
+        epoch_scores = []
+        control_plane.install_agent(self.agent)
+        try:
+            for _ in range(epochs):
+                for listener in m.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(m)
+                scores: Dict[int, float] = {}
+                i = 0
+                steps_since_snap = None  # snapshot at epoch start
+                while i < len(batches):
+                    preemption.check_fit(m)
+                    if steps_since_snap is None or (
+                        steps_since_snap >= self.snapshot_every
+                    ):
+                        self.ring.push(m, epoch_index=i)
+                        steps_since_snap = 0
+                    plan = self._step_barrier(m.iteration_count)
+                    if plan is not None:
+                        snap = self.recover(plan)
+                        i = snap["epoch_index"]
+                        scores = {k: v for k, v in scores.items()
+                                  if k < i}
+                        steps_since_snap = 0
+                        continue
+                    scores[i] = self.trainer.fit_minibatch(batches[i])
+                    steps_since_snap += 1
+                    i += 1
+                vals = [scores[k] for k in sorted(scores)]
+                epoch_scores.append(
+                    float(np.mean([float(v) for v in vals]))
+                    if vals else float("nan")
+                )
+                for listener in m.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(m)
+                m.epoch_count += 1
+        finally:
+            control_plane.uninstall_agent(self.agent)
         return epoch_scores

@@ -12,12 +12,18 @@ to Spark RDD aggregation.
 
 from __future__ import annotations
 
+import logging
+import math
 import os
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.exceptions import DL4JFaultException
+
+logger = logging.getLogger(__name__)
 
 
 def build_mesh(
@@ -54,6 +60,9 @@ def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    *,
+    timeout_s: Optional[float] = None,
+    policy=None,
 ) -> None:
     """Multi-host initialization (replaces the reference's Spark
     master/executor bootstrap; reference
@@ -62,7 +71,12 @@ def init_distributed(
     With no arguments, reads the standard env vars
     (``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``, ``PROCESS_ID``) or
     defers to the TPU pod runtime's automatic configuration.
-    """
+
+    ``timeout_s`` (or ``DL4J_TPU_INIT_TIMEOUT_S``) bounds the whole
+    bring-up with retry + deadline: a worker that starts before its
+    coordinator fails fast with a chained ``DL4JFaultException``
+    instead of hanging on jax's 300s default. Without a budget the
+    stock blocking call is used unchanged."""
     kwargs = {}
     addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
     if addr:
@@ -73,7 +87,231 @@ def init_distributed(
     pid = process_id if process_id is not None else os.environ.get("PROCESS_ID")
     if pid is not None:
         kwargs["process_id"] = int(pid)
-    jax.distributed.initialize(**kwargs)
+    if timeout_s is None:
+        env = os.environ.get("DL4J_TPU_INIT_TIMEOUT_S")
+        timeout_s = float(env) if env else None
+    if timeout_s is None and policy is None:
+        jax.distributed.initialize(**kwargs)
+        return
+    from deeplearning4j_tpu.exceptions import (
+        DeadlineExceededException, RetryExhaustedException,
+    )
+    from deeplearning4j_tpu.resilience.retry import (
+        RetryPolicy, retry_call,
+    )
+
+    policy = policy or RetryPolicy(
+        max_attempts=4, base_delay=0.5, multiplier=2.0, max_delay=5.0,
+        retry_on=(OSError, TimeoutError, RuntimeError),
+        total_timeout=timeout_s,
+    )
+    if "coordinator_address" in kwargs and timeout_s is not None:
+        # split the budget across attempts so the LAST attempt still
+        # gets a slice instead of the first one eating it all
+        kwargs["initialization_timeout"] = max(
+            1, int(math.ceil(timeout_s / policy.max_attempts)))
+
+    def _attempt():
+        try:
+            jax.distributed.initialize(**kwargs)
+        except RuntimeError as e:
+            if "only be called once" in str(e):
+                raise DL4JFaultException(
+                    "init_distributed: jax.distributed is already "
+                    "initialized in this process — call "
+                    "shutdown_distributed() before re-forming"
+                ) from e
+            # drop any half-built client/service so the retry starts
+            # from a clean slate
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            raise
+
+    try:
+        retry_call(_attempt, policy=policy)
+    except (RetryExhaustedException, DeadlineExceededException) as e:
+        raise DL4JFaultException(
+            "init_distributed: coordinator "
+            f"{kwargs.get('coordinator_address', '<auto>')} not "
+            f"reachable within {timeout_s}s — start the coordinator "
+            "first, or raise timeout_s / DL4J_TPU_INIT_TIMEOUT_S"
+        ) from e
+
+
+def _enable_cpu_collectives() -> None:
+    """Cross-process collectives on the CPU backend need the gloo
+    implementation (the default 'none' fails every multi-process
+    computation outright). Harmless on TPU; skipped when already
+    chosen or when this jax predates the flag."""
+    try:
+        current = jax.config.jax_cpu_collectives_implementation
+    except AttributeError:
+        current = None
+    try:
+        if current in (None, "none"):
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+
+
+def init_distributed_elastic(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    *,
+    timeout_s: float = 30.0,
+    policy=None,
+    heartbeat_interval_s: int = 1,
+    max_missing_heartbeats: int = 10,
+    shutdown_timeout_s: int = 3,
+    on_peer_failure: Optional[Callable] = None,
+) -> None:
+    """Survivor-safe ``jax.distributed`` bring-up for the cross-host
+    control plane. Builds the coordination client/service directly
+    (same wire protocol as ``jax.distributed.initialize``) so that a
+    host-loss survivor can actually outlive its peers:
+
+    - a peer-failure notice runs ``on_peer_failure`` (default: log +
+      flight-recorder event) instead of the stock client's
+      LOG(QFATAL) process kill;
+    - the shutdown barrier is bounded (``shutdown_timeout_s``), so a
+      survivor's teardown cannot hang on a SIGKILLed peer that will
+      never arrive;
+    - connection is bounded-retried like :func:`init_distributed`.
+
+    Pair with :func:`shutdown_distributed` + :func:`reform_distributed`
+    for the teardown/re-formation cycle."""
+    from jax._src import distributed as _jdist
+
+    import jaxlib.xla_extension as xe
+
+    from deeplearning4j_tpu.observability import flightrec
+    from deeplearning4j_tpu.exceptions import (
+        DeadlineExceededException, RetryExhaustedException,
+    )
+    from deeplearning4j_tpu.resilience.retry import (
+        RetryPolicy, retry_call,
+    )
+
+    state = _jdist.global_state
+    if state.client is not None:
+        raise DL4JFaultException(
+            "init_distributed_elastic: a distributed client is still "
+            "live — call shutdown_distributed() first")
+    _enable_cpu_collectives()
+
+    def _notice(*args):
+        logger.warning("jax coordination peer-failure notice: %s",
+                       args)
+        flightrec.record_event("jax_peer_failure",
+                               detail=str(args)[:200])
+        if on_peer_failure is not None:
+            on_peer_failure(*args)
+
+    policy = policy or RetryPolicy(
+        max_attempts=3, base_delay=0.5, max_delay=3.0,
+        retry_on=(OSError, TimeoutError, RuntimeError),
+        total_timeout=timeout_s,
+    )
+    per_attempt = max(1, int(math.ceil(timeout_s / policy.max_attempts)))
+    port = coordinator_address.rsplit(":", 1)[1]
+
+    def _attempt():
+        if process_id == 0 and state.service is None:
+            # the service survives a failed client attempt: it is
+            # already listening and the next attempt connects to it
+            state.service = xe.get_distributed_runtime_service(
+                "[::]:" + port, num_processes,
+                heartbeat_interval=heartbeat_interval_s,
+                max_missing_heartbeats=max_missing_heartbeats,
+                shutdown_timeout=shutdown_timeout_s,
+            )
+        client = xe.get_distributed_runtime_client(
+            coordinator_address, process_id,
+            init_timeout=per_attempt,
+            shutdown_timeout=shutdown_timeout_s,
+            heartbeat_interval=heartbeat_interval_s,
+            max_missing_heartbeats=max_missing_heartbeats,
+            missed_heartbeat_callback=_notice,
+            shutdown_on_destruction=False,
+            use_compression=True,
+        )
+        client.connect()
+        state.client = client
+        state.process_id = process_id
+        state.num_processes = num_processes
+        state.coordinator_address = coordinator_address
+
+    try:
+        retry_call(_attempt, policy=policy)
+    except (RetryExhaustedException, DeadlineExceededException) as e:
+        raise DL4JFaultException(
+            "init_distributed_elastic: could not form a "
+            f"{num_processes}-process runtime at "
+            f"{coordinator_address} within {timeout_s}s"
+        ) from e
+
+
+def shutdown_distributed() -> None:
+    """Tear down the jax distributed runtime AND the backend registry
+    so this process can re-initialize over a new process set (host-loss
+    mesh re-formation). Never raises: a failing shutdown barrier (dead
+    peers cannot arrive at it) is logged and abandoned — bounded only
+    when the runtime came from :func:`init_distributed_elastic`, whose
+    client has a small shutdown timeout and a benign failure
+    callback."""
+    from jax._src import distributed as _jdist
+
+    state = _jdist.global_state
+    if state.client is not None:
+        try:
+            state.client.shutdown()
+        except Exception as e:
+            logger.warning(
+                "distributed client shutdown abandoned: %r", e)
+        state.client = None
+    if state.service is not None:
+        try:
+            state.service.shutdown()
+        except Exception as e:
+            logger.warning(
+                "distributed service shutdown abandoned: %r", e)
+        state.service = None
+    state.preemption_sync_manager = None
+    state.process_id = 0
+    state.num_processes = 1
+    state.coordinator_address = None
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+
+
+def reform_distributed(plan, *, data: Optional[int] = None,
+                       model: int = 1,
+                       timeout_s: float = 30.0) -> Mesh:
+    """One call from recovery plan to fresh mesh: tear down the old
+    runtime, re-initialize over the survivor set named by ``plan`` (a
+    ``control_plane.RecoveryPlan`` or any object/dict with
+    ``jax_coordinator`` / ``num`` / ``rank``), return a mesh over the
+    new global device set."""
+    if isinstance(plan, dict):
+        get = plan.get
+    else:
+        def get(k, d=None):
+            return getattr(plan, k, d)
+
+    addr = get("jax_coordinator")
+    num = int(get("num"))
+    rank = int(get("rank"))
+    if addr is None:
+        raise DL4JFaultException(
+            "reform_distributed: plan has no jax_coordinator address")
+    shutdown_distributed()
+    init_distributed_elastic(addr, num, rank, timeout_s=timeout_s)
+    return build_mesh(data=data, model=model)
 
 
 def process_local_batch(global_batch: int, mesh: Mesh) -> int:

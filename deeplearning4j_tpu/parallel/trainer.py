@@ -850,6 +850,7 @@ class DistributedTrainer:
         trajectory over the surviving batches is bitwise the clean
         run's. With ``prefetch`` the validation runs on the prefetch
         worker thread."""
+        from deeplearning4j_tpu.parallel import control_plane
         from deeplearning4j_tpu.parallel.dispatch import (
             AsyncDispatchWindow,
         )
@@ -920,6 +921,7 @@ class DistributedTrainer:
                             prefetch=source
                             if hasattr(source, "shutdown") else None,
                         )
+                        control_plane.check_fit(m)
                         scores.append(
                             self.fit_minibatch(ds, _window=window)
                         )

@@ -17,8 +17,13 @@ separately.
 
 from __future__ import annotations
 
+import os
 import random
-from typing import Callable, Dict, IO, Iterator, List, Optional, Set, Union
+import signal
+from typing import (
+    Callable, Dict, IO, Iterator, List, Optional, Sequence, Set,
+    Tuple, Union,
+)
 
 from deeplearning4j_tpu.cloud.storage import ObjectStore
 from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
@@ -240,6 +245,77 @@ class PoisonIterator(DataSetIterator):
 
     def total_examples(self) -> int:
         return self.inner.total_examples()
+
+
+class ControlChannelChaos:
+    """Control-plane transport decorator: host-granularity network
+    faults for the cross-host control plane
+    (``parallel/control_plane.py``). Wraps any transport exposing
+    ``request(payload, timeout_s=)`` and injects, deterministically:
+
+    - **drops** — a :class:`ChaosPolicy` consulted per request, keyed
+      by the protocol op (``join`` / ``renew`` / ``barrier`` / ...):
+      a scheduled call raises :class:`ChaosError` (an ``OSError``, so
+      the agent's bounded retry treats it exactly like a dropped
+      heartbeat frame);
+    - **delays** — ``delay={op: seconds}`` sleeps before delegating
+      (injectable ``sleep``), the slow-network half of the storm;
+    - **partition** — ``partition=(start, end)`` fails EVERY request
+      whose global index falls in ``[start, end)`` regardless of op:
+      the coordinator is unreachable, retries exhaust, and the agent
+      concludes :class:`CoordinatorLostException`.
+
+    ``requests`` records ``(op, index)`` of every attempt for exact
+    asserts; the same seed replays the same storm."""
+
+    def __init__(self, inner, policy: Optional[ChaosPolicy] = None,
+                 *, delay: Optional[Dict[str, float]] = None,
+                 partition: Optional[Tuple[int, int]] = None,
+                 sleep: Callable[[float], None] = None):
+        import time
+
+        self.inner = inner
+        self.policy = policy
+        self.delay = dict(delay or {})
+        self.partition = partition
+        self.sleep = sleep if sleep is not None else time.sleep
+        self.total = 0
+        self.requests: List[tuple] = []
+
+    def request(self, payload: dict, timeout_s=None) -> dict:
+        op = str(payload.get("op"))
+        index = self.total
+        self.total += 1
+        self.requests.append((op, index))
+        if self.partition is not None:
+            lo, hi = self.partition
+            if lo <= index < hi:
+                raise ChaosError(
+                    f"chaos: control channel partitioned "
+                    f"(request #{index}, op {op!r})")
+        if self.policy is not None:
+            self.policy.check(op)
+        d = self.delay.get(op)
+        if d:
+            self.sleep(d)
+        return self.inner.request(payload, timeout_s=timeout_s)
+
+
+class KillAtStep:
+    """Host-granularity chaos: an iteration listener that SIGKILLs its
+    OWN process the moment ``iteration_done`` reaches ``at_step`` —
+    the kill-rank-N-at-step-K storm. SIGKILL, not an exception: the
+    point is that nothing gets to clean up, exactly like a real host
+    loss. Arm it on rank N only; every other rank trains normally
+    until the control plane declares the death."""
+
+    def __init__(self, at_step: int, sig: int = signal.SIGKILL):
+        self.at_step = int(at_step)
+        self.sig = sig
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if int(iteration) >= self.at_step:
+            os.kill(os.getpid(), self.sig)
 
 
 class FlakyIterator(DataSetIterator):

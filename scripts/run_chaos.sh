@@ -108,6 +108,22 @@ python scripts/perf_gate.py || exit 1
 #                                  tolerance; plus the full dispatch/
 #                                  trajectory/AOT-refusal suite rides
 #                                  along (fast, CPU interpret mode)
+#   tests/test_control_plane.py  — cross-host control plane: lease
+#                                  heartbeats through seeded drop /
+#                                  delay / partition storms (drops
+#                                  survive the retry envelope, delays
+#                                  land in control_rtt_ms, a hard
+#                                  partition concludes coordinator
+#                                  lost -> emergency checkpoint +
+#                                  exit 75); then the real thing —
+#                                  two jax.distributed processes,
+#                                  rank 1 SIGKILLed mid-step, the
+#                                  survivor rolls back to the newest
+#                                  snapshot, re-forms a 1-process
+#                                  mesh, and finishes bitwise equal
+#                                  to a piecewise reference, with
+#                                  ZeRO off and on (sharded moments
+#                                  gathered + re-sharded)
 STORMS=(
     tests/test_resilience.py
     tests/test_serving.py
@@ -121,6 +137,7 @@ STORMS=(
     tests/test_data_defense.py
     tests/test_conv_block.py
     tests/test_profiler.py
+    tests/test_control_plane.py
 )
 
 declare -a names rcs

@@ -1,0 +1,173 @@
+"""Shared helpers for REAL multi-process distributed tests
+(``test_multihost_real.py``, ``test_control_plane.py``'s SIGKILL
+storms): child-process environment setup, port picking, and a spawn
+helper that ALWAYS reaps its children and retries the whole bring-up
+on a port-bind race.
+
+The old per-test ``_free_port`` had a TOCTOU hole: the port is
+released before the child binds it, and anything on the box can steal
+it in between. No reservation scheme closes that hole (the jax
+coordinator must bind the port itself), so the fix is the honest one:
+detect the bind race in the failed child's stderr and retry the
+entire round with fresh ports.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+from typing import Callable, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Every child must pin the CPU platform BEFORE its first jax use: the
+# parent test process holds 8 virtual CPU devices (conftest), children
+# want exactly one local device each, and the cross-process CPU
+# collectives need the gloo implementation (the default 'none' fails
+# every multi-process computation outright).
+CHILD_PREAMBLE = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.extend.backend as _jeb
+_jeb.clear_backends()
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except Exception:
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+_jeb.clear_backends()
+"""
+
+_BIND_RACE_MARKERS = (
+    "Address already in use",
+    "address already in use",
+    "EADDRINUSE",
+    "Failed to bind",
+    "errno: 98",
+)
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """Bind-and-release port pick. Inherently racy (see module
+    docstring): pair with ``run_ranks``'s bind-race retry."""
+    s = socket.socket()
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def child_env(extra: Optional[dict] = None) -> dict:
+    """A clean child environment: repo on PYTHONPATH, the parent's
+    XLA_FLAGS dropped (children pin their own device count)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    env.pop("XLA_FLAGS", None)
+    if extra:
+        env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def looks_like_bind_race(stderr: str) -> bool:
+    return any(m in (stderr or "") for m in _BIND_RACE_MARKERS)
+
+
+def reap(procs: Sequence[subprocess.Popen]) -> None:
+    """Kill + wait every still-running child. Never raises; never
+    leaves an orphan, whatever state the test died in."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            pass
+
+
+def run_ranks(
+    make_round: Callable[[], Tuple[List[List[str]], object]],
+    *,
+    timeout_s: float = 300.0,
+    attempts: int = 3,
+    env: Optional[dict] = None,
+    on_spawned: Optional[Callable] = None,
+) -> Tuple[List[Tuple[int, str, str]], object]:
+    """Run one round of rank children to completion.
+
+    ``make_round()`` returns ``(argv_lists, ctx)`` — fresh command
+    lines (allocate fresh ports INSIDE it) plus any context the caller
+    wants back. Every child is spawned, awaited with ``timeout_s``,
+    and — no matter how the round ends — reaped: kill + wait in a
+    ``finally``, so an assert or timeout can never orphan a child.
+
+    When a child fails and its stderr shows a port-bind race, the
+    whole round retries (up to ``attempts``) with whatever fresh ports
+    the next ``make_round()`` picks. Returns
+    ``([(returncode, stdout, stderr), ...], ctx)`` in rank order; exit
+    codes are the caller's to judge (a SIGKILL storm EXPECTS -9)."""
+    e = env if env is not None else child_env()
+    last_results = None
+    ctx = None
+    for attempt in range(attempts):
+        cmds, ctx = make_round()
+        procs = [
+            subprocess.Popen(c, env=e, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+            for c in cmds
+        ]
+        if on_spawned is not None:
+            on_spawned(procs, ctx)
+        results: List[Tuple[int, str, str]] = []
+        timed_out = None
+        try:
+            for rank, p in enumerate(procs):
+                try:
+                    out, err = p.communicate(timeout=timeout_s)
+                except subprocess.TimeoutExpired:
+                    timed_out = rank
+                    break
+                results.append((p.returncode, out, err))
+        finally:
+            reap(procs)
+        if timed_out is not None:
+            raise AssertionError(
+                f"rank {timed_out} timed out after {timeout_s}s "
+                f"(attempt {attempt + 1}/{attempts})")
+        last_results = results
+        race = any(rc not in (0, -9) and looks_like_bind_race(err)
+                   for rc, _, err in results)
+        if not race:
+            return results, ctx
+    return last_results, ctx
+
+
+def dump_obj(path: str, obj) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(obj, f)
+
+
+def load_obj(path: str):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def python_child(script: str, *args: str) -> List[str]:
+    """argv for a ``python -c`` child running ``CHILD_PREAMBLE`` +
+    ``script``."""
+    return [sys.executable, "-c", CHILD_PREAMBLE + script, *args]

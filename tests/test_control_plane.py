@@ -1,0 +1,741 @@
+"""Cross-host control plane: lease/epoch/fence units under a fake
+clock, chaos storms over the control channel, coordinator-loss
+checkpoint-and-exit, and the REAL 2-process SIGKILL host-loss storm
+with a bitwise piecewise-reference assert (ZeRO off and on).
+
+Reference analog: the coordinator/worker failure model of the
+TensorFlow system paper (PAPERS.md) and Spark master/worker liveness
+(``BaseSparkTest`` master recovery tests).
+"""
+
+import pickle
+import subprocess
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.parallel.control_plane import (
+    ControlPlaneException,
+    CoordinatorLostException,
+    HostFencedException,
+    LeaseCoordinator,
+    LeaseState,
+    LocalTransport,
+    RecoveryPlan,
+    TcpTransport,
+    WorkerAgent,
+)
+from deeplearning4j_tpu.parallel.elastic import (
+    HeartbeatMonitor, HostElasticTrainer,
+)
+from deeplearning4j_tpu.parallel.mesh import build_mesh, init_distributed
+from deeplearning4j_tpu.resilience.chaos import (
+    ChaosError, ChaosPolicy, ControlChannelChaos, KillAtStep,
+)
+from deeplearning4j_tpu.resilience.retry import RetryPolicy
+from deeplearning4j_tpu.exceptions import DL4JFaultException
+
+from tests import _multiproc
+from tests.test_resilience import CHAOS_SEED, batches as mk_batches, \
+    simple_net
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _state(n=2, lease_s=2.0, **kw):
+    fc = FakeClock()
+    kw.setdefault("port_factory", lambda: 4242)
+    kw.setdefault("registry", MetricsRegistry())
+    return LeaseState(n, lease_s=lease_s, clock=fc, **kw), fc
+
+
+def _fast_policy():
+    return RetryPolicy(max_attempts=3, base_delay=0.001,
+                       max_delay=0.002, total_timeout=5.0)
+
+
+# -- lease state machine under a fake clock ----------------------------
+
+
+def test_lease_grant_forms_at_expected_count():
+    st, fc = _state(2)
+    assert st.grant_for(0) is None  # nobody joined yet -> not formed
+    assert st.join(0) == 0
+    assert st.grant_for(0) is None  # still forming
+    assert st.join(1) == 1
+    g = st.grant_for(0)
+    assert g["ok"] and g["epoch"] == 1 and g["num"] == 2
+    assert g["members"] == [0, 1] and g["rank"] == 0
+    assert st.grant_for(1)["rank"] == 1
+    assert "4242" in g["jax_coordinator"]
+
+
+def test_lease_renew_extends_and_counts():
+    reg = MetricsRegistry()
+    st, fc = _state(2, lease_s=2.0, registry=reg)
+    st.join(0), st.join(1)
+    for _ in range(5):
+        fc.advance(1.5)
+        assert st.renew(0, 1)["ok"]
+        assert st.renew(1, 1)["ok"]
+    # both outlived several lease windows through renewal alone
+    assert st.info()["members"] == [0, 1]
+    assert reg.get("lease_renewals_total")._default().value == 10
+    assert reg.get("control_epoch")._default().value == 1.0
+
+
+def test_lease_expiry_fences_and_bumps_epoch():
+    reg = MetricsRegistry()
+    st, fc = _state(2, lease_s=2.0, registry=reg)
+    st.join(0), st.join(1)
+    fc.advance(1.0)
+    assert st.renew(1, 1)["ok"]          # member 1 stays fresh
+    fc.advance(1.5)                      # member 0's lease (2.0) gone
+    r = st.renew(1, 1)
+    assert r["error"] == "stale_epoch"
+    plan = RecoveryPlan.from_dict(r["plan"])
+    assert plan.epoch == 2 and plan.term == 2
+    assert plan.members == (1,) and plan.dead == (0,)
+    assert plan.rank == 0 and plan.num == 1
+    # the dead member is fenced: renew, barrier, grant all refuse
+    assert st.renew(0, 1)["error"] == "fenced"
+    assert st.arrive(0, 2, 9)["error"] == "fenced"
+    assert st.grant_for(0)["error"] == "fenced"
+    exp = reg.get("lease_expired_total").labels("0").value
+    assert exp == 1
+
+
+def test_no_expiry_during_formation():
+    st, fc = _state(2, lease_s=2.0)
+    st.join(0)
+    fc.advance(100.0)  # waiting for the straggler rank
+    assert st.join(1) == 1
+    assert st.grant_for(0)["ok"]  # nobody was swept while forming
+
+
+def test_barrier_proceed_wait_and_lease_refresh():
+    st, fc = _state(2, lease_s=2.0)
+    st.join(0), st.join(1)
+    assert st.arrive(0, 1, 0)["decision"] == "wait"
+    fc.advance(1.5)
+    assert st.arrive(0, 1, 0)["decision"] == "wait"  # renews to 3.5
+    st.renew(1, 1)                                   # renews to 3.5
+    # past member 0's ORIGINAL expiry (2.0): arrival kept it alive
+    fc.advance(1.0)
+    assert st.arrive(1, 1, 0)["decision"] == "proceed"
+    assert st.arrive(0, 1, 0)["decision"] == "proceed"
+
+
+def test_barrier_converts_death_into_plan():
+    st, fc = _state(2, lease_s=2.0)
+    st.join(0), st.join(1)
+    assert st.arrive(0, 1, 3)["decision"] == "wait"
+    fc.advance(1.5)
+    assert st.arrive(0, 1, 3)["decision"] == "wait"  # keep 0 alive
+    fc.advance(1.0)  # member 1 never arrived: its lease (2.0) is gone
+    r = st.arrive(0, 1, 3)
+    assert r["error"] == "stale_epoch"
+    plan = RecoveryPlan.from_dict(r["plan"])
+    assert plan.dead == (1,) and plan.members == (0,)
+
+
+def test_rejoin_admitted_at_next_epoch_as_fresh_member():
+    st, fc = _state(2, lease_s=2.0)
+    st.join(0), st.join(1)
+    fc.advance(1.0)
+    st.renew(1, 1)
+    fc.advance(1.5)              # member 0 dies
+    st.renew(1, 1)               # epoch 2, members == (1,)
+    # the dead host comes back: NEVER member 0 again
+    fresh = st.join(0)
+    assert fresh == 2
+    assert st.grant_for(2) is None       # pending until the bump
+    r = st.arrive(1, 2, 7)               # next step boundary admits
+    assert r["error"] == "stale_epoch"
+    plan = RecoveryPlan.from_dict(r["plan"])
+    assert plan.epoch == 3
+    assert plan.members == (1, 2) and plan.admitted == (2,)
+    g = st.grant_for(2)
+    assert g["ok"] and g["rank"] == 1 and g["num"] == 2
+    # ... and the old identity stays fenced forever
+    assert st.renew(0, 3)["error"] == "fenced"
+
+
+def test_graceful_leave_reforms():
+    st, fc = _state(2)
+    st.join(0), st.join(1)
+    st.leave(0)
+    g = st.grant_for(1)
+    assert g["epoch"] == 2 and g["members"] == [1]
+    assert st.renew(0, 2)["error"] == "fenced"
+
+
+def test_stale_epoch_rejected_with_plan():
+    st, fc = _state(2)
+    st.join(0), st.join(1)
+    st.leave(1)
+    r = st.renew(0, 1)  # member 0 still talks epoch 1
+    assert r["error"] == "stale_epoch"
+    assert r["plan"]["epoch"] == 2
+
+
+# -- worker agent over the in-process transport ------------------------
+
+
+def _local_agent(st, rank=0, **kw):
+    kw.setdefault("policy", _fast_policy())
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("registry", MetricsRegistry())
+    return WorkerAgent(LocalTransport(st), rank_hint=rank, **kw)
+
+
+def test_agent_join_and_barrier_local():
+    st, fc = _state(2)
+    a0, a1 = _local_agent(st, 0), _local_agent(st, 1)
+    t = threading.Thread(target=a0.join)  # blocks (polls) until formed
+    t.start()
+    p1 = a1.join()
+    t.join(5)
+    assert not t.is_alive()
+    assert (a0.rank, a0.num, a1.rank) == (0, 2, 1)
+    assert p1.epoch == 1
+    # barrier: a1 waits for a0 via polling
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(a1.step_barrier(0)))
+    t.start()
+    assert a0.step_barrier(0) is None
+    t.join(5)
+    assert done == [None]
+
+
+def test_agent_stale_epoch_returns_plan_and_adopt():
+    st, fc = _state(2)
+    a0, a1 = _local_agent(st, 0), _local_agent(st, 1)
+    t = threading.Thread(target=a0.join)
+    t.start()
+    a1.join()
+    t.join(5)
+    assert not t.is_alive()
+    fc.advance(1.0)
+    a1.renew()
+    fc.advance(1.5)  # a0's member dies (its thread joined already)
+    plan = a1.step_barrier(1)
+    assert isinstance(plan, RecoveryPlan)
+    assert plan.dead and plan.num == 1
+    a1.adopt(plan)
+    assert a1.epoch == plan.epoch and a1.rank == 0
+    assert a1.step_barrier(1) is None  # alone at the new epoch
+
+
+def test_agent_fence_raises():
+    st, fc = _state(1)
+    a = _local_agent(st, 0)
+    a.join()
+    fc.advance(5.0)
+    st.info()  # sweep declares the member dead
+    with pytest.raises(HostFencedException):
+        a.renew()
+    # sticky verdict: the fit-loop hook re-raises without a wire call
+    with pytest.raises(HostFencedException):
+        a.raise_verdicts()
+
+
+# -- chaos storms over the control channel -----------------------------
+
+
+@pytest.mark.chaos
+def test_storm_heartbeat_drops_survive_retry():
+    """Dropped renewal frames are retried inside the agent; the lease
+    never lapses even though every other frame dies."""
+    st, fc = _state(1, lease_s=10.0)
+    chaos = ControlChannelChaos(
+        LocalTransport(st),
+        policy=ChaosPolicy(seed=CHAOS_SEED,
+                           fail_calls={"renew": {0, 2, 4}}),
+    )
+    a = WorkerAgent(chaos, rank_hint=0, policy=_fast_policy(),
+                    sleep=lambda s: None, registry=MetricsRegistry())
+    a.join()
+    for _ in range(3):
+        assert a.renew() is None     # success despite the drop
+    assert len(chaos.policy.injected) == 3
+    assert st.info()["members"] == [0]
+
+
+@pytest.mark.chaos
+def test_storm_heartbeat_delay_frames():
+    """Delayed frames: the transport sleeps (injected) before
+    delegating — latency shows up in control_rtt_ms, nothing fails."""
+    st, fc = _state(1, lease_s=10.0)
+    slept = []
+    chaos = ControlChannelChaos(
+        LocalTransport(st), delay={"renew": 0.25},
+        sleep=slept.append,
+    )
+    reg = MetricsRegistry()
+    a = WorkerAgent(chaos, rank_hint=0, policy=_fast_policy(),
+                    sleep=lambda s: None, registry=reg)
+    a.join()
+    assert a.renew() is None
+    assert slept == [0.25]
+    assert reg.get("control_rtt_ms")._default().count >= 2
+
+
+@pytest.mark.chaos
+def test_storm_partition_concludes_coordinator_lost():
+    st, fc = _state(1, lease_s=10.0)
+    chaos = ControlChannelChaos(LocalTransport(st),
+                                partition=(2, 1 << 30))
+    a = WorkerAgent(chaos, rank_hint=0, policy=_fast_policy(),
+                    sleep=lambda s: None, registry=MetricsRegistry())
+    a.join()  # requests 0 (join) and 1 (grant? no — join grants directly)
+    with pytest.raises(CoordinatorLostException) as ei:
+        for step in range(10):
+            a.step_barrier(step)
+    assert isinstance(ei.value.__cause__, DL4JFaultException)
+    # every request in the partition window was a ChaosError
+    assert all(op == "barrier"
+               for op, _ in chaos.requests[2:5])
+
+
+@pytest.mark.chaos
+def test_storm_coordinator_loss_checkpoints_and_exits_75(tmp_path):
+    """Coordinator gone mid-fit -> the trainer checkpoints, raises
+    PreemptedException(reason='coordinator-lost'), and
+    exit_on_preemption turns it into exit code 75."""
+    from deeplearning4j_tpu.resilience.checkpoint import (
+        CheckpointManager,
+    )
+    from deeplearning4j_tpu.resilience.preemption import (
+        EXIT_PREEMPTED, PreemptedException, exit_on_preemption,
+    )
+
+    st, fc = _state(1, lease_s=1000.0)
+    chaos = ControlChannelChaos(LocalTransport(st),
+                                partition=(4, 1 << 30))
+    a = WorkerAgent(chaos, rank_hint=0, policy=_fast_policy(),
+                    sleep=lambda s: None, registry=MetricsRegistry())
+    a.join()
+    net = simple_net(seed=11)
+    mgr = CheckpointManager(tmp_path, keep_last=2)
+    tr = HostElasticTrainer(
+        net, a, mesh=build_mesh(), snapshot_every=2,
+        checkpoint_manager=mgr, registry=MetricsRegistry(),
+    )
+    rng = np.random.RandomState(0)
+    data = mk_batches(rng, n_batches=8)
+    with pytest.raises(PreemptedException) as ei:
+        tr.fit(data)
+    e = ei.value
+    assert e.reason == "coordinator-lost"
+    assert e.checkpoint is not None and not e.checkpoint_failed
+    assert e.exit_code == EXIT_PREEMPTED == 75
+    assert mgr.available()  # the exit checkpoint landed on disk
+    # the documented process exit path
+    net2 = simple_net(seed=11)
+    st2, _ = _state(1, lease_s=1000.0)
+    a2 = WorkerAgent(
+        ControlChannelChaos(LocalTransport(st2),
+                            partition=(4, 1 << 30)),
+        rank_hint=0, policy=_fast_policy(), sleep=lambda s: None,
+        registry=MetricsRegistry())
+    a2.join()
+    tr2 = HostElasticTrainer(
+        net2, a2, mesh=build_mesh(), snapshot_every=2,
+        checkpoint_manager=mgr, registry=MetricsRegistry(),
+    )
+    with pytest.raises(SystemExit) as se:
+        with exit_on_preemption():
+            tr2.fit(data)
+    assert se.value.code == 75
+
+
+# -- satellite: HeartbeatMonitor jitter + epoch-fenced clear -----------
+
+
+def test_heartbeat_jitter_decorrelates_shards():
+    m1 = HeartbeatMonitor(["0", "1"], timeout=30.0, jitter=0.2,
+                          seed=5, registry=MetricsRegistry())
+    m2 = HeartbeatMonitor(["0", "1"], timeout=30.0, jitter=0.2,
+                          seed=5, registry=MetricsRegistry())
+    base = 10.0
+    seq0 = [m1.next_interval("0") for _ in range(8)]
+    seq1 = [m1.next_interval("1") for _ in range(8)]
+    assert seq0 != seq1                       # decorrelated per shard
+    assert all(base * 0.8 <= v <= base * 1.2 for v in seq0 + seq1)
+    # deterministic per (seed, shard): same schedule on a twin
+    assert seq0 == [m2.next_interval("0") for _ in range(8)]
+    with pytest.raises(KeyError):
+        m1.next_interval("nope")
+    # jitter=0 is the legacy fixed cadence
+    m0 = HeartbeatMonitor(["0"], timeout=30.0,
+                          registry=MetricsRegistry())
+    assert m0.next_interval("0") == base
+
+
+def test_heartbeat_clear_is_epoch_fenced():
+    fc = FakeClock()
+    m = HeartbeatMonitor(["0", "1"], timeout=5.0, clock=fc,
+                         registry=MetricsRegistry())
+    epoch = m.epoch
+    m.mark_dead("1")
+    assert m.dead() == ["1"]
+    # a zombie clearing itself with a stale epoch is refused
+    assert not m.clear("1", epoch - 1)
+    assert m.dead() == ["1"]
+    # the rejoin path holds the current epoch: welcome back
+    assert m.clear("1", epoch)
+    assert m.dead() == []
+    m.beat("1")  # no longer sticky-dead
+    # reset advances the epoch, so yesterday's token dies with it
+    m.reset(["0", "1"])
+    assert not m.clear("1", epoch)
+    assert m.clear("1", m.epoch)
+
+
+# -- satellite: init_distributed fail-fast -----------------------------
+
+
+def test_init_distributed_bounded_retry_fails_fast(monkeypatch):
+    import jax
+
+    calls = []
+
+    def boom(**kw):
+        calls.append(kw)
+        raise RuntimeError("DEADLINE_EXCEEDED: Barrier timed out")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+    pol = RetryPolicy(max_attempts=3, base_delay=0.001,
+                      max_delay=0.002,
+                      retry_on=(OSError, TimeoutError, RuntimeError))
+    with pytest.raises(DL4JFaultException) as ei:
+        init_distributed("127.0.0.1:1", 2, 0, timeout_s=5.0,
+                         policy=pol)
+    assert len(calls) == 3                    # bounded, not hanging
+    assert "127.0.0.1:1" in str(ei.value)
+    assert ei.value.__cause__ is not None     # chained
+    # the per-attempt slice of the budget reached jax
+    assert calls[0]["initialization_timeout"] == 2
+
+
+def test_init_distributed_double_init_not_retried(monkeypatch):
+    import jax
+
+    calls = []
+
+    def boom(**kw):
+        calls.append(kw)
+        raise RuntimeError("jax.distributed.initialize should only "
+                           "be called once")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with pytest.raises(DL4JFaultException) as ei:
+        init_distributed("127.0.0.1:1", 2, 0, timeout_s=5.0)
+    assert len(calls) == 1  # non-retryable: fail immediately
+    assert "shutdown_distributed" in str(ei.value)
+
+
+def test_init_distributed_no_budget_is_unchanged(monkeypatch):
+    import jax
+
+    seen = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: seen.append(kw))
+    monkeypatch.delenv("DL4J_TPU_INIT_TIMEOUT_S", raising=False)
+    init_distributed("127.0.0.1:9", 2, 1)
+    assert seen == [{"coordinator_address": "127.0.0.1:9",
+                     "num_processes": 2, "process_id": 1}]
+
+
+# -- TCP coordinator integration ---------------------------------------
+
+
+def test_tcp_join_barrier_and_rejoin():
+    with LeaseCoordinator(2, lease_s=5.0) as coord:
+        agents = {}
+        errs = []
+
+        def run(r):
+            try:
+                a = WorkerAgent(coord.address, rank_hint=r)
+                a.join(timeout_s=15)
+                agents[r] = a
+                for step in range(3):
+                    assert a.step_barrier(step) is None
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert not errs, errs
+        assert {agents[r].rank for r in agents} == {0, 1}
+        # info op over the wire
+        info = TcpTransport(coord.address).request({"op": "info"})
+        assert info["members"] == sorted(
+            a.member for a in agents.values())
+        # a third worker joins mid-run: admitted at the next barrier
+        joined = {}
+        t3 = threading.Thread(target=lambda: joined.update(
+            plan=WorkerAgent(coord.address).join(timeout_s=20)))
+        t3.start()
+        deadline = time.monotonic() + 10
+        while not coord.state.info()["pending"]:
+            assert time.monotonic() < deadline, "join never registered"
+            time.sleep(0.01)
+        plans = [agents[r].step_barrier(3) for r in range(2)]
+        assert all(isinstance(p, RecoveryPlan) for p in plans)
+        for r in range(2):
+            agents[r].adopt(plans[r])
+        t3.join(20)
+        assert joined["plan"].num == 3
+        assert set(joined["plan"].admitted) == {
+            joined["plan"].member}
+
+
+# -- the real 2-process SIGKILL host-loss storm ------------------------
+
+_WORKER = r"""
+import json, os, pickle
+import numpy as np
+
+rank = int(os.environ["CP_RANK"])
+zero = os.environ.get("CP_ZERO") == "1"
+kill_at = int(os.environ.get("CP_KILL_AT", "-1"))
+n_batches = int(os.environ["CP_NBATCH"])
+snap_every = int(os.environ["CP_SNAP_EVERY"])
+outdir = os.environ["CP_OUT"]
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.parallel.control_plane import WorkerAgent
+from deeplearning4j_tpu.parallel.elastic import HostElasticTrainer
+from deeplearning4j_tpu.parallel.mesh import (
+    build_mesh, init_distributed_elastic,
+)
+from deeplearning4j_tpu.resilience.chaos import KillAtStep
+
+agent = WorkerAgent(os.environ["CP_CONTROL"], rank_hint=rank)
+grant = agent.join(timeout_s=60)
+agent.start_renewals()  # BEFORE the (slow) jax bring-up: keep renewing
+init_distributed_elastic(grant.jax_coordinator, grant.num,
+                         grant.rank, timeout_s=60)
+assert jax.process_count() == grant.num, jax.process_count()
+
+conf = (NeuralNetConfiguration.Builder().seed(42).learning_rate(0.05)
+        .updater("ADAM").list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3, loss="MCXENT"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+mesh = build_mesh(data=len(jax.devices()), model=1)
+tr = HostElasticTrainer(net, agent, mesh=mesh,
+                        snapshot_every=snap_every, zero=zero)
+rng = np.random.RandomState(0)  # same global batches on every rank
+data = [DataSet(features=rng.randn(8, 4).astype(np.float32),
+                labels=np.eye(3, dtype=np.float32)[
+                    rng.randint(0, 3, 8)])
+        for _ in range(n_batches)]
+if kill_at >= 0:
+    net.listeners.append(KillAtStep(kill_at))
+tr.fit(data, epochs=1)
+
+upd = net.updater_state
+if getattr(net, "_zero_layout", None):
+    upd = core.zero_gather_updater_state(upd, net.params)
+host = lambda t: jax.tree_util.tree_map(lambda a: np.array(a), t)
+with open(os.path.join(outdir, f"rank{rank}.pkl"), "wb") as f:
+    pickle.dump({
+        "rank": rank, "member": agent.member, "epoch": agent.epoch,
+        "iteration": int(net.iteration_count),
+        "recoveries": tr.recoveries,
+        "last_recovery": tr.last_recovery,
+        "snapshot": tr.last_recovery_snapshot,
+        "params": host(net.params), "updater": host(upd),
+    }, f)
+agent.close()
+print(f"CP_OK rank={rank} recoveries={tr.recoveries} "
+      f"iter={int(net.iteration_count)}")
+"""
+
+_REFERENCE = r"""
+import os, pickle
+import numpy as np
+import jax.numpy as jnp
+
+# single process, no jax.distributed: gloo (preamble default for the
+# worker children) requires a distributed client — revert to local
+jax.config.update("jax_cpu_collectives_implementation", "none")
+_jeb.clear_backends()
+
+zero = os.environ.get("CP_ZERO") == "1"
+n_batches = int(os.environ["CP_NBATCH"])
+outdir = os.environ["CP_OUT"]
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.trainer import DistributedTrainer
+
+with open(os.path.join(outdir, "snapshot.pkl"), "rb") as f:
+    snap = pickle.load(f)
+
+conf = (NeuralNetConfiguration.Builder().seed(42).learning_rate(0.05)
+        .updater("ADAM").list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3, loss="MCXENT"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+net.params = snap["params"]
+net.updater_state = snap["updater_state"]
+net.state = snap["state"]
+net._base_key = jnp.asarray(snap["rng"])
+net.iteration_count = snap["step"]
+net.epoch_count = snap["epoch"]
+
+# survivor-width replay: 1 device, same zero flag as the survivor
+mesh = build_mesh(data=1, model=1)
+tr = DistributedTrainer(net, mesh=mesh, zero=zero)
+rng = np.random.RandomState(0)
+data = [DataSet(features=rng.randn(8, 4).astype(np.float32),
+                labels=np.eye(3, dtype=np.float32)[
+                    rng.randint(0, 3, 8)])
+        for _ in range(n_batches)]
+for ds in data[snap["epoch_index"]:]:
+    tr.fit_minibatch(ds)
+
+upd = net.updater_state
+if getattr(net, "_zero_layout", None):
+    upd = core.zero_gather_updater_state(upd, net.params)
+host = lambda t: jax.tree_util.tree_map(lambda a: np.array(a), t)
+with open(os.path.join(outdir, "reference.pkl"), "wb") as f:
+    pickle.dump({"iteration": int(net.iteration_count),
+                 "params": host(net.params),
+                 "updater": host(upd)}, f)
+print("REF_OK")
+"""
+
+
+def _assert_trees_bitwise(a, b, what):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb, f"{what}: tree structure differs"
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y),
+            err_msg=f"{what}: leaf differs (not bitwise equal)")
+
+
+def _sigkill_storm(tmp_path, zero):
+    """SIGKILL rank 1 at step K mid-run; rank 0 must re-form a
+    1-process mesh within one snapshot window and finish with a
+    trajectory bitwise equal to the piecewise reference."""
+    n_batches, snap_every, kill_at = 12, 4, 7
+    outdir = tmp_path / f"storm_zero{int(zero)}"
+    outdir.mkdir()
+    base_env = {
+        "CP_ZERO": "1" if zero else "0",
+        "CP_NBATCH": n_batches, "CP_SNAP_EVERY": snap_every,
+        "CP_OUT": outdir,
+    }
+    cmd = _multiproc.python_child(_WORKER)
+    results = None
+    # run_ranks can't vary env per rank (CP_RANK / CP_KILL_AT), so
+    # spawn manually with the same reap-always + bind-race-retry rules
+    for attempt in range(3):
+        coord = LeaseCoordinator(
+            2, lease_s=1.0, barrier_timeout_s=60.0).start()
+        procs = [
+            subprocess.Popen(
+                cmd,
+                env=_multiproc.child_env(dict(
+                    base_env, CP_RANK=rank,
+                    CP_CONTROL=coord.address,
+                    CP_KILL_AT=kill_at if rank == 1 else -1)),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            for rank in range(2)
+        ]
+        results = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=300)
+                results.append((p.returncode, out, err))
+        finally:
+            _multiproc.reap(procs)
+            coord.stop()
+        if not any(rc not in (0, -9)
+                   and _multiproc.looks_like_bind_race(err)
+                   for rc, _, err in results):
+            break
+
+    (rc0, out0, err0), (rc1, out1, err1) = results
+    assert rc1 == -9, f"rank1 should die by SIGKILL: {rc1}\n{err1[-2000:]}"
+    assert rc0 == 0, f"survivor failed:\n{err0[-4000:]}"
+    assert "CP_OK rank=0" in out0
+    # no orphans: both children reaped above (communicate or kill+wait)
+
+    with open(outdir / "rank0.pkl", "rb") as f:
+        surv = pickle.load(f)
+    assert surv["recoveries"] == 1
+    assert surv["iteration"] == n_batches
+    rec = surv["last_recovery"]
+    assert rec["survivors"] == 1 and rec["dead"] == [1]
+    # within one snapshot window of the kill step
+    assert kill_at - snap_every <= rec["rolled_back_to"] <= kill_at
+    snap = surv["snapshot"]
+    assert snap["step"] == rec["rolled_back_to"]
+
+    with open(outdir / "snapshot.pkl", "wb") as f:
+        pickle.dump(snap, f)
+    p = subprocess.Popen(
+        _multiproc.python_child(_REFERENCE),
+        env=_multiproc.child_env(dict(base_env)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = p.communicate(timeout=300)
+    finally:
+        _multiproc.reap([p])
+    assert p.returncode == 0, f"reference failed:\n{err[-4000:]}"
+
+    with open(outdir / "reference.pkl", "rb") as f:
+        ref = pickle.load(f)
+    assert ref["iteration"] == surv["iteration"]
+    _assert_trees_bitwise(surv["params"], ref["params"], "params")
+    _assert_trees_bitwise(surv["updater"], ref["updater"], "updater")
+
+
+@pytest.mark.chaos
+def test_storm_sigkill_host_loss_bitwise(tmp_path):
+    _sigkill_storm(tmp_path, zero=False)
+
+
+@pytest.mark.chaos
+def test_storm_sigkill_host_loss_bitwise_zero(tmp_path):
+    _sigkill_storm(tmp_path, zero=True)

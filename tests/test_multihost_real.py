@@ -7,32 +7,15 @@ must agree on the resulting score and parameters.
 Reference analog: Spark local-mode tests — a real master/executor
 bootstrap on one machine (``BaseSparkTest.java:90``,
 ``setMaster("local[n]")``), not a cluster.
+
+Child environment, port picking, bind-race retry, and reaping live in
+``tests/_multiproc.py`` (shared with the control-plane storms).
 """
 
-import os
-import socket
-import subprocess
-import sys
+from tests import _multiproc
 
 _CHILD = r"""
-import os, sys
 import numpy as np
-os.environ["JAX_PLATFORMS"] = "cpu"
-# exactly one local CPU device per process -> 2 global devices
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-import jax
-jax.config.update("jax_platforms", "cpu")
-# the env's sitecustomize may have initialized jax on the TPU plugin
-# already (see tests/conftest.py) — reset the backend registry so the
-# settings above take effect; libtpu is single-process, so two
-# children must NOT both grab the chip
-import jax.extend.backend as _jeb
-_jeb.clear_backends()
-try:
-    jax.config.update("jax_num_cpu_devices", 1)
-except Exception:
-    pass
-_jeb.clear_backends()
 
 from deeplearning4j_tpu.parallel.mesh import (
     build_mesh, init_distributed, process_local_batch,
@@ -42,7 +25,7 @@ rank = int(sys.argv[1])
 port = sys.argv[2]
 init_distributed(
     coordinator_address=f"127.0.0.1:{port}", num_processes=2,
-    process_id=rank,
+    process_id=rank, timeout_s=120.0,
 )
 assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 2, jax.devices()
@@ -83,43 +66,18 @@ print(f"RANK{rank}_OK score={scores[0]:.6f}")
 """
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def test_two_process_distributed_training():
-    port = _free_port()
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [repo] + env.get("PYTHONPATH", "").split(os.pathsep)
-    )
-    # a clean slate for the children: the parent test process pins the
-    # CPU platform / 8 virtual devices; children set their own
-    env.pop("XLA_FLAGS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _CHILD, str(rank), str(port)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True,
-        )
-        for rank in range(2)
-    ]
+    def make_round():
+        port = _multiproc.free_port()
+        return [
+            _multiproc.python_child(_CHILD, str(rank), str(port))
+            for rank in range(2)
+        ], port
+
+    results, _port = _multiproc.run_ranks(make_round, timeout_s=300)
     outs = []
-    for rank, p in enumerate(procs):
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise AssertionError(f"rank {rank} timed out")
-        assert p.returncode == 0, (
-            f"rank {rank} failed:\n{err[-3000:]}"
-        )
+    for rank, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"rank {rank} failed:\n{err[-3000:]}"
         outs.append(out)
     for rank in range(2):
         assert f"RANK{rank}_OK" in outs[rank]
