@@ -33,6 +33,14 @@ class CheckpointCorruptedException(DL4JFaultException):
     missing members) and no earlier version could be restored."""
 
 
+class CheckpointCommitAbortedException(DL4JFaultException):
+    """A sharded checkpoint's two-phase commit aborted: the membership
+    the shards were written under changed (a host died or was admitted)
+    or the commit barrier was partitioned before rank 0 could write the
+    manifest. The uncommitted directory is ignored by restore and
+    collected by GC; the previous committed step remains the newest."""
+
+
 class RetryExhaustedException(DL4JFaultException):
     """A retried operation failed on every attempt of its budget.
     Carries the attempt count and the last underlying cause (also

@@ -129,11 +129,15 @@ class ContinualTrainer:
             feature_shape=self.feature_shape,
         )
 
-    def publish(self) -> "CheckpointInfo":
+    def publish(self, mode: Optional[str] = None) -> "CheckpointInfo":
         """Checkpoint the model at its current step, AOT bundle
         attached. Export failures degrade to a bundle-less publish
         (the consumer then JITs — a lost bundle costs a compile,
-        never a version)."""
+        never a version). ``mode`` rides through to
+        ``CheckpointManager.save``: with an async manager (or
+        ``mode="async"``) the publish is write-behind and this
+        returns the :class:`AsyncSaveHandle` (its ``step`` is final;
+        the manifest lands in the background)."""
         artifacts = None
         try:
             artifacts = self._artifacts()
@@ -143,13 +147,21 @@ class ContinualTrainer:
                 "bundle", int(self.model.iteration_count),
                 exc_info=True,
             )
-        info = self.manager.save(self.model, artifacts=artifacts)
+        info = self.manager.save(self.model, artifacts=artifacts,
+                                 mode=mode)
         self.last_published = info
         self._m_published.inc()
         self._m_published_step.set(info.step)
         logger.info("published checkpoint step %d (%d artifacts)",
-                    info.step, len(info.artifacts))
+                    info.step, len(getattr(info, "artifacts", None)
+                                   or {}))
         return info
+
+    def _publish_sync(self) -> "CheckpointInfo":
+        """Emergency-path publish: always synchronous, so the
+        preemption exit code never promises a checkpoint that a
+        background writer has yet to finish."""
+        return self.publish(mode="sync")
 
     # -- the stream loop ------------------------------------------------
 
@@ -207,7 +219,7 @@ class ContinualTrainer:
                 # trainer's publish() (AOT artifacts attached, journal
                 # retention honored), then PreemptedException
                 preemption.check_fit(
-                    self.model, checkpoint_fn=self.publish,
+                    self.model, checkpoint_fn=self._publish_sync,
                     prefetch=stream
                     if hasattr(stream, "shutdown") else None,
                 )

@@ -676,6 +676,25 @@ def _host_gather_leaf(a):
     return np.asarray(a)
 
 
+def host_snapshot_tree(tree):
+    """Buffer-isolated host copy of a pytree — the ``SnapshotRing``
+    copy discipline, shared with checkpoint snapshots: every leaf
+    comes back as a fresh ``np.ndarray`` sharing no buffers with the
+    input, so the caller may hand the copy to a background thread
+    (write-behind checkpointing) or park it in host RAM (snapshot
+    ring) while the live tree keeps training. Cross-process-sharded
+    leaves ride ``_host_gather_leaf``'s replicating collective, so on
+    a multi-process mesh this must run in lockstep across ranks."""
+    import jax
+
+    def _copy(a):
+        if isinstance(a, np.ndarray):
+            return np.array(a)
+        return np.asarray(_host_gather_leaf(a))
+
+    return jax.tree_util.tree_map(_copy, tree)
+
+
 def zero_gather_updater_state(upd_state, params):
     """Gather a zero-laid-out updater state back to canonical
     per-param shapes on HOST (numpy) — the checkpoint / snapshot /

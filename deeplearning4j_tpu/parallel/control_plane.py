@@ -172,6 +172,7 @@ class LeaseState:
         self.fenced: set = set()              # dead member ids (sticky)
         self.plan: Optional[dict] = None      # current epoch's plan
         self._arrived: Dict[int, set] = {}    # barrier step -> member ids
+        self._synced: Dict[str, Dict[int, object]] = {}  # key -> payloads
         self._next_id = 0
         self.cond = threading.Condition()
         registry = registry if registry is not None else _default_registry()
@@ -236,6 +237,7 @@ class LeaseState:
             "admitted": admitted, "lease_s": self.lease_s,
         }
         self._arrived = {}
+        self._synced = {}
         flightrec.record_event(
             "control_epoch", epoch=self.epoch, term=self.term,
             num=len(order), dead=self.plan["dead"], admitted=admitted)
@@ -383,6 +385,65 @@ class LeaseState:
                             "epoch": self.epoch, "step": step}
                 self.cond.wait(poll_s)
 
+    def sync(self, member: int, epoch: int, key: str,
+             payload=None) -> dict:
+        """Payload-carrying named barrier — the two-phase checkpoint
+        commit fence. Like :meth:`arrive`, but each member brings a
+        JSON payload (its shard digest) and ``proceed`` returns
+        everyone's, so all ranks leave the barrier knowing every
+        shard is durable before rank 0 writes the manifest. Keys are
+        opaque strings in a namespace separate from step barriers,
+        and — unlike ``arrive`` — a pending join does NOT bump the
+        epoch here: sync barriers run off the step path (background
+        checkpoint commits) and must not steal the admission point
+        from the step barrier. Any epoch bump (death, admission)
+        clears in-flight sync keys, so a commit can never span a
+        membership change."""
+        with self.cond:
+            self._sweep_locked()
+            if member in self.fenced or member not in self.members:
+                return {"ok": False, "error": "fenced",
+                        "epoch": self.epoch}
+            if int(epoch) != self.epoch:
+                return {"ok": False, "error": "stale_epoch",
+                        "epoch": self.epoch,
+                        "plan": self._plan_for_locked(member)}
+            self.members[member] = self.clock() + self.lease_s
+            key = str(key)
+            got = self._synced.setdefault(key, {})
+            got[member] = payload
+            if set(self.members) <= set(got):
+                # bounded: drop oldest completed keys (keep a few so
+                # stragglers re-polling a just-released key still see
+                # proceed; a straggler past that re-arrives, idempotent)
+                while len(self._synced) > 8:
+                    oldest = next(iter(self._synced))
+                    if oldest == key:
+                        break
+                    del self._synced[oldest]
+                self.cond.notify_all()
+                return {"ok": True, "decision": "proceed",
+                        "epoch": self.epoch, "key": key,
+                        "payloads": {str(m): got[m]
+                                     for m in sorted(got)}}
+            return {"ok": True, "decision": "wait",
+                    "epoch": self.epoch, "key": key}
+
+    def sync_wait(self, member: int, epoch: int, key: str, payload,
+                  timeout_s: float, poll_s: float = 0.05) -> dict:
+        """Blocking :meth:`sync` (real-clock server handlers only)."""
+        deadline = self.clock() + timeout_s
+        poll_s = min(poll_s, self.lease_s / 4.0)
+        while True:
+            r = self.sync(member, epoch, key, payload)
+            if r.get("decision") != "wait":
+                return r
+            with self.cond:
+                if self.clock() >= deadline:
+                    return {"ok": False, "error": "barrier_timeout",
+                            "epoch": self.epoch, "key": key}
+                self.cond.wait(poll_s)
+
     def join_wait(self, member_hint: Optional[int], timeout_s: float,
                   poll_s: float = 0.05) -> dict:
         """Blocking join (server handlers): register, then wait for
@@ -483,6 +544,11 @@ class LeaseCoordinator:
                 int(req["member"]), int(req["epoch"]),
                 int(req["step"]),
                 float(req.get("timeout_s", self.barrier_timeout_s)))
+        if op == "sync":
+            return st.sync_wait(
+                int(req["member"]), int(req["epoch"]),
+                str(req["key"]), req.get("payload"),
+                float(req.get("timeout_s", self.barrier_timeout_s)))
         if op == "leave":
             return st.leave(int(req["member"]))
         if op == "info":
@@ -576,6 +642,11 @@ class LocalTransport:
             return st.arrive(int(payload["member"]),
                              int(payload["epoch"]),
                              int(payload["step"]))
+        if op == "sync":
+            return st.sync(int(payload["member"]),
+                           int(payload["epoch"]),
+                           str(payload["key"]),
+                           payload.get("payload"))
         if op == "leave":
             return st.leave(int(payload["member"]))
         if op == "info":
@@ -824,6 +895,48 @@ class WorkerAgent:
                     f"(epoch {self.epoch}): peers wedged but not "
                     "declared dead")
             return None
+
+    def sync_barrier(self, key: str, payload=None,
+                     timeout_s: Optional[float] = None
+                     ) -> Optional[Dict[int, object]]:
+        """Payload-carrying named barrier — the checkpoint commit
+        fence. Blocks until every member of the current epoch arrives
+        with its payload, then returns ``{member_id: payload}`` for
+        all of them. Returns ``None`` when the epoch moved underneath
+        (a member died or was admitted): the caller's commit MUST
+        abort — the membership its shards were written under no
+        longer exists. Safe from any thread (each request rides a
+        fresh connection), which is the point: write-behind
+        checkpoint writers commit here without touching the training
+        thread's step barriers."""
+        self.raise_verdicts()
+        timeout_s = (self.barrier_timeout_s if timeout_s is None
+                     else float(timeout_s))
+        deadline = self.clock() + timeout_s
+        while True:
+            resp = self._call(
+                {"op": "sync", "member": self.member,
+                 "epoch": self.epoch, "key": str(key),
+                 "payload": payload, "timeout_s": timeout_s},
+                timeout_s=timeout_s + 10.0)
+            if resp.get("decision") == "wait":
+                if self.clock() >= deadline:
+                    raise ControlPlaneException(
+                        f"sync barrier {key!r} timed out after "
+                        f"{timeout_s}s (epoch {self.epoch}): peers "
+                        "wedged but not declared dead")
+                self.sleep(self.poll_s)
+                continue
+            if resp.get("error") == "stale_epoch":
+                self._stash_plan(resp)
+                return None
+            if resp.get("error") == "barrier_timeout":
+                raise ControlPlaneException(
+                    f"sync barrier {key!r} timed out after "
+                    f"{timeout_s}s (epoch {self.epoch}): peers wedged "
+                    "but not declared dead")
+            return {int(m): p
+                    for m, p in resp.get("payloads", {}).items()}
 
     def close(self, leave: bool = False) -> None:
         """Stop renewing; optionally a graceful ``leave`` (off by

@@ -288,7 +288,12 @@ class SnapshotRing:
 
     @staticmethod
     def _host(tree):
-        return jax.tree_util.tree_map(lambda a: np.array(a), tree)
+        # buffer-isolated host copies; shared with write-behind
+        # checkpoint snapshots (core.host_snapshot_tree), so
+        # cross-process-sharded leaves gather correctly too
+        from deeplearning4j_tpu.nn import core
+
+        return core.host_snapshot_tree(tree)
 
     def push(self, model, epoch_index: int = 0) -> dict:
         """Snapshot ``model`` at its current step. ``epoch_index``

@@ -57,9 +57,12 @@ from deeplearning4j_tpu.resilience.deadline import (  # noqa: F401
     Deadline,
 )
 from deeplearning4j_tpu.resilience.checkpoint import (  # noqa: F401
+    AsyncSaveHandle,
     CheckpointInfo,
     CheckpointListener,
     CheckpointManager,
+    LeaseCommitBarrier,
+    LocalCommitBarrier,
     atomic_write_bytes,
     restore_into,
 )

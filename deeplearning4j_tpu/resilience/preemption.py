@@ -353,7 +353,16 @@ class PreemptionHandler:
                     except Exception:  # never cost us the checkpoint
                         logger.exception(
                             "flight-recorder artifact dump failed")
-                info = mgr.save(model, artifacts=arts)
+                # force the synchronous path: it drains any
+                # write-behind save first, so the emergency
+                # checkpoint is complete, durable, and the newest on
+                # disk when the exit code promises "checkpointed"
+                try:
+                    info = mgr.save(model, artifacts=arts,
+                                    mode="sync")
+                except TypeError:
+                    # duck-typed managers without a mode kwarg
+                    info = mgr.save(model, artifacts=arts)
         except Exception:
             failed = True
             logger.exception("emergency checkpoint failed at step %s",

@@ -10,11 +10,23 @@ checkpoints self-describing and shard-assignable under pjit, while
 (batch-norm running stats, absent in the reference's format because
 its BN state lives inside params) is a fourth member.
 
-Writes are atomic (temp file + ``os.replace``). Versioned training
-checkpoints (``resilience/checkpoint.py``) pair each zip with a
-sibling JSON manifest — ``{"format": 1, "step", "epoch", "file",
-"crc32", "size"}`` — so restores verify the zip's CRC-32 before
-trusting it and can fall back to an earlier version.
+Writes are atomic **and durable** (temp file + ``fsync`` +
+``os.replace`` + directory ``fsync`` — rename alone survives a
+process crash but not a power loss). Versioned training checkpoints
+(``resilience/checkpoint.py``) pair each zip with a sibling JSON
+manifest — ``{"format": 1, "step", "epoch", "file", "crc32",
+"size"}`` — so restores verify the zip's CRC-32 before trusting it
+and can fall back to an earlier version.
+
+The save path is split in two so write-behind checkpointing can run
+the expensive half off the training thread: ``snapshot_model`` takes
+buffer-isolated host copies of everything a checkpoint holds (the
+only part that must run on the training thread, against a quiescent
+model), and ``write_snapshot`` serializes that snapshot to a zip
+from any thread. ``snapshot_flat_arrays`` / ``model_from_flat``
+expose the same state as one flat ``{section/layer/param: array}``
+map — the unit of sharding for the multi-host
+``checkpoint-<step>/shard-<rank>.npz`` layout.
 """
 
 from __future__ import annotations
@@ -24,7 +36,7 @@ import json
 import os
 import tempfile
 import zipfile
-from typing import Optional
+from typing import Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -33,6 +45,54 @@ CONFIG_NAME = "configuration.json"
 COEFFICIENTS_NAME = "coefficients.npz"
 UPDATER_NAME = "updaterState.npz"
 LAYER_STATE_NAME = "layerState.npz"
+
+# snapshot_flat_arrays section prefixes (zip member name sans ".npz")
+_FLAT_SECTIONS = ("coefficients", "layerState", "updaterState")
+
+
+def fsync_dir(path) -> None:
+    """fsync a directory so a just-renamed entry survives power loss.
+
+    Best-effort: some filesystems refuse O_RDONLY opens of
+    directories; losing the directory fsync there degrades to the
+    pre-existing crash-only guarantee rather than failing the save.
+    """
+    try:
+        fd = os.open(os.fspath(path) or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path, writer) -> None:
+    """Durably write a file: stage to a temp file in the destination
+    directory, ``writer(f)`` fills it, fsync the temp file, rename
+    into place, fsync the directory. A crash or power loss at any
+    point leaves either the old file or the new one — never a torn
+    mix."""
+    path = os.fspath(path)
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _flatten_params(params: dict) -> dict:
@@ -45,7 +105,8 @@ def _flatten_params(params: dict) -> dict:
 
 def _unflatten_params(d) -> dict:
     out: dict = {}
-    for key in d.files:
+    keys = d.files if hasattr(d, "files") else d.keys()
+    for key in keys:
         # rsplit: layer/vertex names may contain '/', param names never do
         ln, pn = key.rsplit("/", 1)
         out.setdefault(ln, {})[pn] = jnp.asarray(d[key])
@@ -82,14 +143,16 @@ def _read_npz(zf: zipfile.ZipFile, name: str):
     return np.load(io.BytesIO(zf.read(name)), allow_pickle=False)
 
 
-def write_model(model, path, save_updater: bool = True) -> None:
-    """Reference ``ModelSerializer.writeModel``, made crash-safe: the
-    zip is staged to a temp file in the destination directory and
-    ``os.replace``d into place, so a crash mid-save can never leave a
-    truncated zip where the last good checkpoint was (rename is atomic
-    within a filesystem; writing the temp next to the target keeps
-    both on one). File-like destinations stream directly (no rename
-    to do)."""
+def snapshot_model(model, save_updater: bool = True) -> dict:
+    """Buffer-isolated host snapshot of everything ``write_model``
+    persists — config doc, params, layer state, canonical updater
+    moments (ZeRO shards gathered back to param shapes so the
+    checkpoint stays mesh-independent). Every array is a fresh host
+    copy sharing no buffers with the live model, so the model may
+    keep training while a background thread serializes the snapshot.
+    This is the only part of a save that must run on the training
+    thread (against a quiescent model)."""
+    from deeplearning4j_tpu.nn import core
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -99,55 +162,114 @@ def write_model(model, path, save_updater: bool = True) -> None:
         mtype = "ComputationGraph"
     else:
         raise ValueError(f"Cannot serialize {type(model).__name__}")
-    conf_doc = {
+    upd = None
+    if save_updater and model.updater_state is not None:
+        upd = model.updater_state
+        if getattr(model, "_zero_layout", None):
+            # ZeRO-sharded moments: gather the flat shards back to
+            # canonical param shapes so the checkpoint is
+            # mesh-independent (restore re-shards onto whatever mesh
+            # is present — 8-wide, 4-wide, or replicated)
+            upd = core.zero_gather_updater_state(upd, model.params)
+        upd = core.host_snapshot_tree(upd)
+    return {
         "model_type": mtype,
         "configuration": model.conf.to_dict(),
         "iteration_count": model.iteration_count,
         "epoch_count": model.epoch_count,
+        "params": core.host_snapshot_tree(model.params),
+        "state": core.host_snapshot_tree(
+            {ln: st for ln, st in model.state.items() if st}
+        ),
+        "updater": upd,
     }
+
+
+def snapshot_conf_doc(snap: dict) -> dict:
+    """The ``configuration.json`` document for a snapshot — also what
+    a sharded ``manifest.json`` embeds so shard npz files stay pure
+    array containers."""
+    return {
+        "model_type": snap["model_type"],
+        "configuration": snap["configuration"],
+        "iteration_count": snap["iteration_count"],
+        "epoch_count": snap["epoch_count"],
+    }
+
+
+def write_snapshot(snap: dict, path) -> None:
+    """Serialize a ``snapshot_model`` dict to a checkpoint zip. Pure
+    host-array work — safe on any thread. Path destinations get the
+    durable temp + fsync + rename treatment; file-like destinations
+    stream directly (no rename to do)."""
 
     def _write_to(dest) -> None:
         with zipfile.ZipFile(dest, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr(CONFIG_NAME, json.dumps(conf_doc, indent=2))
-            _write_npz(zf, COEFFICIENTS_NAME, _flatten_params(model.params))
-            layer_state = {
-                ln: st for ln, st in model.state.items() if st
-            }
-            if layer_state:
+            zf.writestr(
+                CONFIG_NAME, json.dumps(snapshot_conf_doc(snap), indent=2)
+            )
+            _write_npz(zf, COEFFICIENTS_NAME, _flatten_params(snap["params"]))
+            if snap["state"]:
                 _write_npz(
-                    zf, LAYER_STATE_NAME, _flatten_params(layer_state)
+                    zf, LAYER_STATE_NAME, _flatten_params(snap["state"])
                 )
-            if save_updater and model.updater_state is not None:
-                upd = model.updater_state
-                if getattr(model, "_zero_layout", None):
-                    # ZeRO-sharded moments: gather the flat shards back
-                    # to canonical param shapes so the checkpoint is
-                    # mesh-independent (restore re-shards onto whatever
-                    # mesh is present — 8-wide, 4-wide, or replicated)
-                    from deeplearning4j_tpu.nn import core
-                    upd = core.zero_gather_updater_state(
-                        upd, model.params
-                    )
-                _write_npz(zf, UPDATER_NAME, _flatten_updater(upd))
+            if snap["updater"] is not None:
+                _write_npz(zf, UPDATER_NAME, _flatten_updater(snap["updater"]))
 
     if hasattr(path, "write"):
         _write_to(path)
         return
-    path = os.fspath(path)
-    fd, tmp = tempfile.mkstemp(
-        dir=os.path.dirname(path) or ".",
-        prefix=os.path.basename(path) + ".", suffix=".tmp",
-    )
-    try:
-        with os.fdopen(fd, "wb") as f:
-            _write_to(f)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    atomic_write(path, _write_to)
+
+
+def write_model(model, path, save_updater: bool = True) -> None:
+    """Reference ``ModelSerializer.writeModel``, made crash-safe and
+    power-loss durable: snapshot on the calling thread, then stage
+    the zip to a temp file, fsync, ``os.replace`` into place, and
+    fsync the directory — a crash or power loss mid-save can never
+    leave a truncated zip where the last good checkpoint was."""
+    write_snapshot(snapshot_model(model, save_updater=save_updater), path)
+
+
+def snapshot_flat_arrays(snap: dict) -> Dict[str, np.ndarray]:
+    """A snapshot as one flat ``{section/layer/param: array}`` map
+    (sections: ``coefficients``, ``layerState``, ``updaterState``) —
+    the unit of sharding for multi-host checkpoints: sorted keys are
+    dealt round-robin across ranks, each rank persists only its
+    slice, and restore merges the slices back by name."""
+    flat: Dict[str, np.ndarray] = {}
+    for k, v in _flatten_params(snap["params"]).items():
+        flat[f"coefficients/{k}"] = v
+    for k, v in _flatten_params(snap["state"]).items():
+        flat[f"layerState/{k}"] = v
+    if snap["updater"] is not None:
+        for k, v in _flatten_updater(snap["updater"]).items():
+            flat[f"updaterState/{k}"] = v
+    return flat
+
+
+def model_from_flat(conf_doc: dict, flat, load_updater: bool = True):
+    """Rebuild a model from a config document plus the merged flat
+    array map of ``snapshot_flat_arrays`` — the restore half of the
+    sharded layout, independent of how many shards the map was
+    reassembled from."""
+    sections: Dict[str, dict] = {s: {} for s in _FLAT_SECTIONS}
+    for key, arr in flat.items():
+        section, rest = key.split("/", 1)
+        if section not in sections:
+            raise ValueError(f"Unknown checkpoint shard section: {key}")
+        sections[section][rest] = arr
+    model = _build_model(conf_doc, expect=None)
+    model.init(params=_unflatten_params(sections["coefficients"]))
+    for ln, s in _unflatten_params(sections["layerState"]).items():
+        model.state[ln] = s
+    if load_updater and sections["updaterState"]:
+        model.updater_state = _unflatten_updater(
+            sections["updaterState"], model.updater_state
+        )
+    model.iteration_count = conf_doc.get("iteration_count", 0)
+    model.epoch_count = conf_doc.get("epoch_count", 0)
+    return model
 
 
 def restore_multi_layer_network(path, load_updater: bool = True):
@@ -172,7 +294,7 @@ def restore_model_from_bytes(data: bytes, load_updater: bool = True):
     return _restore(io.BytesIO(data), load_updater, expect=None)
 
 
-def _restore(path, load_updater: bool, expect: Optional[str]):
+def _build_model(doc: dict, expect: Optional[str]):
     from deeplearning4j_tpu.nn.conf.graph_conf import (
         ComputationGraphConfiguration,
     )
@@ -182,21 +304,20 @@ def _restore(path, load_updater: bool, expect: Optional[str]):
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
+    mtype = doc["model_type"]
+    if expect is not None and mtype != expect:
+        raise ValueError(f"Checkpoint holds a {mtype}, not a {expect}")
+    if mtype == "MultiLayerNetwork":
+        conf = MultiLayerConfiguration.from_dict(doc["configuration"])
+        return MultiLayerNetwork(conf)
+    conf = ComputationGraphConfiguration.from_dict(doc["configuration"])
+    return ComputationGraph(conf)
+
+
+def _restore(path, load_updater: bool, expect: Optional[str]):
     with zipfile.ZipFile(path, "r") as zf:
         doc = json.loads(zf.read(CONFIG_NAME))
-        mtype = doc["model_type"]
-        if expect is not None and mtype != expect:
-            raise ValueError(
-                f"Checkpoint holds a {mtype}, not a {expect}"
-            )
-        if mtype == "MultiLayerNetwork":
-            conf = MultiLayerConfiguration.from_dict(doc["configuration"])
-            model = MultiLayerNetwork(conf)
-        else:
-            conf = ComputationGraphConfiguration.from_dict(
-                doc["configuration"]
-            )
-            model = ComputationGraph(conf)
+        model = _build_model(doc, expect)
         params = _unflatten_params(_read_npz(zf, COEFFICIENTS_NAME))
         model.init(params=params)
         names = set(zf.namelist())

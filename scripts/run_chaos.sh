@@ -124,6 +124,22 @@ python scripts/perf_gate.py || exit 1
 #                                  to a piecewise reference, with
 #                                  ZeRO off and on (sharded moments
 #                                  gathered + re-sharded)
+#   tests/test_async_checkpoint.py — write-behind sharded checkpoints:
+#                                  a control-channel partition DURING
+#                                  the two-phase commit barrier (both
+#                                  hosts abort, agree on the previous
+#                                  committed step, torn dir GC'd);
+#                                  SIGKILL swept across the async
+#                                  write's phases single-process
+#                                  (restore lands the newest committed
+#                                  step, resume bitwise equal to the
+#                                  uninterrupted reference); the real
+#                                  2-process sharded storm, ZeRO off
+#                                  and on (rank 1 dies right after
+#                                  enqueuing its save — the commit
+#                                  either lands whole or aborts, and
+#                                  the restored shards merge bitwise
+#                                  onto a 1-device mesh)
 STORMS=(
     tests/test_resilience.py
     tests/test_serving.py
@@ -138,6 +154,7 @@ STORMS=(
     tests/test_conv_block.py
     tests/test_profiler.py
     tests/test_control_plane.py
+    tests/test_async_checkpoint.py
 )
 
 declare -a names rcs

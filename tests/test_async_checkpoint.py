@@ -1,0 +1,913 @@
+"""Asynchronous sharded checkpointing: write-behind durability,
+two-phase cross-host commit, and scrub/repair.
+
+Tier-1 coverage: async saves bitwise-identical to sync (params,
+updater state, PRNG), supersede semantics (newest wins, at most one
+in flight), fsync durability of ``atomic_write``, the sharded
+``<prefix>-<step>/`` layout with the manifest as commit point,
+corrupt-shard walk-back, scrub-quarantine and repair-from-replica
+round trips, uncommitted-directory GC, shard-aware pruning with
+``protect=``, the two-host in-process commit over the lease
+coordinator, and the ``ContinualTrainer`` async publish/resume path.
+
+Chaos storms (``scripts/run_chaos.sh``): a control-channel partition
+during the commit barrier (both hosts abort and agree on the previous
+step), a single-process SIGKILL-mid-async-save storm (restore lands on
+the newest committed step and the resumed trajectory is bitwise equal
+to the uninterrupted reference), and a REAL 2-process sharded storm
+(ZeRO on and off) where rank 1 dies right after enqueuing its save —
+the restored checkpoint must be bitwise equal to the training-thread
+state recorded at the committed step, and restore must assemble the
+shards onto a 1-device mesh.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import conftest  # noqa: F401  (pins the CPU backend)
+from tests import _multiproc
+
+from deeplearning4j_tpu.cloud.storage import LocalObjectStore
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.exceptions import (
+    CheckpointCommitAbortedException,
+    CheckpointCorruptedException,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.resilience import RetryingObjectStore
+from deeplearning4j_tpu.resilience.checkpoint import (
+    CheckpointManager,
+    LeaseCommitBarrier,
+    LocalCommitBarrier,
+)
+
+CHAOS_SEED = int(os.environ.get("DL4J_TPU_CHAOS_SEED", "1337"))
+
+
+def simple_net(seed=7):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(0.05)
+        .updater("ADAM")
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def batches(n_batches=8, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        x = rng.randn(batch, 4).astype(np.float32)
+        y = np.eye(3)[rng.randint(0, 3, batch)].astype(np.float32)
+        out.append(DataSet(features=x, labels=y))
+    return out
+
+
+def assert_trees_bitwise(a, b, what):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb, f"{what}: tree structure differs"
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y),
+            err_msg=f"{what}: leaf not bitwise equal")
+
+
+def assert_models_bitwise(a, b):
+    assert_trees_bitwise(a.params, b.params, "params")
+    assert_trees_bitwise(a.updater_state, b.updater_state, "updater")
+    np.testing.assert_array_equal(
+        np.asarray(a._base_key), np.asarray(b._base_key),
+        err_msg="PRNG base key not bitwise equal")
+    assert a.iteration_count == b.iteration_count
+
+
+# -- write-behind: bitwise equivalence + isolation ----------------------
+
+
+def test_async_save_bitwise_matches_sync(tmp_path):
+    data = batches(4)
+    m_sync, m_async = simple_net(), simple_net()
+    for ds in data[:2]:
+        m_sync.fit_minibatch(ds)
+        m_async.fit_minibatch(ds)
+
+    mgr_sync = CheckpointManager(tmp_path / "sync", mode="sync")
+    mgr_async = CheckpointManager(tmp_path / "async", mode="async")
+    info = mgr_sync.save(m_sync)
+    handle = mgr_async.save(m_async)
+    # snapshot isolation: training continues while the writer works,
+    # and the checkpoint must hold the state AT save time
+    for ds in data[2:]:
+        m_async.fit_minibatch(ds)
+    got = handle.wait(60)
+    assert got is not None and got.step == info.step
+    mgr_async.stop()
+
+    r_sync, _ = mgr_sync.restore_latest()
+    r_async, _ = mgr_async.restore_latest()
+    assert_models_bitwise(r_sync, r_async)
+    # and both match the in-memory state at the save step
+    assert_trees_bitwise(m_sync.params, r_async.params, "params")
+
+
+def test_async_supersede_newest_wins(tmp_path, monkeypatch):
+    m = simple_net()
+    data = batches(3)
+    m.fit_minibatch(data[0])
+    mgr = CheckpointManager(tmp_path, mode="async", keep_last=5)
+    gate, entered = threading.Event(), threading.Event()
+    orig = mgr._write_payload
+
+    def gated(payload):
+        entered.set()
+        assert gate.wait(30), "writer gate never opened"
+        return orig(payload)
+
+    monkeypatch.setattr(mgr, "_write_payload", gated)
+    h1 = mgr.save(m)
+    assert entered.wait(10), "writer never picked up the save"
+    m.fit_minibatch(data[1])
+    h2 = mgr.save(m)          # queued behind the in-flight write
+    m.fit_minibatch(data[2])
+    h3 = mgr.save(m)          # supersedes h2: single-slot queue
+    assert h2.wait(10) is None and h2.superseded
+    assert not h3.done()
+    gate.set()
+    assert h1.wait(60).step == h1.step
+    assert h3.wait(60).step == h3.step
+    assert mgr.latest_step() == h3.step
+    assert mgr.list_steps() == [h1.step, h3.step]  # h2 never landed
+    mgr.stop()
+
+
+def test_sync_save_drains_writer_first(tmp_path):
+    m = simple_net()
+    data = batches(2)
+    m.fit_minibatch(data[0])
+    mgr = CheckpointManager(tmp_path, mode="async", keep_last=5)
+    h = mgr.save(m)
+    m.fit_minibatch(data[1])
+    info = mgr.save(m, mode="sync")  # the emergency/preemption path
+    # the sync save ordered itself AFTER the pending async write
+    assert h.done() and h.wait(0).step == h.step
+    assert info.step > h.step
+    assert mgr.latest_step() == info.step
+    mgr.stop()
+
+
+def test_stop_flushes_and_writer_restarts(tmp_path):
+    m = simple_net()
+    m.fit_minibatch(batches(1)[0])
+    mgr = CheckpointManager(tmp_path, mode="async", keep_last=5)
+    h = mgr.save(m)
+    mgr.stop()
+    assert h.done() and mgr.latest_step() == h.step
+    # the manager stays usable: a later async save restarts the writer
+    m.fit_minibatch(batches(2)[1])
+    h2 = mgr.save(m)
+    assert h2.wait(60) is not None
+    mgr.stop()
+
+
+def test_async_metrics(tmp_path):
+    reg = MetricsRegistry()
+    m = simple_net()
+    m.fit_minibatch(batches(1)[0])
+    mgr = CheckpointManager(tmp_path, mode="async",
+                            commit=LocalCommitBarrier(), registry=reg)
+    h = mgr.save(m)
+    h.wait(60)
+    mgr.flush()
+    assert mgr._m_pending.value == 0.0
+    assert mgr._m_stall.count >= 1
+    assert mgr._m_write.count >= 1
+    assert mgr._m_commit.count >= 1
+    # async stall is the host-snapshot copy only: bounded well below
+    # the full write for any non-trivial model (here both are tiny, so
+    # just require the stall sample exists and is finite)
+    assert all(np.isfinite(v) for _, v in
+               mgr._m_stall.quantile_values() if v is not None)
+    mgr.stop()
+
+
+# -- fsync durability ---------------------------------------------------
+
+
+def test_atomic_write_and_write_model_fsync(tmp_path, monkeypatch):
+    from deeplearning4j_tpu.util import model_serializer as ms
+
+    fsyncs = []
+    real = os.fsync
+    monkeypatch.setattr(
+        ms.os, "fsync", lambda fd: (fsyncs.append(fd), real(fd))[1])
+
+    ms.atomic_write(tmp_path / "blob.bin",
+                    lambda f: f.write(b"payload"))
+    # at least the temp file AND the directory entry
+    assert len(fsyncs) >= 2
+    assert (tmp_path / "blob.bin").read_bytes() == b"payload"
+
+    n = len(fsyncs)
+    m = simple_net()
+    ms.write_model(m, tmp_path / "model.zip")
+    assert len(fsyncs) >= n + 2
+    assert (tmp_path / "model.zip").exists()
+
+
+# -- sharded layout + commit point --------------------------------------
+
+
+def test_sharded_layout_local_barrier_roundtrip(tmp_path):
+    m = simple_net()
+    data = batches(2)
+    m.fit_minibatch(data[0])
+    mgr = CheckpointManager(tmp_path, commit=LocalCommitBarrier(),
+                            keep_last=5)
+    info = mgr.save(m, artifacts={"bundle": b"aot-bytes"})
+    assert info.is_sharded and info.nshards == 1
+    d = tmp_path / info.dir
+    assert (d / "shard-0.npz").is_file()
+    assert (d / "manifest.json").is_file()
+    assert (d / "bundle.aot").is_file()  # artifacts live INSIDE the dir
+    doc = json.loads((d / "manifest.json").read_text())
+    assert doc["format"] == 2 and doc["nshards"] == 1
+    assert mgr.load_artifact(info, "bundle") == b"aot-bytes"
+
+    r, got = mgr.restore_latest()
+    assert got.step == info.step
+    assert_models_bitwise(m, r)
+
+
+def test_restore_latest_walks_past_corrupt_shard(tmp_path):
+    m = simple_net()
+    data = batches(2)
+    mgr = CheckpointManager(tmp_path, commit=LocalCommitBarrier(),
+                            keep_last=5)
+    m.fit_minibatch(data[0])
+    good = mgr.save(m)
+    m.fit_minibatch(data[1])
+    newest = mgr.save(m)
+
+    shard = tmp_path / newest.dir / "shard-0.npz"
+    raw = bytearray(shard.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    shard.write_bytes(bytes(raw))
+
+    r, got = mgr.restore_latest()
+    assert got.step == good.step  # walked back past the corrupt shard
+    with pytest.raises(CheckpointCorruptedException):
+        mgr.restore(newest)
+
+
+def test_scrub_repairs_from_replica_bitwise(tmp_path):
+    replica = RetryingObjectStore(LocalObjectStore(tmp_path / "rep"))
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(tmp_path / "ck",
+                            commit=LocalCommitBarrier(),
+                            replica_store=replica, registry=reg,
+                            keep_last=5)
+    m = simple_net()
+    m.fit_minibatch(batches(1)[0])
+    info = mgr.save(m)
+
+    shard = tmp_path / "ck" / info.dir / "shard-0.npz"
+    shard.write_bytes(b"garbage" * 100)
+    report = mgr.scrub_once()
+    assert report == {"checked": 1, "corrupt": 1, "repaired": 1,
+                      "quarantined": []}
+    assert mgr._m_scrub.value >= 1 and mgr._m_repair.value >= 1
+    assert mgr.verify(info)
+    r, _ = mgr.restore_latest()
+    assert_models_bitwise(m, r)
+
+
+def test_scrub_quarantines_without_replica(tmp_path):
+    mgr = CheckpointManager(tmp_path, commit=LocalCommitBarrier(),
+                            keep_last=5)
+    m = simple_net()
+    data = batches(2)
+    m.fit_minibatch(data[0])
+    older = mgr.save(m)
+    m.fit_minibatch(data[1])
+    newest = mgr.save(m)
+
+    (tmp_path / newest.dir / "shard-0.npz").write_bytes(b"x")
+    report = mgr.scrub_once()
+    assert report["quarantined"] == [newest.step]
+    assert mgr.is_quarantined(newest.step)
+    # restore walks back past the quarantined step
+    _, got = mgr.restore_latest()
+    assert got.step == older.step
+    # a re-save of the same step clears the marker
+    m.iteration_count = newest.step
+    again = mgr.save(m)
+    assert again.step == newest.step
+    assert not mgr.is_quarantined(newest.step)
+    _, got = mgr.restore_latest()
+    assert got.step == newest.step
+
+
+def test_restore_repairs_corrupt_shard_inline(tmp_path):
+    replica = LocalObjectStore(tmp_path / "rep")
+    mgr = CheckpointManager(tmp_path / "ck",
+                            commit=LocalCommitBarrier(),
+                            replica_store=replica, keep_last=5)
+    m = simple_net()
+    m.fit_minibatch(batches(1)[0])
+    info = mgr.save(m)
+    (tmp_path / "ck" / info.dir / "shard-0.npz").write_bytes(b"junk")
+    # restore() itself repairs from the replica before giving up
+    r = mgr.restore(info)
+    assert_models_bitwise(m, r)
+
+
+# -- GC of uncommitted directories + shard-aware pruning ----------------
+
+
+def test_uncommitted_dir_gc(tmp_path):
+    mgr = CheckpointManager(tmp_path, commit=LocalCommitBarrier(),
+                            keep_last=5)
+    m = simple_net()
+    data = batches(2)
+    m.fit_minibatch(data[0])
+    m.fit_minibatch(data[1])
+    committed = mgr.save(m)  # step 2
+
+    # a torn save BELOW the newest committed step: garbage immediately
+    torn = tmp_path / "checkpoint-00000001"
+    torn.mkdir()
+    (torn / "shard-0.npz").write_bytes(b"partial")
+    # a fresh dir ABOVE the newest commit: a peer may still be writing
+    fresh = tmp_path / "checkpoint-00000099"
+    fresh.mkdir()
+    mgr._prune()
+    assert not torn.exists()
+    assert fresh.exists()  # younger than gc_grace_s: kept
+
+    # an in-flight step is never collected, whatever its age
+    mgr.gc_grace_s = 0.0
+    with mgr._wcond:
+        mgr._active_steps.add(99)
+    mgr._prune()
+    assert fresh.exists()
+    with mgr._wcond:
+        mgr._active_steps.discard(99)
+    mgr._prune()
+    assert not fresh.exists()
+    # the committed checkpoint is untouched throughout
+    assert mgr.latest_step() == committed.step
+
+
+def test_prune_shard_aware_with_protect(tmp_path):
+    m = simple_net()
+    data = batches(3)
+    protected_steps = set()
+    mgr = CheckpointManager(tmp_path, commit=LocalCommitBarrier(),
+                            keep_last=1, protect=lambda: protected_steps)
+    m.fit_minibatch(data[0])
+    first = mgr.save(m)
+    protected_steps.add(first.step)
+    m.fit_minibatch(data[1])
+    second = mgr.save(m)
+    m.fit_minibatch(data[2])
+    third = mgr.save(m)
+
+    assert mgr.list_steps() == [first.step, third.step]
+    # whole-directory removal: no orphan shard files of the pruned step
+    assert not (tmp_path / f"checkpoint-{second.step:08d}").exists()
+    # the protected step keeps its shards AND manifest intact
+    pd = tmp_path / first.dir
+    assert (pd / "shard-0.npz").is_file()
+    assert (pd / "manifest.json").is_file()
+    r = mgr.restore(first)
+    assert r.iteration_count == first.step
+    assert third.step == mgr.latest_step()
+
+
+# -- two-host commit over the lease coordinator (in-process) ------------
+
+
+def _join_all(agents):
+    ts = [threading.Thread(target=a.join, kwargs={"timeout_s": 10})
+          for a in agents]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(20)
+
+
+def test_two_host_commit_and_sharded_restore(tmp_path):
+    from deeplearning4j_tpu.parallel.control_plane import (
+        LeaseState, LocalTransport, WorkerAgent,
+    )
+
+    m = simple_net()
+    for ds in batches(2):
+        m.fit_minibatch(ds)
+    state = LeaseState(2, lease_s=10.0)
+    agents = [WorkerAgent(LocalTransport(state), rank_hint=r)
+              for r in range(2)]
+    _join_all(agents)
+    mgrs = [CheckpointManager(tmp_path,
+                              commit=LeaseCommitBarrier(a),
+                              keep_last=5)
+            for a in agents]
+    infos = [None, None]
+
+    def save(r):
+        infos[r] = mgrs[r].save(m)
+
+    ts = [threading.Thread(target=save, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert all(i is not None for i in infos)
+    assert infos[0].nshards == 2 and infos[1].nshards == 2
+    assert sorted(infos[0].shards) == ["0", "1"]
+    # every host ends up holding the SAME committed manifest
+    assert infos[0].to_manifest() == infos[1].to_manifest()
+    # restore assembles both shards onto this (single-process) mesh
+    r, got = mgrs[0].restore_latest()
+    assert got.step == infos[0].step
+    assert_models_bitwise(m, r)
+
+
+@pytest.mark.chaos
+def test_storm_partition_during_commit_aborts_both(tmp_path):
+    """Control-channel partition DURING the commit barrier: both hosts
+    must abort (no torn manifest), agree on the previous committed
+    step, and GC must collect the uncommitted shard directory."""
+    from deeplearning4j_tpu.parallel.control_plane import (
+        LeaseState, LocalTransport, WorkerAgent,
+    )
+    from deeplearning4j_tpu.resilience.chaos import (
+        ChaosPolicy, ControlChannelChaos,
+    )
+
+    m = simple_net()
+    data = batches(3)
+    for ds in data[:2]:
+        m.fit_minibatch(ds)
+
+    # a committed step 2 first, through a healthy control plane
+    state = LeaseState(2, lease_s=10.0)
+    agents = [WorkerAgent(LocalTransport(state), rank_hint=r)
+              for r in range(2)]
+    _join_all(agents)
+    mgrs = [CheckpointManager(tmp_path,
+                              commit=LeaseCommitBarrier(a),
+                              keep_last=5)
+            for a in agents]
+    infos = [None, None]
+
+    def save(r):
+        infos[r] = mgrs[r].save(m)
+
+    ts = [threading.Thread(target=save, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    prev = infos[0].step
+
+    # now partition host 1's control channel and try to commit step 3
+    m.fit_minibatch(data[2])
+    state2 = LeaseState(2, lease_s=1.0)
+    agents2 = [WorkerAgent(LocalTransport(state2), rank_hint=r)
+               for r in range(2)]
+    _join_all(agents2)
+    agents2[1].transport = ControlChannelChaos(
+        LocalTransport(state2),
+        policy=ChaosPolicy(seed=CHAOS_SEED, failure_rate=0.0),
+        partition=(0.0, 10**9),
+    )
+    mgrs2 = [CheckpointManager(tmp_path,
+                               commit=LeaseCommitBarrier(a),
+                               keep_last=5)
+             for a in agents2]
+    errs = [None, None]
+
+    def save2(r):
+        try:
+            mgrs2[r].save(m)
+        except Exception as e:  # surfaced below
+            errs[r] = e
+
+    ts = [threading.Thread(target=save2, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert all(isinstance(e, CheckpointCommitAbortedException)
+               for e in errs), errs
+    # both hosts agree: the previous step is still the newest commit
+    assert mgrs2[0].latest_step() == prev
+    assert mgrs2[1].latest_step() == prev
+    # and the torn step-3 directory is garbage-collected
+    mgrs2[0].gc_grace_s = 0.0
+    mgrs2[0]._prune()
+    leftovers = [p.name for p in tmp_path.iterdir()
+                 if p.is_dir() and not (p / "manifest.json").exists()]
+    assert leftovers == []
+    _, got = mgrs2[0].restore_latest()
+    assert got.step == prev
+
+
+# -- ContinualTrainer async publish / resume ----------------------------
+
+
+def test_continual_trainer_async_publish_resumes_exactly(tmp_path):
+    from deeplearning4j_tpu.loop.trainer import ContinualTrainer
+
+    data = batches(12)
+
+    # reference: uninterrupted, no checkpointing at all
+    ref = simple_net()
+    for ds in data:
+        ref.fit_minibatch(ds)
+
+    # run A: write-behind publishes, "killed" after 6 steps
+    net_a = simple_net()
+    mgr_a = CheckpointManager(tmp_path, mode="async", keep_last=5)
+    tr_a = ContinualTrainer(net_a, mgr_a, publish_every=4)
+    tr_a.run(data[:6], publish_trailing=False)
+    assert tr_a.last_published is not None
+    assert tr_a.last_published.step == 4
+    mgr_a.stop()  # the crash happened after the writer drained
+    assert mgr_a.latest_step() == 4
+
+    # run B: resume from the async publish, finish the stream
+    net_b = simple_net()  # same conf; resume overwrites the fresh init
+    mgr_b = CheckpointManager(tmp_path, mode="async", keep_last=5)
+    tr_b = ContinualTrainer(net_b, mgr_b, publish_every=4)
+    assert tr_b.resume() == 4
+    tr_b.run(data[4:], publish_trailing=False)
+    mgr_b.stop()
+
+    assert_models_bitwise(ref, net_b)
+    assert mgr_b.latest_step() == 12
+
+
+# -- SIGKILL storms (subprocess; registered in scripts/run_chaos.sh) ----
+
+_CHILD_NET = r"""
+import numpy as np
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _make_net():
+    conf = (NeuralNetConfiguration.Builder().seed(7).learning_rate(0.05)
+            .updater("ADAM").list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _make_data(n):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        x = rng.randn(8, 4).astype(np.float32)
+        y = np.eye(3)[rng.randint(0, 3, 8)].astype(np.float32)
+        out.append(DataSet(features=x, labels=y))
+    return out
+"""
+
+_LOCAL_PREAMBLE = r"""
+# single process, no jax.distributed: gloo (the shared preamble
+# default) requires a distributed client — revert to local
+jax.config.update("jax_cpu_collectives_implementation", "none")
+_jeb.clear_backends()
+import os, pickle, signal, time
+""" + _CHILD_NET
+
+_KILL_CHILD = _LOCAL_PREAMBLE + r"""
+from deeplearning4j_tpu.resilience.checkpoint import (
+    CheckpointManager, LocalCommitBarrier)
+
+ckdir = os.environ["CK_DIR"]
+kill_at = int(os.environ["CK_KILL_AT"])
+delay_s = float(os.environ["CK_DELAY_S"])
+n = int(os.environ["CK_NBATCH"])
+
+net = _make_net()
+mgr = CheckpointManager(ckdir, keep_last=8, mode="async",
+                        commit=LocalCommitBarrier())
+for i, ds in enumerate(_make_data(n), start=1):
+    net.fit_minibatch(ds)
+    h = mgr.save(net)
+    if i == kill_at:
+        # SIGKILL lands somewhere inside the background write —
+        # delay_s sweeps the kill point across the write's phases
+        if delay_s:
+            time.sleep(delay_s)
+        os.kill(os.getpid(), signal.SIGKILL)
+    h.wait(120)
+mgr.stop()
+print("CK_DONE")
+"""
+
+_RESUME_CHILD = _LOCAL_PREAMBLE + r"""
+from deeplearning4j_tpu.resilience.checkpoint import CheckpointManager
+
+ckdir = os.environ["CK_DIR"]
+n = int(os.environ["CK_NBATCH"])
+out = os.environ["CK_OUT"]
+
+mgr = CheckpointManager(ckdir, keep_last=8)
+net, info = mgr.restore_latest()
+for ds in _make_data(n)[int(info.step):]:
+    net.fit_minibatch(ds)
+host = lambda t: jax.tree_util.tree_map(lambda a: np.array(a), t)
+with open(out, "wb") as f:
+    pickle.dump({"restored_step": int(info.step),
+                 "iteration": int(net.iteration_count),
+                 "params": host(net.params),
+                 "updater": host(net.updater_state),
+                 "rng": np.asarray(net._base_key)}, f)
+print("CK_RESUME_OK", int(info.step))
+"""
+
+_REF_CHILD = _LOCAL_PREAMBLE + r"""
+n = int(os.environ["CK_NBATCH"])
+out = os.environ["CK_OUT"]
+
+net = _make_net()
+for ds in _make_data(n):
+    net.fit_minibatch(ds)
+host = lambda t: jax.tree_util.tree_map(lambda a: np.array(a), t)
+with open(out, "wb") as f:
+    pickle.dump({"iteration": int(net.iteration_count),
+                 "params": host(net.params),
+                 "updater": host(net.updater_state),
+                 "rng": np.asarray(net._base_key)}, f)
+print("CK_REF_OK")
+"""
+
+
+def _run_child(script, env, timeout_s=300, expect_sigkill=False):
+    p = subprocess.Popen(
+        _multiproc.python_child(script),
+        env=_multiproc.child_env(env),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = p.communicate(timeout=timeout_s)
+    finally:
+        _multiproc.reap([p])
+    if expect_sigkill:
+        assert p.returncode == -9, (
+            f"child should die by SIGKILL: {p.returncode}\n"
+            f"{err[-3000:]}")
+    else:
+        assert p.returncode == 0, f"child failed:\n{err[-4000:]}"
+    return out
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_storm_sigkill_mid_async_save_bitwise_resume(tmp_path):
+    """SIGKILL at varying points inside an async sharded save: the
+    store must always hold a restorable checkpoint at the newest
+    committed step (the kill step, or the one before it when the
+    manifest never landed), and resuming from it must replay a
+    trajectory bitwise equal to the uninterrupted reference."""
+    n, kill_at = 6, 4
+    ref_pkl = tmp_path / "reference.pkl"
+    _run_child(_REF_CHILD, {"CK_NBATCH": n, "CK_OUT": ref_pkl})
+    with open(ref_pkl, "rb") as f:
+        ref = pickle.load(f)
+    assert ref["iteration"] == n
+
+    for case, delay_s in enumerate([0.0, 0.02, 0.1]):
+        ckdir = tmp_path / f"storm{case}"
+        ckdir.mkdir()
+        _run_child(_KILL_CHILD,
+                   {"CK_DIR": ckdir, "CK_KILL_AT": kill_at,
+                    "CK_DELAY_S": delay_s, "CK_NBATCH": n},
+                   expect_sigkill=True)
+        out_pkl = ckdir / "resume.pkl"
+        out = _run_child(_RESUME_CHILD,
+                         {"CK_DIR": ckdir, "CK_NBATCH": n,
+                          "CK_OUT": out_pkl})
+        assert "CK_RESUME_OK" in out
+        with open(out_pkl, "rb") as f:
+            res = pickle.load(f)
+        # every step before the kill step committed (the child waits
+        # each handle); the kill-step save itself races the SIGKILL
+        assert res["restored_step"] in (kill_at - 1, kill_at), res
+        assert res["iteration"] == n
+        assert_trees_bitwise(res["params"], ref["params"],
+                             f"delay={delay_s}: params")
+        assert_trees_bitwise(res["updater"], ref["updater"],
+                             f"delay={delay_s}: updater")
+        np.testing.assert_array_equal(
+            res["rng"], ref["rng"],
+            err_msg=f"delay={delay_s}: PRNG base key")
+
+
+_SHARD_WORKER = r"""
+import os, pickle, signal, time
+""" + _CHILD_NET + r"""
+from deeplearning4j_tpu.exceptions import (
+    CheckpointCommitAbortedException)
+from deeplearning4j_tpu.parallel.control_plane import WorkerAgent
+from deeplearning4j_tpu.parallel.mesh import (
+    build_mesh, init_distributed_elastic)
+from deeplearning4j_tpu.parallel.trainer import DistributedTrainer
+from deeplearning4j_tpu.resilience.checkpoint import (
+    CheckpointManager, LeaseCommitBarrier)
+from deeplearning4j_tpu.util.model_serializer import (
+    snapshot_flat_arrays, snapshot_model)
+
+rank = int(os.environ["CK_RANK"])
+zero = os.environ.get("CK_ZERO") == "1"
+kill_at = int(os.environ["CK_KILL_AT"])
+save_every = int(os.environ["CK_SAVE_EVERY"])
+n = int(os.environ["CK_NBATCH"])
+ckdir = os.environ["CK_DIR"]
+
+agent = WorkerAgent(os.environ["CK_CONTROL"], rank_hint=rank)
+grant = agent.join(timeout_s=60)
+agent.start_renewals()
+init_distributed_elastic(grant.jax_coordinator, grant.num,
+                         grant.rank, timeout_s=60)
+assert jax.process_count() == 2, jax.process_count()
+
+net = _make_net()
+mesh = build_mesh(data=len(jax.devices()), model=1)
+tr = DistributedTrainer(net, mesh=mesh, zero=zero)
+mgr = CheckpointManager(ckdir, keep_last=10, mode="async",
+                        commit=LeaseCommitBarrier(agent))
+recorded = {}
+prev = None
+for i, ds in enumerate(_make_data(n), start=1):
+    tr.fit_minibatch(ds)
+    if i % save_every:
+        continue
+    if prev is not None:
+        try:
+            prev.wait(120)
+        except CheckpointCommitAbortedException:
+            pass
+    # record the training-thread truth at this step; both ranks run
+    # the (collective) snapshot in lockstep, rank 0 keeps it
+    snap = snapshot_flat_arrays(snapshot_model(net))
+    if rank == 0:
+        recorded[i] = snap
+    h = mgr.save(net)
+    if rank == 1 and i == kill_at:
+        os.kill(os.getpid(), signal.SIGKILL)
+    prev = h
+if prev is not None:
+    try:
+        prev.wait(120)
+    except CheckpointCommitAbortedException:
+        pass
+mgr.stop()
+if rank == 0:
+    with open(os.path.join(ckdir, "rank0_recorded.pkl"), "wb") as f:
+        pickle.dump({s: {k: np.array(v) for k, v in d.items()}
+                     for s, d in recorded.items()}, f)
+agent.close()
+print("CK_OK rank=%d" % rank)
+"""
+
+
+def _sharded_sigkill_storm(tmp_path, zero):
+    """Rank 1 SIGKILLs itself right after enqueuing its kill-step
+    save: phase 1 of the two-phase commit cannot complete without its
+    shard digest (or completes and the manifest lands — both legal),
+    so rank 0 either commits or aborts, never publishes a manifest
+    over a missing shard. The restored checkpoint must be bitwise
+    equal to the state recorded on the training thread at that step,
+    and restore must assemble both shards onto a 1-device mesh."""
+    from deeplearning4j_tpu.parallel.control_plane import (
+        LeaseCoordinator,
+    )
+
+    n, save_every, kill_at = 6, 2, 6
+    ckdir = tmp_path / f"shard_zero{int(zero)}"
+    ckdir.mkdir()
+    base_env = {
+        "CK_ZERO": "1" if zero else "0", "CK_KILL_AT": kill_at,
+        "CK_SAVE_EVERY": save_every, "CK_NBATCH": n, "CK_DIR": ckdir,
+    }
+    cmd = _multiproc.python_child(_SHARD_WORKER)
+    results = None
+    for attempt in range(3):
+        coord = LeaseCoordinator(
+            2, lease_s=1.0, barrier_timeout_s=30.0).start()
+        procs = [
+            subprocess.Popen(
+                cmd,
+                env=_multiproc.child_env(dict(
+                    base_env, CK_RANK=rank,
+                    CK_CONTROL=coord.address)),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            for rank in range(2)
+        ]
+        results = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=300)
+                results.append((p.returncode, out, err))
+        finally:
+            _multiproc.reap(procs)
+            coord.stop()
+        if not any(rc not in (0, -9)
+                   and _multiproc.looks_like_bind_race(err)
+                   for rc, _, err in results):
+            break
+
+    (rc0, out0, err0), (rc1, out1, err1) = results
+    assert rc1 == -9, (
+        f"rank1 should die by SIGKILL: {rc1}\n{err1[-2000:]}")
+    assert rc0 == 0, f"rank0 failed:\n{err0[-4000:]}"
+    assert "CK_OK rank=0" in out0
+
+    mgr = CheckpointManager(ckdir, keep_last=10)
+    steps = mgr.list_steps()
+    # every pre-kill save committed; the kill-step one races the kill
+    assert {2, 4} <= set(steps), steps
+    latest = steps[-1]
+    assert latest in (kill_at - save_every, kill_at), steps
+
+    # the committed manifest names both shards, and their merged
+    # contents are bitwise the training-thread state at that step
+    info = [i for i in mgr.available() if i.step == latest][-1]
+    assert info.nshards == 2 and sorted(info.shards) == ["0", "1"]
+    flat = {}
+    for _, ent in sorted(info.shards.items(),
+                         key=lambda kv: int(kv[0])):
+        with np.load(ckdir / info.dir / ent["file"],
+                     allow_pickle=False) as z:
+            for k in z.files:
+                flat[k] = z[k]
+    with open(ckdir / "rank0_recorded.pkl", "rb") as f:
+        recorded = pickle.load(f)
+    want = recorded[latest]
+    assert set(flat) == set(want)
+    for k in sorted(flat):
+        np.testing.assert_array_equal(flat[k], want[k], err_msg=k)
+
+    # a torn kill-step directory is invisible to restore and GC'd
+    mgr.gc_grace_s = 0.0
+    mgr._prune()
+    leftovers = [p.name for p in ckdir.iterdir()
+                 if p.is_dir()
+                 and not (p / "manifest.json").exists()]
+    assert leftovers == []
+
+    # restore assembles the shards onto a 1-device mesh and resumes;
+    # two independent resumes must agree bitwise (deterministic
+    # restore + replay)
+    dumps = []
+    for trial in range(2):
+        out_pkl = ckdir / f"resume{trial}.pkl"
+        out = _run_child(_RESUME_CHILD,
+                         {"CK_DIR": ckdir, "CK_NBATCH": n,
+                          "CK_OUT": out_pkl})
+        assert "CK_RESUME_OK" in out
+        with open(out_pkl, "rb") as f:
+            dumps.append(pickle.load(f))
+    assert dumps[0]["restored_step"] == latest
+    assert dumps[0]["iteration"] == n
+    assert_trees_bitwise(dumps[0]["params"], dumps[1]["params"],
+                         "resume determinism: params")
+    assert_trees_bitwise(dumps[0]["updater"], dumps[1]["updater"],
+                         "resume determinism: updater")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_storm_sigkill_sharded_two_process(tmp_path):
+    _sharded_sigkill_storm(tmp_path, zero=False)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_storm_sigkill_sharded_two_process_zero(tmp_path):
+    _sharded_sigkill_storm(tmp_path, zero=True)
