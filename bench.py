@@ -1520,6 +1520,40 @@ def bench_zero_sharding(budget_s=None) -> dict:
     }
 
 
+def bench_megastep(budget_s=None) -> dict:
+    """Megastep-epochs A/B via the standalone training script
+    (subprocess — per-step fit vs K=6 steps fused into one dispatch
+    behind the chunk-mode double-buffered prefetch, on an I/O-bound
+    iterator). Reports the script's ``megastep`` payload; the
+    acceptance gates are ``dispatches_per_step_megastep`` <= 1.5/K
+    (flight-recorder records per optimizer step — the one-dispatch-
+    per-chunk claim), ``input_stall_fraction_megastep`` < 0.05 (the
+    double-buffered feed keeps the fused dispatch fed), and the
+    BITWISE ``trajectory_match`` vs the per-step reference — rolled
+    up as ``megastep_ok``."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_training.py",
+    )
+    timeout = 300
+    if budget_s is not None:
+        timeout = max(30, min(timeout, int(budget_s)))
+    out = subprocess.run(
+        [sys.executable, script, "--steps", "36", "--io-ms", "0",
+         "--windows", "3", "--megastep", "6"],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ,
+             "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE or "",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_training --megastep failed: {out.stderr[-2000:]}"
+        )
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    return doc.get("megastep", {})
+
+
 def bench_data_defense(budget_s=None) -> dict:
     """Bad-data defense A/B via the standalone training script
     (subprocess — it builds its own nets, validator, quarantine store
@@ -1951,6 +1985,13 @@ def _section_table(budget_fn):
          "(scripts/bench_training.py --zero --grad-accum 4; bitwise "
          "trajectory_match and updater_bytes_ratio <= 0.25 are the "
          "gates)"),
+        ("megastep",
+         lambda: bench_megastep(budget_fn()),
+         "megastep epochs: per-step fit vs K=6 steps fused into one "
+         "dispatch behind the double-buffered chunk feed "
+         "(scripts/bench_training.py --megastep 6; dispatches/step "
+         "<= 1.5/K, input stall < 5% and bitwise trajectory_match "
+         "are the gates)"),
         ("data_defense",
          lambda: bench_data_defense(budget_fn()),
          "bad-data defense clean-path A/B: validator + statistical "

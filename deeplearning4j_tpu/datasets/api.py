@@ -181,6 +181,56 @@ class PlacedDataSet:
 
 
 @dataclass
+class PlacedChunk:
+    """A block of k same-shaped minibatches stacked ``[k, b, ...]``
+    AND already placed on device — the double-buffered feed payload of
+    the megastep executor. A ``PrefetchIterator`` in chunk-stacking
+    mode assembles the next block and runs its ``chunk_placement``
+    (stack + ``device_put``, e.g. ``DistributedTrainer.place_chunk``)
+    on the worker thread while the device executes the current
+    megastep, so the K-step dispatch never waits on a host->device
+    copy. ``num_rows`` counts valid examples across all k steps (the
+    examples/sec signal)."""
+
+    features: object          # [k, b, ...] device array (or list)
+    labels: object
+    features_mask: object = None
+    labels_mask: object = None
+    num_rows: Optional[int] = None
+
+    @property
+    def k(self) -> int:
+        first = self.features
+        if isinstance(first, (list, tuple)):
+            first = first[0]
+        return int(np.shape(first)[0])
+
+    def num_examples(self) -> int:
+        if self.num_rows is not None:
+            return int(self.num_rows)
+        first = self.features
+        if isinstance(first, (list, tuple)):
+            first = first[0]
+        s = np.shape(first)
+        return int(s[0]) * int(s[1])
+
+    def to_datasets(self) -> List["DataSet"]:
+        """Unstack into k per-batch DataSets (device slices) — the
+        per-step fallback for trailing partial blocks."""
+        def at(a, i):
+            return None if a is None else a[i]
+
+        return [
+            DataSet(
+                features=at(self.features, i), labels=at(self.labels, i),
+                features_mask=at(self.features_mask, i),
+                labels_mask=at(self.labels_mask, i),
+            )
+            for i in range(self.k)
+        ]
+
+
+@dataclass
 class MultiDataSet:
     """Multi-input/multi-output container (reference nd4j MultiDataSet,
     consumed by ComputationGraph)."""

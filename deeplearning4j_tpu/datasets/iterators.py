@@ -36,6 +36,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._exception: Optional[BaseException] = None
         self._pending_exc: Optional[BaseException] = None
         self._next_item = None
+        self._needs_advance = False
         self._started = False
 
     # -- internals -----------------------------------------------------
@@ -69,6 +70,7 @@ class AsyncDataSetIterator(DataSetIterator):
         )
         self._thread.start()
         self._started = True
+        self._needs_advance = False
         self._advance()
 
     def _advance(self) -> None:
@@ -88,6 +90,15 @@ class AsyncDataSetIterator(DataSetIterator):
     def has_next(self) -> bool:
         if not self._started:
             self._start()
+        elif self._needs_advance:
+            # deferred take (see next()): block for the following item
+            # only now, AFTER the consumer has processed the previous
+            # one — an eager advance inside next() would stall the
+            # consumer on item N+1's production before it could even
+            # start working on item N, fully serializing a producer
+            # that is slower than the consumer
+            self._needs_advance = False
+            self._advance()
         return self._next_item is not None or self._pending_exc is not None
 
     def next(self) -> DataSet:
@@ -97,7 +108,8 @@ class AsyncDataSetIterator(DataSetIterator):
             exc, self._pending_exc = self._pending_exc, None
             raise exc
         ds = self._next_item
-        self._advance()
+        self._next_item = None
+        self._needs_advance = True
         return ds
 
     def reset(self) -> None:
@@ -106,6 +118,7 @@ class AsyncDataSetIterator(DataSetIterator):
             self.base.reset()
         self._started = False
         self._next_item = None
+        self._needs_advance = False
 
     def shutdown(self, timeout: float = 5.0) -> None:
         """Cancel and join the worker (reference ``shutdown()``). Safe

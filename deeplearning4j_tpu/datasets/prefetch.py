@@ -79,6 +79,111 @@ class _PlacingIterator:
             self.base.reset()
 
 
+def _chunk_sig(ds):
+    """Shape signature deciding which batches may stack into one
+    megastep block (np.shape only — never materializes a device
+    array)."""
+    import numpy as np
+
+    def sh(a):
+        return None if a is None else tuple(np.shape(a))
+
+    return (
+        sh(getattr(ds, "features", None)),
+        sh(getattr(ds, "labels", None)),
+        sh(getattr(ds, "labels_mask", None)),
+        sh(getattr(ds, "features_mask", None)),
+    )
+
+
+def _stack_host_chunk(batches):
+    """Default chunk assembly: np.stack k host minibatches into one
+    [k, b, ...] :class:`~.api.ChunkedDataSet` on the worker thread.
+    The consumer-side driver does the (single) host->device transfer;
+    a ``chunk_placement`` (e.g. ``DistributedTrainer.place_chunk``)
+    replaces this with stack + sharded ``device_put`` so even that
+    transfer leaves the critical path."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.api import ChunkedDataSet
+
+    def stack(get):
+        first = get(batches[0])
+        if first is None:
+            return None
+        return np.stack([np.asarray(get(b)) for b in batches])
+
+    return ChunkedDataSet(
+        features=stack(lambda b: b.features),
+        labels=stack(lambda b: b.labels),
+        features_mask=stack(lambda b: getattr(b, "features_mask", None)),
+        labels_mask=stack(lambda b: getattr(b, "labels_mask", None)),
+    )
+
+
+class _ChunkingIterator:
+    """Producer-side adapter for megastep training: assemble blocks of
+    ``k`` same-shaped minibatches ON THE WORKER THREAD and emit one
+    chunk payload per block — the double-buffered feed. While the
+    device executes the current K-step megastep, the worker is already
+    stacking (and, via ``chunk_placement``, ``device_put``-ing) the
+    NEXT block, so the fused dispatch never waits on assembly or the
+    host->device copy.
+
+    Multi-input batches (list-valued features) and shape-changing or
+    trailing partial blocks pass through as individual (optionally
+    ``placement``-placed) batches — the consumer's per-step fallback
+    keeps the trajectory identical."""
+
+    def __init__(self, base: DataSetIterator, k: int,
+                 chunk_placement: Optional[Callable],
+                 placement: Optional[Callable]):
+        self.base = base
+        self.k = int(k)
+        self.chunk_placement = chunk_placement
+        self.placement = placement
+
+    def _assemble(self, buf):
+        if self.chunk_placement is not None:
+            return self.chunk_placement(buf)
+        return _stack_host_chunk(buf)
+
+    def _passthrough(self, ds):
+        return self.placement(ds) if self.placement else ds
+
+    def __iter__(self):
+        buf, sig = [], None
+        for ds in self.base:
+            if isinstance(ds.features, (list, tuple)):
+                for b in buf:
+                    yield self._passthrough(b)
+                buf, sig = [], None
+                yield self._passthrough(ds)
+                continue
+            s = _chunk_sig(ds)
+            if buf and s != sig:
+                # a shape change ends the block early: a short block
+                # still beats per-batch feed when >= 2 stacked
+                if len(buf) >= 2:
+                    yield self._assemble(buf)
+                else:
+                    yield self._passthrough(buf[0])
+                buf = []
+            sig = s
+            buf.append(ds)
+            if len(buf) >= self.k:
+                yield self._assemble(buf)
+                buf = []
+        if len(buf) >= 2:
+            yield self._assemble(buf)
+        elif buf:
+            yield self._passthrough(buf[0])
+
+    def reset(self) -> None:
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+
 class PrefetchIterator(AsyncDataSetIterator):
     """Bounded background prefetch + optional device placement (see
     module docstring). Drop-in for any ``DataSetIterator``::
@@ -102,7 +207,9 @@ class PrefetchIterator(AsyncDataSetIterator):
 
     def __init__(self, base: DataSetIterator, queue_depth: int = 2,
                  placement: Optional[Callable] = None,
-                 registry=None, validator=None, quarantine=None):
+                 registry=None, validator=None, quarantine=None,
+                 megastep: int = 1,
+                 chunk_placement: Optional[Callable] = None):
         if queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
         self.validating = None
@@ -117,9 +224,17 @@ class PrefetchIterator(AsyncDataSetIterator):
                 self.validating = base = ValidatingIterator(
                     base, validator, quarantine=quarantine,
                 )
-        super().__init__(
-            _PlacingIterator(base, placement), queue_depth
-        )
+        self.megastep = int(megastep or 1)
+        if self.megastep > 1:
+            # chunk-stacking mode: each queue item is a whole K-batch
+            # block, assembled (and placed) on the worker — the
+            # double-buffered feed of the megastep executor
+            producer = _ChunkingIterator(
+                base, self.megastep, chunk_placement, placement
+            )
+        else:
+            producer = _PlacingIterator(base, placement)
+        super().__init__(producer, queue_depth)
         self._user_base = base
         if registry is None:
             from deeplearning4j_tpu.observability.metrics import (

@@ -786,6 +786,7 @@ def set_grad_accum(model, k) -> None:
         model.grad_accum = k
         model._jit_step = None
         model._jit_multi_step = None
+        model._jit_megastep = None
         if hasattr(model, "_jit_tbptt_multi_step"):
             model._jit_tbptt_multi_step = None
     note_grad_accum(k)
@@ -1139,6 +1140,203 @@ def build_multi_step(score_fn, updater, *, cast,
     return jax.jit(multi_step, donate_argnums=(0, 1, 2))
 
 
+# ---------------------------------------------------------------------------
+# the megastep executor: K full train steps + metric accumulation in
+# ONE XLA dispatch
+# ---------------------------------------------------------------------------
+#
+# build_multi_step fuses k steps but only for the bare step flavor
+# (no guard / telemetry / loss scale / stat guard — _can_scan_steps
+# refuses those configs). The megastep generalizes it: the scanned
+# body is the FULL build_step body (grad_step/accum_grad_step +
+# finish_step), so divergence-guard selects, the statistical guard's
+# EWMA state, and the dynamic loss-scale state all thread through the
+# scan carry, and the chunk's metrics (per-step scores, grad norms,
+# guard ok flags, plus their on-device aggregates) come back in ONE
+# readback instead of K host syncs. Because each scanned step is the
+# same math as the per-step program, the trajectory is bitwise equal
+# to the per-step loop (tier-1-asserted on both engines).
+
+
+def build_megastep(score_fn, updater, *, cast,
+                   recurrent_names: Sequence[str] = (),
+                   guarded: bool = False, telemetry: bool = False,
+                   loss_scale: bool = False, stat_guard=None,
+                   grad_accum: int = 1, zero_layout=None,
+                   flatten=None, unflatten=None,
+                   jit: bool = True) -> Callable:
+    """K optimizer steps fused into ONE XLA program, full step flavor.
+
+    Signature of the returned function::
+
+        megastep(params, upd_state, state, xs, ys, masks, fmasks,
+                 lr_stack, it0, base_key[, ls_state][, sg_state])
+        -> (params, upd_state, state, metrics, it0 + k)
+           [+ (ls_state,)][+ (sg_state,)]
+
+    ``metrics`` is the on-device accumulator dict read back once per
+    chunk by ``megastep_readback``: ``scores`` [k], ``loss_sum``,
+    ``examples``, plus ``grad_norms`` [k] under ``telemetry`` and
+    ``oks`` [k] / ``guard_trips`` under ``guarded``. Per-step rng is
+    ``fold_in(base_key, it0 + i)`` and Adam's t is ``it0 + 1 + i`` —
+    identical to the per-step loop, so the trajectory is bitwise.
+    ``flatten``/``unflatten`` override the ``zero_layout`` closures
+    (the GSPMD trainer passes sharding-pinned ones); ``jit=False``
+    returns the raw function for the trainer to wrap with explicit
+    in/out shardings."""
+    if stat_guard is not None and not guarded:
+        raise ValueError(
+            "stat_guard requires guarded=True (it shares the "
+            "divergence guard's in-jit select and ok flag)"
+        )
+    if flatten is None and unflatten is None:
+        flatten, unflatten = zero_layout_closures(zero_layout)
+    k_accum = int(grad_accum)
+
+    def body(carry, per_step):
+        params, upd_state, state, ls, sg = carry
+        x, labels, mask, fmask, lrs, t, rng = per_step
+        if cast is not None:
+            x, labels, mask, fmask = cast(x, labels, mask, fmask)
+        scale = ls["scale"] if loss_scale else None
+        if k_accum > 1:
+            (score, new_state), grads = accum_grad_step(
+                score_fn, params, state, x, labels, mask, fmask, rng,
+                k_accum, scale=scale,
+                recurrent_names=recurrent_names,
+            )
+        else:
+            (score, new_state), grads = grad_step(
+                score_fn, params, state, x, labels, mask, fmask, rng,
+                scale=scale,
+            )
+        # standard-backprop semantics: recurrent carry resets per
+        # minibatch; restoring the (empty) input entries BEFORE the
+        # guard select keeps the carry structure constant
+        new_state = dict(new_state)
+        for name in recurrent_names:
+            if name in new_state:
+                new_state[name] = state[name]
+        out = finish_step(
+            updater, grads, score, new_state, params, upd_state,
+            state, lrs, t, guarded=guarded, telemetry=telemetry,
+            ls=ls if loss_scale else None,
+            flatten=flatten, unflatten=unflatten,
+            sg=sg if stat_guard is not None else None,
+            sg_cfg=stat_guard,
+        )
+        new_params, new_upd, new_state, score = out[:4]
+        i = 4
+        per_out = {"score": score}
+        if telemetry:
+            per_out["grad_norm"] = out[i]
+            i += 1
+        new_ls = ls
+        if loss_scale:
+            new_ls = out[i]
+            i += 1
+        new_sg = sg
+        if stat_guard is not None:
+            new_sg = out[i]
+            i += 1
+        if guarded:
+            per_out["ok"] = out[i]
+        return (new_params, new_upd, new_state, new_ls, new_sg), per_out
+
+    def megastep(params, upd_state, state, xs, ys, masks, fmasks,
+                 lr_stack, it0, base_key, *extra):
+        leaf = jax.tree_util.tree_leaves(xs)[0]
+        k, rows = leaf.shape[0], leaf.shape[1]
+        ts = (it0 + 1 + jnp.arange(k)).astype(jnp.float32)
+        rngs = jax.vmap(
+            lambda i: jax.random.fold_in(base_key, i)
+        )(it0 + jnp.arange(k))
+        i = 0
+        ls = None
+        if loss_scale:
+            ls = extra[i]
+            i += 1
+        sg = extra[i] if stat_guard is not None else None
+        (params, upd_state, state, ls, sg), per = jax.lax.scan(
+            body, (params, upd_state, state, ls, sg),
+            (xs, ys, masks, fmasks, lr_stack, ts, rngs),
+        )
+        scores = per["score"]
+        metrics = {
+            "scores": scores,
+            "loss_sum": jnp.sum(scores.astype(jnp.float32)),
+            "examples": jnp.asarray(k * rows, jnp.int32),
+        }
+        if telemetry:
+            metrics["grad_norms"] = per["grad_norm"]
+        if guarded:
+            oks = per["ok"]
+            metrics["oks"] = oks
+            metrics["guard_trips"] = jnp.sum(1 - oks.astype(jnp.int32))
+        tail = ()
+        if loss_scale:
+            tail += (ls,)
+        if stat_guard is not None:
+            tail += (sg,)
+        return (params, upd_state, state, metrics, it0 + k) + tail
+
+    if not jit:
+        return megastep
+    return jax.jit(megastep, donate_argnums=(0, 1, 2))
+
+
+_MEGASTEP_GAUGE = None
+_MEGASTEP_DISPATCHES = None
+_MEGASTEP_READBACK_MS = None
+
+
+def note_megastep(k: int) -> None:
+    """Publish one fused megastep dispatch covering ``k`` steps."""
+    global _MEGASTEP_GAUGE, _MEGASTEP_DISPATCHES
+    if _MEGASTEP_GAUGE is None:
+        from deeplearning4j_tpu.observability.metrics import (
+            default_registry,
+        )
+
+        reg = default_registry()
+        _MEGASTEP_GAUGE = reg.gauge(
+            "megastep_chunk_size",
+            help="optimizer steps fused into the last megastep "
+                 "dispatch (K; trailing partial blocks show smaller)",
+        )._default()
+        _MEGASTEP_DISPATCHES = reg.counter(
+            "megastep_dispatches_total",
+            help="fused megastep dispatches executed (steps/dispatch "
+                 "= iteration delta / this delta)",
+        )._default()
+    _MEGASTEP_GAUGE.set(float(k))
+    _MEGASTEP_DISPATCHES.inc()
+
+
+def megastep_readback(metrics):
+    """THE designated host-readback site of the megastep path: one
+    device->host transfer of the chunk's accumulated metric dict.
+    ``scripts/lint_parity.py`` forbids every other host read inside
+    the per-chunk driver (``run_megastep_chunk`` /
+    ``fit_epoch_megastep``), so the host never re-enters the hot loop
+    between dispatches."""
+    global _MEGASTEP_READBACK_MS
+    if _MEGASTEP_READBACK_MS is None:
+        from deeplearning4j_tpu.observability.metrics import (
+            default_registry,
+        )
+
+        _MEGASTEP_READBACK_MS = default_registry().summary(
+            "megastep_readback_ms",
+            help="per-chunk device->host readback of the megastep "
+                 "metric accumulator (ms; one per K fused steps)",
+        )._default()
+    t0 = time.perf_counter()
+    host = jax.device_get(metrics)
+    _MEGASTEP_READBACK_MS.observe((time.perf_counter() - t0) * 1000.0)
+    return host
+
+
 def build_pretrain_step(layer, name: str, upd_def) -> Callable:
     """Jitted single-layer pretrain update; takes the layer's input
     tensor precomputed (the frozen lower stack runs once per batch,
@@ -1327,6 +1525,205 @@ def fit_epoch_scan(model, it) -> int:
     return n
 
 
+# ---------------------------------------------------------------------------
+# megastep epoch driver (K steps / dispatch, one readback / chunk)
+# ---------------------------------------------------------------------------
+
+
+def megastep_active(model) -> bool:
+    """True when the ``megastep`` knob asks for fused K-step
+    dispatches (K > 1)."""
+    return int(getattr(model, "megastep", 1) or 1) > 1
+
+
+def can_megastep(model) -> bool:
+    """Megastep eligibility. Unlike ``_can_scan_steps`` the fused
+    chunk here runs the FULL step flavor, so divergence guard,
+    telemetry, stat guard, and dynamic loss scaling all stay eligible
+    (their state threads through the scan carry). Still refused:
+    TBPTT (host-side carry between chunks), non-SGD algorithms,
+    recurrent models (conservative — per-step semantics preserved via
+    fallback), a ROLLBACK-policy guard (its host restore must
+    interrupt the trajectory mid-chunk, which a fused dispatch cannot
+    do), and listeners that neither declare
+    ``supports_batched_iterations`` nor implement ``chunk_done``."""
+    from deeplearning4j_tpu.resilience.guard import ROLLBACK
+
+    if not megastep_active(model):
+        return False
+    conf = model.conf
+    guard = getattr(model, "divergence_guard", None)
+    return (
+        getattr(conf, "iterations", 1) == 1
+        and bool(getattr(conf, "backprop", True))
+        and getattr(conf, "backprop_type", None) != "TruncatedBPTT"
+        and getattr(
+            conf, "optimization_algo", "STOCHASTIC_GRADIENT_DESCENT"
+        ) == "STOCHASTIC_GRADIENT_DESCENT"
+        and not model._recurrent_names()
+        and (guard is None or guard.policy != ROLLBACK)
+        and all(
+            getattr(l, "supports_batched_iterations", False)
+            or hasattr(l, "chunk_done")
+            for l in model.listeners
+        )
+    )
+
+
+def run_megastep_chunk(model, stacked, *, step_fn=None, extra=None,
+                       guard=None, on_restore=None, rows=None,
+                       ls_active=None, sg_active=None) -> None:
+    """One fused K-step megastep dispatch from pre-stacked device
+    arrays ``(x, y, labels_mask, features_mask, k)``, followed by THE
+    single per-chunk host readback (``megastep_readback``) and the
+    host-side fan-out of what used to be per-step work: guard policy
+    (from the read-back ok flags — consecutive-bad aborts fire at
+    most K−1 steps late), listener callbacks (``chunk_done`` when the
+    listener has one, else per-step ``iteration_done`` replayed from
+    already-host scores at zero extra syncs), and one profiler
+    record covering the chunk. ``step_fn``/``extra``/``guard``/
+    ``on_restore``/``ls_active``/``sg_active`` let the distributed
+    trainer substitute its sharded executable and its own guard's
+    step flavor; the defaults serve the single-host engines."""
+    from deeplearning4j_tpu.observability import profiler as _prof_mod
+
+    xs, ys, masks, fmasks, k = stacked
+    it0 = model.iteration_count
+    prof = _prof_mod.get_active_profiler()
+    if prof is not None:
+        prof.begin_step(it0 + k)
+    lr_stack, it0_dev = scan_consts(model, k, it0)
+    if step_fn is None:
+        if model._jit_megastep is None:
+            model._jit_megastep = model._build_megastep()
+        step_fn = model._jit_megastep
+    if extra is None:
+        extra = model._step_extra_args()
+    out = step_fn(
+        model.params, model.updater_state, model.state,
+        xs, ys, masks, fmasks, lr_stack, it0_dev, model._base_key,
+        *extra,
+    )
+    model.params, model.updater_state, model.state = out[:3]
+    metrics, it0_next = out[3], out[4]
+    i = 5
+    if ls_active is None:
+        ls_active = bool(getattr(model, "_loss_scale_active", False))
+    if sg_active is None:
+        sg_active = stat_guard_active(model)
+    if ls_active:
+        model._loss_scale_state = out[i]
+        i += 1
+    if sg_active:
+        model._stat_guard_state = out[i]
+    note_it0(model, it0_next, it0 + k)
+    model.iteration_count += k
+    note_megastep(k)
+    host = megastep_readback(metrics)
+    scores = host["scores"]
+    model._last_score = float(scores[-1])
+    if "grad_norms" in host:
+        model._last_grad_norm = float(host["grad_norms"][-1])
+    if guard is None:
+        guard = getattr(model, "divergence_guard", None)
+    if guard is not None and "oks" in host:
+        # the in-jit select already suppressed each bad update, so
+        # the trajectory needs nothing from the host — this only
+        # applies the SKIP policy's ledger/abort bookkeeping, once
+        # per chunk instead of once per step
+        for j in range(k):
+            if bool(host["oks"][j]):
+                guard.good_step()
+            else:
+                guard.bad_step(model, on_restore=on_restore,
+                               step_index=it0 + j + 1)
+    if model.listeners:
+        lt0 = time.perf_counter()
+        for listener in model.listeners:
+            cd = getattr(listener, "chunk_done", None)
+            if cd is not None:
+                cd(model, it0, k, host)
+        per_step = [l for l in model.listeners
+                    if not hasattr(l, "chunk_done")]
+        if per_step:
+            for j in range(k):
+                model._last_score = float(scores[j])
+                for listener in per_step:
+                    listener.iteration_done(model, it0 + j + 1)
+            model._last_score = float(scores[-1])
+        if prof is not None:
+            prof.note_listener_ms((time.perf_counter() - lt0) * 1e3)
+    if prof is not None:
+        prof.end_step(
+            score=model._last_score,
+            rows=rows if rows is not None else k * _chunk_rows(xs),
+            chunk=k,
+        )
+
+
+def flush_megastep(model, batches: List[Any]) -> None:
+    if len(batches) == 1:
+        model.fit_minibatch(batches[0])
+        return
+    if _wants_last_features(model):
+        model._last_features = batches[-1].features
+    run_megastep_chunk(model, model._stack_chunk(batches))
+
+
+def fit_epoch_megastep(model, it, prefetch=None) -> int:
+    """Buffer same-shaped minibatches into blocks of
+    ``model.megastep`` and run each block as one fused megastep
+    dispatch. ``ChunkedDataSet``/``PlacedChunk`` items (pre-stacked
+    [k, b, ...] payloads from a chunk-mode ``PrefetchIterator``) feed
+    the dispatch directly — the double-buffered path where the next
+    block's host->device copy overlaps the current dispatch. Partial
+    or signature-changing tails fall back to the per-step program
+    (same math — the mixed trajectory stays bitwise equal to the pure
+    per-step loop). Chunk boundaries are the preemption/emergency
+    checkpoint boundaries: an un-flushed buffer holds no dispatched
+    work, so checkpoint staleness is bounded by K−1 steps."""
+    from deeplearning4j_tpu.datasets.api import (
+        ChunkedDataSet, PlacedChunk,
+    )
+    from deeplearning4j_tpu.parallel import control_plane
+    from deeplearning4j_tpu.resilience import preemption
+
+    model._reset_recurrent_state()
+    k_target = int(model.megastep)
+    buf: List[Any] = []
+    sig = None
+    n = 0
+    for ds in it:
+        preemption.check_fit(model, prefetch=prefetch)
+        control_plane.check_fit(model)
+        if isinstance(ds, (ChunkedDataSet, PlacedChunk)):
+            if buf:
+                flush_megastep(model, buf)
+                buf, sig = [], None
+            if ds.k >= 2:
+                if _wants_last_features(model):
+                    model._last_features = ds.features[-1]
+                run_megastep_chunk(model, model._prep_prestacked(ds))
+            else:
+                for b in ds.to_datasets():
+                    model.fit_minibatch(b)
+            n += ds.k
+            continue
+        s = model._ds_scan_sig(ds)
+        if buf and s != sig:
+            flush_megastep(model, buf)
+            buf = []
+        sig = s
+        buf.append(ds)
+        n += 1
+        if len(buf) >= k_target:
+            flush_megastep(model, buf)
+            buf = []
+    if buf:
+        flush_megastep(model, buf)
+    return n
+
+
 def fit_epochs_device_cached(model, iterator, epochs: int, arrays_of,
                              extra_plan_fn=None) -> bool:
     """Multi-epoch fit over a materialized dataset with the batches
@@ -1404,7 +1801,11 @@ def fit_batches(model, iterator, epochs: int) -> None:
         model.pretrain(iterator)
     if not model.conf.backprop:
         return
-    if model._fit_epochs_device_cached(iterator, epochs):
+    # megastep=K outranks the device-cached replay: the caller asked
+    # for the fused-K executor (and its per-chunk readback contract)
+    if not can_megastep(model) and model._fit_epochs_device_cached(
+        iterator, epochs
+    ):
         return
     from deeplearning4j_tpu.parallel import control_plane
     from deeplearning4j_tpu.parallel.dispatch import (
@@ -1424,7 +1825,13 @@ def fit_batches(model, iterator, epochs: int) -> None:
                 if hasattr(listener, "on_epoch_start"):
                     listener.on_epoch_start(model)
             it = iter(iterator)
-            if model._can_scan_steps() and model.scan_chunk > 1:
+            if can_megastep(model):
+                n_batches = fit_epoch_megastep(
+                    model, it,
+                    prefetch=iterator
+                    if hasattr(iterator, "shutdown") else None,
+                )
+            elif model._can_scan_steps() and model.scan_chunk > 1:
                 n_batches = fit_epoch_scan(model, it)
             else:
                 n_batches = 0
@@ -1501,6 +1908,10 @@ def init_transforms(model, conf) -> None:
     model._batch_validator = None
     model._quarantine_store = None
     model.grad_accum = 1
+    # K>1 folds K optimizer steps into one XLA dispatch (the
+    # megastep executor); 1 = classic per-step dispatch
+    model.megastep = int(getattr(conf, "megastep", 1) or 1)
+    model._jit_megastep = None
     # {"shards": n} while the updater state lives in the zero
     # flattened-leaf layout (set/cleared by the distributed trainer's
     # placement); None = canonical per-param shapes
@@ -1508,14 +1919,22 @@ def init_transforms(model, conf) -> None:
 
 
 def set_transforms(model, scan_layers=None, remat=None,
-                   loss_scale=None) -> None:
+                   loss_scale=None, megastep=None) -> None:
     """Runtime (re)configuration of the whole-net transforms on either
     engine. ``None`` leaves a knob unchanged; changed knobs invalidate
     every compiled program that bakes them in. Transforms never change
     the math — trajectories are bitwise identical with them on or off
     (tier-1-asserted) — only the compiled program's shape (scan),
-    memory plan (remat), or f16 gradient dynamic range (loss scale)."""
+    memory plan (remat), or f16 gradient dynamic range (loss scale),
+    or how many optimizer steps one dispatch covers (megastep)."""
     changed = False
+    if megastep is not None:
+        k = int(megastep)
+        if k < 1:
+            raise ValueError(f"megastep must be >= 1, got {megastep}")
+        if k != int(getattr(model, "megastep", 1) or 1):
+            model.megastep = k
+            changed = True
     if scan_layers is not None and bool(scan_layers) != model.scan_layers:
         model.scan_layers = bool(scan_layers)
         model._layer_runs_cache = None
@@ -1534,6 +1953,7 @@ def set_transforms(model, scan_layers=None, remat=None,
     if changed:
         model._jit_step = None
         model._jit_multi_step = None
+        model._jit_megastep = None
         model._jit_output = None
         model._jit_rnn_step = None
         if hasattr(model, "_jit_tbptt_multi_step"):
@@ -1605,6 +2025,11 @@ def transform_kind_suffix(model) -> str:
         parts.append("statguard")
     if int(getattr(model, "grad_accum", 1)) > 1:
         parts.append(f"accum:{model.grad_accum}")
+    if megastep_active(model):
+        # a +mega:K executable is the K-step scanned program with a
+        # different arity and return contract than the per-step one;
+        # a stale artifact at any other K (or none) must be refused
+        parts.append(f"mega:{model.megastep}")
     if getattr(model, "_zero_layout", None):
         # a +zero executable bakes in the flattened-leaf updater
         # layout; a stale plain-step artifact must be refused, not

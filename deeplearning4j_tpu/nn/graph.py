@@ -158,12 +158,15 @@ class ComputationGraph:
     # ------------------------------------------------------------------
 
     def set_transforms(self, scan_layers=None, remat=None,
-                       loss_scale=None) -> "ComputationGraph":
+                       loss_scale=None,
+                       megastep=None) -> "ComputationGraph":
         """(Re)configure the whole-net transforms — same contract as
         ``MultiLayerNetwork.set_transforms``. ``scan_layers`` here
         scans LINEAR CHAINS of identical layer vertices (consecutive
-        topo positions, single consumer each)."""
-        core.set_transforms(self, scan_layers, remat, loss_scale)
+        topo positions, single consumer each); ``megastep=K`` folds K
+        optimizer steps into one dispatch."""
+        core.set_transforms(self, scan_layers, remat, loss_scale,
+                            megastep)
         return self
 
     @property
@@ -191,6 +194,7 @@ class ComputationGraph:
         the sequential engine."""
         self.divergence_guard = guard
         self._jit_step = None
+        self._jit_megastep = None
 
     def set_batch_validator(self, validator, quarantine=None
                             ) -> "ComputationGraph":
@@ -205,6 +209,7 @@ class ComputationGraph:
         if enabled != self._telemetry_grad_norm:
             self._telemetry_grad_norm = enabled
             self._jit_step = None
+            self._jit_megastep = None
 
     # ------------------------------------------------------------------
 
@@ -398,7 +403,7 @@ class ComputationGraph:
             stat_guard=core.stat_guard_config(self),
         )
 
-    def _build_multi_step(self):
+    def _multi_cast(self):
         multi_dtype = self._dtype()
 
         def cast(x, labels, mask, fmask):
@@ -408,10 +413,29 @@ class ComputationGraph:
                       for a in v]
             )
             return c(x), c(labels), c(mask), c(fmask)
+        return cast
 
+    def _build_multi_step(self):
         return core.build_multi_step(
-            self._score_fn(), self.updater_def, cast=cast,
+            self._score_fn(), self.updater_def,
+            cast=self._multi_cast(),
             recurrent_names=self._recurrent_names(),
+            grad_accum=self.grad_accum,
+            zero_layout=self._zero_layout,
+        )
+
+    def _build_megastep(self):
+        """K full train steps fused into one dispatch, full step
+        flavor (core.build_megastep) — same contract as the
+        sequential engine's."""
+        return core.build_megastep(
+            self._score_fn(), self.updater_def,
+            cast=self._multi_cast(),
+            recurrent_names=self._recurrent_names(),
+            guarded=self.divergence_guard is not None,
+            telemetry=self._telemetry_grad_norm,
+            loss_scale=self._loss_scale_active,
+            stat_guard=core.stat_guard_config(self),
             grad_accum=self.grad_accum,
             zero_layout=self._zero_layout,
         )
@@ -482,9 +506,10 @@ class ComputationGraph:
             stack_lists(2), len(batches),
         )
 
-    def _run_prestacked_chunk(self, ds) -> None:
-        """One fused dispatch from a single-input ChunkedDataSet's
-        [k, b, ...] arrays (same dtype contract as stack_on_device)."""
+    def _prep_prestacked(self, ds):
+        """Single-input [k, b, ...] chunk payload -> this engine's
+        stacked device 5-tuple (per-slot lists; same dtype contract
+        as stack_on_device)."""
         dtype = self._dtype()
 
         def prep(a):
@@ -493,26 +518,35 @@ class ComputationGraph:
             a = a if isinstance(a, jax.Array) else jnp.asarray(a)
             return core.cast_stacked(a, dtype)
 
+        lm = getattr(ds, "labels_mask", None)
+        fm = getattr(ds, "features_mask", None)
+        return (
+            [prep(ds.features)], [prep(ds.labels)],
+            None if lm is None else [prep(lm)],
+            None if fm is None else [prep(fm)],
+            ds.k,
+        )
+
+    def _run_prestacked_chunk(self, ds) -> None:
+        """One fused dispatch from a single-input ChunkedDataSet's
+        [k, b, ...] arrays."""
         if ds.k == 1:
             self.fit_minibatch(ds)  # fit_minibatch unstacks
             return
-        core.run_scan_chunk(self, (
-            [prep(ds.features)], [prep(ds.labels)],
-            None if ds.labels_mask is None else [prep(ds.labels_mask)],
-            None if ds.features_mask is None
-            else [prep(ds.features_mask)],
-            ds.k,
-        ))
+        core.run_scan_chunk(self, self._prep_prestacked(ds))
 
     # ------------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1,
-            grad_accum=None) -> None:
+            grad_accum=None, megastep=None) -> None:
         """Accepts a MultiDataSet/DataSet, an iterator of either, or
         (inputs, labels) lists (reference fit overloads
         ``ComputationGraph.java:614-760``). ``grad_accum=K``
-        accumulates K microbatch gradients in-jit per optimizer step
-        (same contract as ``MultiLayerNetwork.fit``)."""
+        accumulates K microbatch gradients in-jit per optimizer step;
+        ``megastep=K`` folds K optimizer steps into one dispatch
+        (same contracts as ``MultiLayerNetwork.fit``)."""
+        if megastep is not None:
+            self.set_transforms(megastep=megastep)
         if grad_accum is not None:
             if (
                 int(grad_accum) > 1

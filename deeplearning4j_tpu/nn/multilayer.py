@@ -194,17 +194,22 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
 
     def set_transforms(self, scan_layers=None, remat=None,
-                       loss_scale=None) -> "MultiLayerNetwork":
+                       loss_scale=None,
+                       megastep=None) -> "MultiLayerNetwork":
         """(Re)configure the whole-net transforms: ``scan_layers``
         (stack homogeneous layer runs under one ``lax.scan`` —
         O(depth) HLO becomes O(1), collapsing deep-stack compile
         time), ``remat`` (``none | dots_saveable | full`` activation
         rematerialization via ``jax.checkpoint`` — recompute FLOPs
-        for activation HBM), and ``loss_scale`` (dynamic loss scaling
-        for ``compute_dtype="float16"``; True = default 2**15).
-        Trajectories are bitwise identical with scan/remat on or off;
-        changed knobs invalidate the compiled programs."""
-        core.set_transforms(self, scan_layers, remat, loss_scale)
+        for activation HBM), ``loss_scale`` (dynamic loss scaling
+        for ``compute_dtype="float16"``; True = default 2**15), and
+        ``megastep`` (K>1 folds K optimizer steps + on-device metric
+        accumulation into ONE XLA dispatch, read back once per
+        chunk). Trajectories are bitwise identical with the
+        transforms on or off; changed knobs invalidate the compiled
+        programs."""
+        core.set_transforms(self, scan_layers, remat, loss_scale,
+                            megastep)
         return self
 
     @property
@@ -293,6 +298,7 @@ class MultiLayerNetwork:
         step: the guarded step returns extra outputs."""
         self.divergence_guard = guard
         self._jit_step = None
+        self._jit_megastep = None
 
     def set_batch_validator(self, validator, quarantine=None
                             ) -> "MultiLayerNetwork":
@@ -310,6 +316,7 @@ class MultiLayerNetwork:
         if enabled != self._telemetry_grad_norm:
             self._telemetry_grad_norm = enabled
             self._jit_step = None
+            self._jit_megastep = None
 
     def _multi_cast(self):
         multi_dtype = _dtype_of(self.conf)
@@ -335,6 +342,23 @@ class MultiLayerNetwork:
             self._score_fn(), self.updater_def,
             cast=self._multi_cast(),
             recurrent_names=self._recurrent_names(),
+            grad_accum=self.grad_accum,
+            zero_layout=self._zero_layout,
+        )
+
+    def _build_megastep(self) -> Callable:
+        """K full train steps fused into one dispatch — the multi
+        step's scan discipline with the FULL per-step flavor (guard /
+        telemetry / loss scale / stat guard / zero) threading through
+        the carry (core.build_megastep)."""
+        return core.build_megastep(
+            self._score_fn(), self.updater_def,
+            cast=self._multi_cast(),
+            recurrent_names=self._recurrent_names(),
+            guarded=self.divergence_guard is not None,
+            telemetry=self._telemetry_grad_norm,
+            loss_scale=self._loss_scale_active,
+            stat_guard=core.stat_guard_config(self),
             grad_accum=self.grad_accum,
             zero_layout=self._zero_layout,
         )
@@ -498,10 +522,11 @@ class MultiLayerNetwork:
             len(batches),
         )
 
-    def _run_prestacked_chunk(self, ds) -> None:
-        """One fused dispatch from a ChunkedDataSet's [k, b, ...]
-        arrays (same dtype contract as core.stack_on_device: narrow
-        ints ride as-is and cast on device)."""
+    def _prep_prestacked(self, ds):
+        """[k, b, ...] chunk payload -> the stacked device 5-tuple the
+        fused dispatch drivers take (same dtype contract as
+        core.stack_on_device: narrow ints ride as-is and cast on
+        device; already-placed device arrays pass through)."""
         dtype = _dtype_of(self.conf)
 
         def prep(a):
@@ -510,6 +535,15 @@ class MultiLayerNetwork:
             a = a if isinstance(a, jax.Array) else jnp.asarray(a)
             return _cast_stacked(a, dtype)
 
+        return (
+            prep(ds.features), prep(ds.labels),
+            prep(getattr(ds, "labels_mask", None)),
+            prep(getattr(ds, "features_mask", None)), ds.k,
+        )
+
+    def _run_prestacked_chunk(self, ds) -> None:
+        """One fused dispatch from a ChunkedDataSet's [k, b, ...]
+        arrays."""
         k = ds.k
         if k == 1:
             from deeplearning4j_tpu.datasets.api import DataSet
@@ -525,10 +559,7 @@ class MultiLayerNetwork:
             return
         if self._wants_last_features():
             self._last_features = ds.features[-1]
-        core.run_scan_chunk(self, (
-            prep(ds.features), prep(ds.labels), prep(ds.labels_mask),
-            prep(ds.features_mask), k,
-        ))
+        core.run_scan_chunk(self, self._prep_prestacked(ds))
 
     # ------------------------------------------------------------------
     # public API (reference fit/output/score)
@@ -554,7 +585,8 @@ class MultiLayerNetwork:
         return step
 
     def fit(self, data, labels=None, *, epochs: int = 1,
-            resume_from=None, grad_accum=None) -> None:
+            resume_from=None, grad_accum=None,
+            megastep=None) -> None:
         """fit(DataSetIterator) / fit(x, y) (reference ``fit:1048``).
 
         ``data`` may be a DataSetIterator-style iterable of objects with
@@ -573,9 +605,19 @@ class MultiLayerNetwork:
         microbatches; BatchNormalization configs are rejected (per-
         microbatch batch stats would change the math). The knob
         persists until changed (``grad_accum=1`` restores plain steps).
+
+        ``megastep=K``: fold K consecutive optimizer steps (plus
+        on-device metric accumulation) into ONE XLA dispatch
+        (``core.build_megastep``), read back once per chunk — the
+        trajectory stays bitwise equal to the per-step loop. Persists
+        until changed (``megastep=1`` restores per-step dispatch);
+        ineligible configs (TBPTT, recurrent, rollback guard) fall
+        back to the per-step path.
         """
         from deeplearning4j_tpu.datasets.api import DataSet
 
+        if megastep is not None:
+            self.set_transforms(megastep=megastep)
         if grad_accum is not None:
             if (
                 int(grad_accum) > 1

@@ -454,11 +454,16 @@ class StepProfiler:
 
     def end_step(self, model=None, ds=None, score=None,
                  grad_norm=None, rows=None,
-                 cost: Optional[CostModel] = None) -> Optional[dict]:
+                 cost: Optional[CostModel] = None,
+                 chunk: Optional[int] = None) -> Optional[dict]:
         """Close the current step: decompose wall time, publish the
         gauges/histograms, append the flight-recorder record, and end
         the per-step span (child spans per component). Returns the
-        record dict (None when disabled / unpaired)."""
+        record dict (None when disabled / unpaired). ``chunk=K``
+        marks a fused megastep record covering K optimizer steps
+        under ONE dispatch (``step`` is then the LAST covered step) —
+        recorder-measured dispatches/step over a run is
+        records/steps, ~1/K under megastep."""
         st = self._state
         if not self.enabled or st is None:
             return None
@@ -545,6 +550,8 @@ class StepProfiler:
             rec["grad_norm"] = grad_norm
         if rows is not None:
             rec["rows"] = int(rows)
+        if chunk is not None:
+            rec["chunk"] = int(chunk)
         if cost is not None:
             rec["cost_key"] = cost.key
             if mfu is not None:

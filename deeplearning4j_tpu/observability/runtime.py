@@ -115,9 +115,11 @@ class TelemetryListener(IterationListener):
     The published value therefore trails by one sampling interval.
     ``defer_reads=False`` restores the synchronous read.
 
-    Forces the per-step fit path (like ``ProfilerListener``): under
-    the fused ``lax.scan`` path all callbacks fire after one chunk
-    dispatch, so per-step timing would be fiction.
+    Forces the per-step fit path under scan-chunked epochs (like
+    ``ProfilerListener``): there all callbacks would fire after one
+    chunk dispatch, so per-step timing would be fiction. Under
+    MEGASTEP epochs the listener rides ``chunk_done`` instead — one
+    honest per-chunk sample from the driver's single readback.
     """
 
     supports_batched_iterations = False
@@ -239,6 +241,49 @@ class TelemetryListener(IterationListener):
                 self._publish_sample(*pending)
         else:
             self._publish_sample(loss_ref, gn_ref)
+        self._publish_transforms(model)
+        if self.publish_memory:
+            publish_device_memory(self.registry)
+
+    def chunk_done(self, model, it0: int, k: int, metrics) -> None:
+        """Megastep cadence: ONE callback per fused K-step chunk, fed
+        the chunk's already-host metric dict — publishing here costs
+        ZERO extra device syncs (the driver's single per-chunk
+        readback paid them all). Counters advance by the whole chunk,
+        the loss/grad-norm gauges publish the chunk's last step, and
+        the transform gauges + memory stats stay frequency-gated in
+        STEPS, so the one genuinely-blocking read (the loss-scale
+        device dict) still happens at most once per sampling
+        interval."""
+        now = time.perf_counter()
+        if (self.grad_norm and self.registry.enabled
+                and self._enabled_on is not model):
+            enable = getattr(model, "enable_step_telemetry", None)
+            if enable is not None:
+                enable(True)
+            self._enabled_on = model
+        if not self.registry.enabled:
+            self._last_time = now
+            return
+        rows = int(metrics.get("examples", 0) or 0)
+        self._steps.inc(int(k))
+        if rows:
+            self._examples.inc(rows)
+        if self._last_time is not None:
+            dt = now - self._last_time
+            if k > 0:
+                self._step_ms.observe(dt * 1000.0 / k)
+            if rows and dt > 0:
+                self._eps.set(rows / dt)
+        self._last_time = now
+        if (it0 + k) // self.frequency == it0 // self.frequency:
+            return  # no sampling boundary inside this chunk
+        scores = metrics.get("scores")
+        if scores is not None and len(scores):
+            self._loss.set(float(scores[-1]))
+        gns = metrics.get("grad_norms")
+        if gns is not None and len(gns):
+            self._grad_norm.set(float(gns[-1]))
         self._publish_transforms(model)
         if self.publish_memory:
             publish_device_memory(self.registry)

@@ -211,6 +211,7 @@ class DistributedTrainer:
         self._place_params()
         self._jit_step_sm = None
         self._jit_step_gspmd = None
+        self._jit_megastep_dist = None
         # step-telemetry / loss-scale / grad-accum flags the jitted
         # steps were built against (they live on the MODEL so the same
         # hooks cover both engines); a change rebuilds the steps
@@ -383,6 +384,8 @@ class DistributedTrainer:
         # own cached steps must not be fed state in the other layout
         m._jit_step = None
         m._jit_multi_step = None
+        m._jit_megastep = None
+        self._jit_megastep_dist = None
         self._publish_updater_gauges()
 
     def _publish_updater_gauges(self) -> None:
@@ -428,6 +431,7 @@ class DistributedTrainer:
             self._built_sg = sg_now
             self._jit_step_sm = None
             self._jit_step_gspmd = None
+            self._jit_megastep_dist = None
         if self._pick_shard_map(has_masks):
             if self._jit_step_sm is None:
                 self._jit_step_sm = self._build_shard_map_step()
@@ -653,6 +657,135 @@ class DistributedTrainer:
             donate_argnums=(0, 1, 2),
         )
 
+    # -- megastep (K fused steps / dispatch) ----------------------------
+
+    def _can_megastep(self) -> bool:
+        """Megastep eligibility under this trainer: the model-side
+        checks (core.can_megastep) plus this trainer's OWN guard —
+        trainer and engine guards are separate installs, and a
+        ROLLBACK-policy guard needs the per-step program."""
+        from deeplearning4j_tpu.resilience.guard import ROLLBACK
+
+        g = self.divergence_guard
+        if g is not None and g.policy == ROLLBACK:
+            return False
+        return core.can_megastep(self.model)
+
+    def _megastep_for(self):
+        """Lazily-built fused K-step executable (knob changes rebuild
+        it — same discipline as ``_step_for``; K itself is NOT baked
+        in, the scanned program just retraces on a new chunk shape)."""
+        ls_now = core.loss_scale_active(self.model)
+        accum_now = int(getattr(self.model, "grad_accum", 1))
+        sg_now = self._sg_config() is not None
+        if (
+            self._telemetry_enabled() != self._built_telemetry
+            or ls_now != self._built_ls
+            or accum_now != self._built_accum
+            or sg_now != self._built_sg
+        ):
+            self._built_telemetry = self._telemetry_enabled()
+            self._built_ls = ls_now
+            self._built_accum = accum_now
+            self._built_sg = sg_now
+            self._jit_step_sm = None
+            self._jit_step_gspmd = None
+            self._jit_megastep_dist = None
+        if self._jit_megastep_dist is None:
+            self._jit_megastep_dist = self._build_gspmd_megastep()
+        return self._jit_megastep_dist
+
+    def _build_gspmd_megastep(self):
+        """The GSPMD flavor of ``core.build_megastep``: the same
+        scanned K-step body, jitted here with explicit shardings —
+        stacked batch blocks ride ``P(None, "data")`` (each step's
+        [b, ...] slice scattered over the data axis, exactly the
+        per-step layout), zero's flat updater moments stay ``P("data")``
+        INSIDE the scanned body, and params/state donate."""
+        ls_active = self._built_ls
+        sg_cfg = self._sg_config()
+        m = self.model
+        mesh = self.mesh
+        rep = NamedSharding(mesh, P())
+        chunk = NamedSharding(mesh, P(None, "data"))
+        if self.zero:
+            n_data = int(mesh.shape["data"])
+            flat = NamedSharding(mesh, P("data"))
+            upd_shardings = {
+                ln: {
+                    pn: tuple(flat for _ in range(len(tup)))
+                    for pn, tup in lp.items()
+                }
+                for ln, lp in m.updater_state.items()
+            }
+
+            def flatten(a):
+                # same double pin as _build_gspmd_step: stop the flat
+                # sharding from propagating backward into the grads
+                a = jax.lax.with_sharding_constraint(a, rep)
+                return jax.lax.with_sharding_constraint(
+                    core.zero_flatten_leaf(a, n_data), flat
+                )
+
+            unflatten = core.zero_unflatten_leaf
+        else:
+            upd_shardings = {
+                ln: {
+                    pn: tuple(
+                        self._param_shardings[ln][pn]
+                        for _ in range(len(tup))
+                    )
+                    for pn, tup in lp.items()
+                }
+                for ln, lp in m.updater_state.items()
+            }
+            flatten = unflatten = None
+        is_graph = self._is_graph
+
+        def score_fn(p, state, x, labels, mask, fmask, rng):
+            if is_graph:
+                return m._score_pure(
+                    p, state, x, labels, mask, rng, train=True,
+                    fmasks=fmask,
+                )
+            return m._score_pure(
+                p, state, x, labels, mask, rng, train=True,
+                fmask=fmask,
+            )
+
+        mega = core.build_megastep(
+            score_fn, m.updater_def, cast=None,
+            recurrent_names=(
+                m._recurrent_names()
+                if hasattr(m, "_recurrent_names") else ()
+            ),
+            guarded=self.divergence_guard is not None,
+            telemetry=self._built_telemetry,
+            loss_scale=ls_active, stat_guard=sg_cfg,
+            grad_accum=self._built_accum,
+            flatten=flatten, unflatten=unflatten, jit=False,
+        )
+        in_shardings = (
+            self._param_shardings, upd_shardings, rep,
+            chunk, chunk, chunk, chunk, None, None, None,
+        )
+        # out: (params, upd, state, metrics, it0+k) [+ls] [+sg]
+        out_shardings = (
+            self._param_shardings, upd_shardings, rep, rep, rep,
+        )
+        if ls_active:
+            in_shardings = in_shardings + (None,)
+            out_shardings = out_shardings + (rep,)
+        if sg_cfg is not None:
+            in_shardings = in_shardings + (None,)
+            out_shardings = out_shardings + (rep,)
+        return jax.jit(
+            mega,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=(0, 1, 2),
+        )
+
     # -- input placement ------------------------------------------------
 
     def _pad_rows(self, a, pad: int):
@@ -820,11 +953,78 @@ class DistributedTrainer:
             has_masks=has_masks,
         )
 
+    def place_chunk(self, batches):
+        """Stack k same-shaped minibatches into one [k, b, ...] block
+        and scatter it onto the mesh with ``P(None, "data")`` in ONE
+        ``device_put`` per array — the megastep feed's placement
+        (each step's [b, ...] slice lands in exactly the per-step
+        ``P("data")`` layout). Run on the prefetch worker via
+        ``PrefetchIterator(megastep=K, chunk_placement=
+        trainer.place_chunk)`` it double-buffers the feed: the next
+        block's host->device copy overlaps the current fused
+        dispatch. Accepts a list of host DataSets or a
+        ``ChunkedDataSet``; single-input models only (the chunking
+        adapter passes multi-input batches through per-step)."""
+        from deeplearning4j_tpu.datasets.api import (
+            ChunkedDataSet, PlacedChunk,
+        )
+
+        if isinstance(batches, PlacedChunk):
+            return batches
+        if isinstance(batches, ChunkedDataSet):
+            batches = batches.to_datasets()
+        batches = list(batches)
+        m = self.model
+        dtype = jnp.dtype(m.conf.dtype)
+        n_data = self.mesh.shape["data"]
+        batch_n = int(np.shape(batches[0].features)[0])
+        k_accum = int(getattr(m, "grad_accum", 1))
+        if k_accum > 1 and batch_n % (k_accum * n_data) != 0:
+            raise ValueError(
+                f"grad_accum={k_accum} on a {n_data}-wide data mesh "
+                f"needs the batch to split into {k_accum} "
+                f"microbatches of whole shards; got batch size "
+                f"{batch_n} (make it a multiple of "
+                f"{k_accum * n_data})"
+            )
+        if batch_n % n_data != 0:
+            # pad-and-mask every step of the block (all share the
+            # shape — the chunking adapter groups by signature)
+            batches = [
+                self._pad_minibatch(b, batch_n, n_data)
+                for b in batches
+            ]
+        rows = batch_n * len(batches)
+        chunk_sharding = NamedSharding(self.mesh, P(None, "data"))
+
+        def stack(get):
+            first = get(batches[0])
+            if first is None:
+                return None
+            h = np.stack([np.asarray(get(b)) for b in batches])
+            out = jax.device_put(h, chunk_sharding)
+            return out if out.dtype == dtype else out.astype(dtype)
+
+        x = stack(lambda b: b.features)
+        y = stack(lambda b: b.labels)
+        lm = stack(lambda b: getattr(b, "labels_mask", None))
+        fm = stack(lambda b: getattr(b, "features_mask", None))
+        if self._is_graph:
+            # the DAG engine's score_fn takes per-slot lists
+            x, y = [x], [y]
+            lm = None if lm is None else [lm]
+            fm = None if fm is None else [fm]
+        return PlacedChunk(
+            features=x, labels=y, labels_mask=lm,
+            features_mask=fm, num_rows=rows,
+        )
+
     # -- public API -----------------------------------------------------
 
     def fit(self, iterator, epochs: int = 1,
             prefetch: Optional[int] = None,
             grad_accum: Optional[int] = None,
+            megastep: Optional[int] = None,
             validator=None, quarantine=None) -> list:
         """Fit ``epochs`` passes of ``iterator``, pipelined: batch
         materialization + sharded placement can run on a prefetch
@@ -861,6 +1061,11 @@ class DistributedTrainer:
             # in-jit microbatch accumulation (core.accum_grad_step);
             # _step_for notices the knob change and rebuilds the step
             core.set_grad_accum(m, grad_accum)
+        if megastep is not None:
+            # K fused steps per dispatch (core.build_megastep); the
+            # knob persists on the model like grad_accum
+            core.set_transforms(m, megastep=megastep)
+        use_mega = self._can_megastep()
         if validator is None:
             validator = getattr(m, "_batch_validator", None)
         if validator is not None:
@@ -882,9 +1087,16 @@ class DistributedTrainer:
             )
 
             if not isinstance(iterator, PrefetchIterator):
+                # under megastep the worker assembles whole K-blocks
+                # and place_chunk scatters each while the previous
+                # block's fused dispatch runs (double-buffered feed)
                 source = owned_prefetch = PrefetchIterator(
                     iterator, queue_depth=int(prefetch),
                     placement=self.place_minibatch,
+                    megastep=(
+                        int(m.megastep) if use_mega else 1
+                    ),
+                    chunk_placement=self.place_chunk,
                 )
         window = AsyncDispatchWindow(
             model=m, guard_fn=lambda: self.divergence_guard,
@@ -912,19 +1124,26 @@ class DistributedTrainer:
                         listener.on_epoch_start(m)
                 scores = []
                 try:
-                    for ds in iter(source):
-                        # preemption notice -> drain window + shut
-                        # down the prefetch worker + emergency
-                        # checkpoint, then PreemptedException
-                        preemption.check_fit(
-                            m, window=window,
-                            prefetch=source
-                            if hasattr(source, "shutdown") else None,
+                    if use_mega:
+                        scores = self._fit_epoch_megastep(
+                            source, window
                         )
-                        control_plane.check_fit(m)
-                        scores.append(
-                            self.fit_minibatch(ds, _window=window)
-                        )
+                    else:
+                        for ds in iter(source):
+                            # preemption notice -> drain window +
+                            # shut down the prefetch worker +
+                            # emergency checkpoint, then
+                            # PreemptedException
+                            preemption.check_fit(
+                                m, window=window,
+                                prefetch=source
+                                if hasattr(source, "shutdown")
+                                else None,
+                            )
+                            control_plane.check_fit(m)
+                            scores.append(
+                                self.fit_minibatch(ds, _window=window)
+                            )
                     window.drain()  # guard aborts surface here
                 finally:
                     if hasattr(source, "reset"):
@@ -1026,6 +1245,107 @@ class DistributedTrainer:
             )
         return score  # 0-d device array; float() to sync
 
+    def _fit_epoch_megastep(self, source, window) -> list:
+        """One megastep epoch: group the stream into K-blocks (or
+        consume pre-assembled ``ChunkedDataSet``/``PlacedChunk``
+        payloads from a chunk-mode prefetch) and run each as one
+        fused dispatch via ``fit_megachunk``; shape-changing or
+        trailing partials fall back to the per-step program — same
+        math, so the mixed trajectory stays bitwise. Chunk boundaries
+        are the preemption-checkpoint boundaries (staleness <= K-1
+        steps)."""
+        from deeplearning4j_tpu.datasets.api import (
+            ChunkedDataSet, PlacedChunk, PlacedDataSet,
+        )
+        from deeplearning4j_tpu.datasets.prefetch import _chunk_sig
+        from deeplearning4j_tpu.parallel import control_plane
+        from deeplearning4j_tpu.resilience import preemption
+
+        m = self.model
+        k_target = int(m.megastep)
+        scores = []
+        buf = []
+        sig = None
+
+        def flush():
+            nonlocal buf
+            if len(buf) == 1:
+                scores.append(self.fit_minibatch(buf[0], _window=window))
+            elif buf:
+                # the chunk's guard flags are applied synchronously
+                # from its readback: settle the per-step backlog
+                # first so guard bookkeeping stays ordered
+                window.drain()
+                scores.append(self.fit_megachunk(self.place_chunk(buf)))
+            buf = []
+
+        for ds in iter(source):
+            preemption.check_fit(
+                m, window=window,
+                prefetch=source
+                if hasattr(source, "shutdown") else None,
+            )
+            control_plane.check_fit(m)
+            if isinstance(ds, (ChunkedDataSet, PlacedChunk)):
+                flush()
+                sig = None
+                if ds.k >= 2:
+                    window.drain()
+                    scores.append(self.fit_megachunk(ds))
+                else:
+                    for b in ds.to_datasets():
+                        scores.append(
+                            self.fit_minibatch(b, _window=window)
+                        )
+                continue
+            if isinstance(ds, PlacedDataSet) or isinstance(
+                ds.features, (list, tuple)
+            ):
+                # already-placed singles (chunk-mode passthrough) and
+                # multi-input batches take the per-step program
+                flush()
+                sig = None
+                scores.append(self.fit_minibatch(ds, _window=window))
+                continue
+            s = _chunk_sig(ds)
+            if buf and s != sig:
+                flush()
+            sig = s
+            buf.append(ds)
+            if len(buf) >= k_target:
+                flush()
+        flush()
+        return scores
+
+    def fit_megachunk(self, chunk) -> float:
+        """One fused K-step dispatch from a placed (or host-stacked)
+        block. Returns the block's last score as a host float — the
+        chunk's single readback already paid that sync."""
+        from deeplearning4j_tpu.datasets.api import PlacedChunk
+
+        step = self._megastep_for()  # may refresh the _built_* flags
+        if not isinstance(chunk, PlacedChunk):
+            chunk = self.place_chunk(chunk)
+        m = self.model
+        extra = (
+            (core.ensure_loss_scale_state(m),) if self._built_ls
+            else ()
+        )
+        if self._built_sg:
+            extra = extra + (core.ensure_stat_guard_state(m),)
+        core.run_megastep_chunk(
+            m,
+            (chunk.features, chunk.labels, chunk.labels_mask,
+             chunk.features_mask, chunk.k),
+            step_fn=step, extra=extra,
+            guard=self.divergence_guard,
+            on_restore=self._place_params,
+            rows=chunk.num_rows,
+            ls_active=self._built_ls, sg_active=self._built_sg,
+        )
+        m._last_batch_rows = chunk.num_rows
+        return float(m._last_score)
+
     def set_divergence_guard(self, guard) -> None:
         """(Un)install a resilience.DivergenceGuard; the jitted steps
         are rebuilt on next use because the guarded step has an extra
@@ -1034,6 +1354,7 @@ class DistributedTrainer:
         self.model._ckpt_guard = guard
         self._jit_step_sm = None
         self._jit_step_gspmd = None
+        self._jit_megastep_dist = None
 
     def resume(self, source, load_updater: bool = True) -> int:
         """Resume training from a checkpoint: restore params, updater

@@ -170,6 +170,48 @@ class StatsListener(IterationListener):
             if self._last_time is not None else 0.0
         )
         self._last_time = now
+        rec = self._build_report(model, iteration, duration_ms)
+        score_ref = getattr(model, "_last_score", None)
+        if self.defer_score_read:
+            # publish the PREVIOUS sampled report now (its score ref
+            # completed long ago — reading it is a copy, not a
+            # dispatch stall), park this one until the next sample
+            # or flush()/on_epoch_end
+            pending = self._pending_report
+            self._pending_report = (rec, score_ref)
+            if pending is not None:
+                self._publish(*pending)
+        else:
+            self._publish(rec, score_ref)
+
+    def chunk_done(self, model, it0: int, k: int, metrics) -> None:
+        """Megastep cadence: at most ONE report per fused K-step
+        chunk (when a sampling boundary falls inside it). The chunk's
+        scores arrive already host-side from the driver's single
+        readback, so the report publishes immediately — no deferred
+        score read, no extra device sync; the blocking param-stat
+        reads stay gated by ``collect_param_stats`` as per-step."""
+        if not self._init_sent:
+            self._send_init(model)
+        if (it0 + k) // self.frequency == it0 // self.frequency:
+            return  # no sampling boundary inside this chunk
+        now = time.perf_counter()
+        duration_ms = (
+            (now - self._last_time) * 1000.0 / k
+            if self._last_time is not None else 0.0
+        )
+        self._last_time = now
+        rec = self._build_report(model, it0 + k, duration_ms)
+        scores = metrics.get("scores") if hasattr(metrics, "get") \
+            else None
+        score = (
+            float(scores[-1])
+            if scores is not None and len(scores) else float("nan")
+        )
+        self._publish(rec, score)
+
+    def _build_report(self, model, iteration: int,
+                      duration_ms: float) -> "StatsReport":
         lrs = {}
         for i, layer in enumerate(getattr(model.conf, "layers", [])):
             lrs[getattr(layer, "name", "") or str(i)] = float(
@@ -214,18 +256,7 @@ class StatsListener(IterationListener):
             update_mean_magnitudes=update_mags,
             param_histograms=histograms,
         )
-        score_ref = getattr(model, "_last_score", None)
-        if self.defer_score_read:
-            # publish the PREVIOUS sampled report now (its score ref
-            # completed long ago — reading it is a copy, not a
-            # dispatch stall), park this one until the next sample
-            # or flush()/on_epoch_end
-            pending = self._pending_report
-            self._pending_report = (rec, score_ref)
-            if pending is not None:
-                self._publish(*pending)
-        else:
-            self._publish(rec, score_ref)
+        return rec
 
     def _publish(self, rec, score_ref) -> None:
         try:

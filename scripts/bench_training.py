@@ -37,7 +37,11 @@ Optional A/B riders on the same seeded batches: ``--remat`` (policy
 off vs on), ``--zero`` (replicated vs ZeRO-sharded optimizer state —
 steps/sec, per-device updater bytes, bitwise trajectory),
 ``--grad-accum K`` (accum=1 vs K in-jit microbatches — steps/sec +
-trajectory vs the single-big-batch run), and ``--defense`` (data-
+trajectory vs the single-big-batch run), ``--megastep K`` (per-step
+fit vs K steps fused into ONE dispatch behind the chunk-mode
+double-buffered prefetch — steps/sec, flight-recorder
+dispatches/step <= 1.5/K, residual input-stall < 5 %, bitwise
+trajectory), and ``--defense`` (data-
 plane defense off vs fully on — clean-path overhead gated <= 5 %,
 zero quarantines on a clean stream, and the no-trip bitwise
 contracts; a gate failure exits nonzero).
@@ -323,6 +327,162 @@ def _grad_accum_ab(batches, k, windows, seed) -> dict:
     return out
 
 
+def _upd_flat(net):
+    import jax
+
+    leaves = [
+        np.asarray(leaf).ravel()
+        for leaf in jax.tree_util.tree_leaves(net.updater_state)
+    ]
+    return np.concatenate(leaves) if leaves else np.zeros(0)
+
+
+def _megastep_ab(batches, k, windows, seed, io_ms,
+                 queue_depth) -> dict:
+    """Megastep A/B through the GSPMD trainer on the SAME seeded
+    batches behind an I/O-bound iterator: the per-step fit (plain
+    prefetch) vs ``megastep=K`` (chunk-mode prefetch: the worker
+    stacks + places the NEXT K-batch block while the device runs the
+    current fused dispatch). Reports steps/sec, flight-recorder
+    dispatches/step (records over optimizer steps — ~1 per step vs
+    ~1/K under megastep, gated at <= 1.5/K), the STEADY-STATE
+    input-stall fraction of the double-buffered feed (per-take waits
+    excluding the first take — the one-time pipeline fill, reported
+    separately as ``pipeline_fill_ms``, amortizes over a real epoch
+    but dominates a seconds-long bench window; gated < 5 %), and the
+    BITWISE trajectory (params + updater state) vs the per-step
+    reference."""
+    import jax
+
+    from deeplearning4j_tpu.datasets.api import ListDataSetIterator
+    from deeplearning4j_tpu.datasets.prefetch import PrefetchIterator
+    from deeplearning4j_tpu.nn import core
+    from deeplearning4j_tpu.observability import profiler as prof_mod
+    from deeplearning4j_tpu.observability.flightrec import (
+        FlightRecorder,
+    )
+    from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+    from deeplearning4j_tpu.parallel import (
+        DistributedTrainer, build_mesh,
+    )
+
+    steps = len(batches)
+
+    def mk(kk):
+        net = _make_net(seed=seed)
+        tr = DistributedTrainer(net, mesh=build_mesh())
+        if kk > 1:
+            core.set_transforms(net, megastep=kk)
+        return tr
+
+    trainers = {"per_step": mk(1), "megastep": mk(k)}
+    # compile both programs outside the windows (per-step for the
+    # tail fallback too). TWO chunks, not one: the first chunk runs
+    # with a host-placed it0 scalar, steady-state chunks reuse the
+    # committed device counter from note_it0 — two jit
+    # specializations, both warmed here
+    for tr in trainers.values():
+        tr.fit_minibatch(batches[0])
+    trainers["megastep"].fit(ListDataSetIterator(batches[:2 * k]),
+                             epochs=1)
+    for tr in trainers.values():
+        jax.block_until_ready(tr.model.params)
+
+    def window(key):
+        tr = trainers[key]
+        rec = FlightRecorder(capacity=8192)
+        prof = prof_mod.StepProfiler(
+            registry=MetricsRegistry(enabled=False), recorder=rec,
+        )
+        it = CostlyIterator(batches, io_ms, 0)
+        reg = MetricsRegistry(enabled=False)
+        if key == "megastep":
+            pf = PrefetchIterator(
+                it, queue_depth=queue_depth, registry=reg,
+                megastep=k, chunk_placement=tr.place_chunk,
+            )
+        else:
+            pf = PrefetchIterator(
+                it, queue_depth=queue_depth, registry=reg,
+                placement=tr.place_minibatch,
+            )
+        # per-take consumer waits: waits[0] is the pipeline fill (the
+        # first take always waits for the whole first item to
+        # assemble), the rest are the steady-state stall
+        waits = []
+        orig_advance = pf._advance
+
+        def timed_advance():
+            t = time.perf_counter()
+            orig_advance()
+            waits.append(time.perf_counter() - t)
+
+        pf._advance = timed_advance
+        prev = prof_mod.set_active_profiler(prof)
+        t0 = time.perf_counter()
+        try:
+            tr.fit(pf, epochs=1)
+            jax.block_until_ready(tr.model.params)
+        finally:
+            prof_mod.set_active_profiler(prev)
+            pf.shutdown()
+        wall = time.perf_counter() - t0
+        n_rec = sum(1 for r in rec.tail() if r.get("type") == "step")
+        fill = waits[0] if waits else 0.0
+        steady = sum(waits[1:])
+        return wall, n_rec, steady / wall, fill
+
+    # element-wise best across interleaved windows: scheduler noise
+    # (the worker losing the core to the consumer on a small CI box)
+    # only ever INFLATES wall and stall, so the minimum of each is
+    # the honest capability number — same principle as the best-of-N
+    # wall windows elsewhere in this file
+    best = {key: None for key in trainers}
+    for _ in range(windows):
+        for key in trainers:
+            res = window(key)
+            prev = best[key]
+            best[key] = res if prev is None else tuple(
+                min(a, b) for a, b in zip(prev, res)
+            )
+
+    out = {"k": k, "io_ms": io_ms}
+    for key in trainers:
+        wall, n_rec, stall, fill = best[key]
+        out[f"steps_per_s_{key}"] = round(steps / wall, 2)
+        out[f"dispatches_per_step_{key}"] = round(n_rec / steps, 4)
+        out[f"input_stall_fraction_{key}"] = round(stall, 4)
+        out[f"pipeline_fill_ms_{key}"] = round(fill * 1000.0, 3)
+    out["speedup"] = round(
+        out["steps_per_s_megastep"] / out["steps_per_s_per_step"], 3,
+    )
+    out["dispatch_ratio_ok"] = bool(
+        out["dispatches_per_step_megastep"] <= 1.5 / k
+    )
+    out["input_stall_ok"] = bool(
+        out["input_stall_fraction_megastep"] < 0.05
+    )
+
+    # -- bitwise trajectory (fresh models, outside the windows) ---------
+    fresh = {"per_step": mk(1), "megastep": mk(k)}
+    for ds in batches:
+        fresh["per_step"].fit_minibatch(ds)
+    jax.block_until_ready(fresh["per_step"].model.params)
+    fresh["megastep"].fit(ListDataSetIterator(batches), epochs=1)
+    jax.block_until_ready(fresh["megastep"].model.params)
+    out["trajectory_match"] = bool(
+        np.array_equal(_params_flat(fresh["per_step"].model),
+                       _params_flat(fresh["megastep"].model))
+        and np.array_equal(_upd_flat(fresh["per_step"].model),
+                           _upd_flat(fresh["megastep"].model))
+    )
+    out["megastep_ok"] = bool(
+        out["dispatch_ratio_ok"] and out["input_stall_ok"]
+        and out["trajectory_match"]
+    )
+    return out
+
+
 def _defense_ab(windows, seed) -> dict:
     """Data-plane defense A/B on seeded CLEAN batches: steps/sec with
     the defense off vs fully on (``BatchValidator`` screening every
@@ -444,7 +604,7 @@ def _defense_ab(windows, seed) -> dict:
 def run(steps=40, batch=256, io_ms=4.0, cost_loops=0,
         queue_depth=3, max_in_flight=3, windows=3,
         seed=0, remat="none", zero=False, grad_accum=0,
-        defense=False) -> dict:
+        defense=False, megastep=0, megastep_io_ms=0.5) -> dict:
     import jax
 
     from deeplearning4j_tpu.datasets.api import DataSet
@@ -573,6 +733,11 @@ def run(steps=40, batch=256, io_ms=4.0, cost_loops=0,
         )
     if defense:
         out["defense"] = _defense_ab(windows, seed)
+    if megastep and megastep > 1:
+        out["megastep"] = _megastep_ab(
+            batches, megastep, windows, seed, megastep_io_ms,
+            queue_depth,
+        )
     return out
 
 
@@ -603,6 +768,17 @@ def main():
                     help="also A/B in-jit gradient accumulation "
                          "accum=1 vs accum=K (steps/sec + trajectory "
                          "vs the single-big-batch run)")
+    ap.add_argument("--megastep", type=int, default=0,
+                    metavar="K",
+                    help="also A/B megastep epochs: per-step fit vs "
+                         "K steps fused into one dispatch behind a "
+                         "chunk-mode prefetch (steps/sec, recorder "
+                         "dispatches/step <= 1.5/K, input-stall "
+                         "< 5%%, bitwise trajectory) — exits nonzero "
+                         "on a gate failure")
+    ap.add_argument("--megastep-io-ms", type=float, default=0.5,
+                    help="simulated I/O wait per batch for the "
+                         "megastep A/B's I/O-bound iterator")
     ap.add_argument("--defense", action="store_true",
                     help="also A/B the data-plane defense off vs on "
                          "(validator + statistical guard): gates "
@@ -616,9 +792,12 @@ def main():
         max_in_flight=args.max_in_flight, windows=args.windows,
         seed=args.seed, remat=args.remat, zero=args.zero,
         grad_accum=args.grad_accum, defense=args.defense,
+        megastep=args.megastep, megastep_io_ms=args.megastep_io_ms,
     )
     print(json.dumps(doc))
     if args.defense and not doc["defense"]["defense_ok"]:
+        sys.exit(1)
+    if args.megastep and not doc["megastep"]["megastep_ok"]:
         sys.exit(1)
 
 

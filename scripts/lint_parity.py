@@ -61,7 +61,22 @@ FORBIDDEN_METHOD_ON = {"update": {"updater", "upd_def", "updater_def"}}
 CORE_REQUIRED = {
     "build_step", "build_multi_step", "build_pretrain_step",
     "apply_layer_run", "maybe_remat", "fit_batches", "run_scan_chunk",
-    "apply_step_out",
+    "apply_step_out", "build_megastep", "run_megastep_chunk",
+    "megastep_readback", "fit_epoch_megastep",
+}
+
+# megastep contract: the per-chunk driver loop in nn/core.py owns ONE
+# designated host-readback site (megastep_readback). Any other host
+# sync inside the drivers silently turns the fused K-step dispatch
+# back into K round trips — the exact regression the megastep exists
+# to kill, and invisible to correctness tests (trajectory unchanged,
+# only dispatches/step bloats).
+MEGASTEP_DRIVERS = {
+    "run_megastep_chunk", "fit_epoch_megastep", "flush_megastep",
+}
+MEGASTEP_FORBIDDEN = {
+    "block_until_ready", "device_get", "item", "tolist", "asarray",
+    "copy_to_host_async",
 }
 ENGINE_REQUIRED_METHODS = {
     "_build_step", "_build_multi_step", "fit_minibatch", "output",
@@ -186,6 +201,56 @@ def check_pallas_locality(errors: list) -> None:
             )
 
 
+def check_megastep_readback(errors: list) -> None:
+    """The megastep driver functions may not read device values
+    except through the single ``megastep_readback()`` call — one
+    blocking host sync per K-step chunk, at the designated site.
+    (``float()``/``bool()`` on the ALREADY-read-back host dict are
+    fine and not flagged; ``device_get``/``block_until_ready``/
+    ``.item()``/``.tolist()``/``asarray`` inside a driver are not.)"""
+    tree = ast.parse(CORE.read_text(), filename=str(CORE))
+    drivers = {
+        n.name: n for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name in MEGASTEP_DRIVERS
+    }
+    for fn in sorted(MEGASTEP_DRIVERS - set(drivers)):
+        errors.append(
+            f"core.py: megastep driver {fn}() not found — the "
+            "readback-site lint has nothing to protect"
+        )
+    readback_calls = []
+    for fn_name, fn in drivers.items():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            if cn == "megastep_readback":
+                readback_calls.append((fn_name, node.lineno))
+            elif cn in MEGASTEP_FORBIDDEN:
+                errors.append(
+                    f"core.py:{node.lineno}: {fn_name}() calls "
+                    f"{cn}() — megastep drivers must not touch the "
+                    "device outside the single megastep_readback() "
+                    "site (one host sync per chunk)"
+                )
+    if drivers and len(readback_calls) != 1:
+        sites = ", ".join(
+            f"{f}:{ln}" for f, ln in readback_calls) or "none"
+        errors.append(
+            "core.py: expected exactly ONE megastep_readback() call "
+            f"across the megastep drivers, found {len(readback_calls)}"
+            f" ({sites}) — the per-chunk readback has one designated "
+            "site in run_megastep_chunk()"
+        )
+    elif readback_calls and readback_calls[0][0] != "run_megastep_chunk":
+        errors.append(
+            f"core.py:{readback_calls[0][1]}: the megastep_readback() "
+            "site moved out of run_megastep_chunk() — keep the "
+            "designated readback in the chunk driver"
+        )
+
+
 def check_core(errors: list) -> None:
     tree = ast.parse(CORE.read_text(), filename=str(CORE))
     defined = {
@@ -202,6 +267,7 @@ def check_core(errors: list) -> None:
 def main() -> int:
     errors: list = []
     check_core(errors)
+    check_megastep_readback(errors)
     for name, path in ENGINES.items():
         check_engine(name, path, errors)
     check_pallas_locality(errors)
@@ -212,7 +278,8 @@ def main() -> int:
         return 1
     print(
         "lint_parity: both engines delegate step/apply/fit hot paths "
-        "to nn/core.py; Pallas kernels stay in ops/ behind dispatch"
+        "to nn/core.py; Pallas kernels stay in ops/ behind dispatch; "
+        "megastep drivers keep one readback site"
     )
     return 0
 

@@ -68,6 +68,12 @@ def main(argv):
     tol = float(os.environ.get("DL4J_TPU_PERF_GATE_TOL",
                                DEFAULT_TOL))
     rounds = find_rounds(d)
+    if not rounds:
+        # first run in a fresh checkout: nothing has benched yet, so
+        # there is no baseline to regress against — explicitly pass
+        print(f"perf_gate: no bench rounds in {d!r} — no baseline "
+              "yet, nothing to gate; pass")
+        return 0
     if len(rounds) < 2:
         print(f"perf_gate: {len(rounds)} bench round(s) in {d!r}; "
               "need 2 to compare — pass")

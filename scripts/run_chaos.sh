@@ -75,8 +75,13 @@ python scripts/perf_gate.py || exit 1
 #                                  mid-epoch with prefetch + async
 #                                  dispatch live -> emergency
 #                                  checkpoint, exit code 75, bitwise
-#                                  resume on both engines; ModelServer
-#                                  + ServingRouter drain with zero 5xx
+#                                  resume on both engines; the same
+#                                  storm with megastep=K live (SIGTERM
+#                                  mid-chunk -> emergency checkpoint on
+#                                  the last chunk boundary, staleness
+#                                  <= K-1, bitwise megastep resume);
+#                                  ModelServer + ServingRouter drain
+#                                  with zero 5xx
 #   tests/test_elastic.py        — device loss mid-run -> survivor-
 #                                  mesh recovery from the host-RAM
 #                                  snapshot ring (no steps lost beyond

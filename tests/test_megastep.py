@@ -1,0 +1,395 @@
+"""Megastep execution: K optimizer steps fused into ONE XLA dispatch.
+
+The contract this file pins: ``fit(megastep=K)`` /
+``set_transforms(megastep=K)`` changes ONLY the dispatch granularity —
+``lax.scan`` over a ``[K, batch, ...]`` chunk with on-device metric
+accumulation and a single per-chunk host readback
+(``core.megastep_readback``) — never WHAT IS TRAINED. Trajectories
+(params AND updater state) are asserted BITWISE against the per-step
+loop on both engines, including partial tail chunks, the chunk-mode
+``PrefetchIterator`` feed, composition with ``grad_accum`` and the
+ZeRO-sharded distributed trainer, and a SKIP-policy divergence guard
+riding inside the scan. Also pinned: the one-readback-per-chunk
+economy (listener ``chunk_done`` cadence, no per-step syncs), the
+``+mega:K`` AOT artifact identity, and the documented refusals
+(ROLLBACK guard falls back to per-step; the fallback is silent and
+trajectory-preserving).
+"""
+
+import numpy as np
+import pytest
+
+import conftest
+
+from deeplearning4j_tpu.datasets.api import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.datasets.prefetch import PrefetchIterator
+from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.resilience.guard import DivergenceGuard
+
+from test_resilience import assert_updater_state_match
+
+
+def _mlp(seed=7, updater="ADAM", lr=0.05):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(updater)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph(seed=9, lr=0.05):
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .learning_rate(lr).updater("ADAM").graph_builder()
+         .add_inputs("in"))
+    b.add_layer("d0", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                "in")
+    b.add_layer("out", OutputLayer(n_in=8, n_out=3), "d0")
+    b.set_outputs("out")
+    return ComputationGraph(b.build()).init()
+
+
+def _batches(rng, n, batch=8, width=4, classes=3):
+    return [
+        DataSet(
+            features=rng.randn(batch, width).astype(np.float32),
+            labels=np.eye(classes, dtype=np.float32)[
+                rng.randint(0, classes, batch)
+            ],
+        )
+        for _ in range(n)
+    ]
+
+
+def _assert_bitwise(ref, mega):
+    np.testing.assert_array_equal(ref.params_flat(),
+                                  mega.params_flat())
+    assert_updater_state_match(ref, mega)
+    assert ref.iteration_count == mega.iteration_count
+
+
+# ---------------------------------------------------------------------------
+# bitwise trajectory, both engines (incl. partial tails)
+# ---------------------------------------------------------------------------
+
+
+def test_megastep_bitwise_mlp_with_partial_tail(rng):
+    """K=3 over 10 batches: three fused chunks plus a 1-batch tail
+    that falls back to the per-step program — the mixed trajectory
+    must equal the pure per-step loop bitwise, params AND moments."""
+    data = _batches(rng, 10)
+    ref = _mlp()
+    for ds in data:
+        ref.fit_minibatch(ds)
+
+    mega = _mlp()
+    mega.fit(ListDataSetIterator(data), megastep=3)
+    assert core.can_megastep(mega)
+    _assert_bitwise(ref, mega)
+    # per-step scores are surfaced from the chunk accumulator too
+    assert np.isfinite(mega.score_value)
+
+
+def test_megastep_bitwise_graph_engine(rng):
+    data = _batches(rng, 8)
+    ref = _graph()
+    for ds in data:
+        ref.fit_minibatch(ds)
+
+    mega = _graph()
+    core.set_transforms(mega, megastep=4)
+    assert core.can_megastep(mega)
+    mega.fit(ListDataSetIterator(data))
+    _assert_bitwise(ref, mega)
+
+
+def test_megastep_multi_epoch_and_knob_reset(rng):
+    """The knob persists across epochs and ``megastep=1`` restores
+    per-step dispatch; both halves stay on the reference trajectory."""
+    data = _batches(rng, 6)
+    ref = _mlp()
+    for _ in range(2):
+        for ds in data:
+            ref.fit_minibatch(ds)
+    for ds in data:
+        ref.fit_minibatch(ds)
+
+    mega = _mlp()
+    mega.fit(ListDataSetIterator(data), epochs=2, megastep=3)
+    mega.fit(ListDataSetIterator(data), megastep=1)
+    assert not core.megastep_active(mega)
+    _assert_bitwise(ref, mega)
+
+
+# ---------------------------------------------------------------------------
+# composition: grad_accum, chunk-mode prefetch, ZeRO trainer
+# ---------------------------------------------------------------------------
+
+
+def test_megastep_composes_with_grad_accum(rng):
+    """megastep=2 outside, grad_accum=2 inside: each fused step still
+    scans K microbatches before its single updater apply."""
+    data = _batches(rng, 8, batch=8)
+    ref = _mlp()
+    ref.fit(ListDataSetIterator(data), grad_accum=2)
+
+    mega = _mlp()
+    mega.fit(ListDataSetIterator(data), grad_accum=2, megastep=2)
+    assert core.can_megastep(mega)
+    _assert_bitwise(ref, mega)
+
+
+def test_megastep_prefetch_chunk_mode_bitwise(rng):
+    """The double-buffered feed: a chunk-mode ``PrefetchIterator``
+    stacks K-blocks on the worker thread and the driver consumes
+    pre-stacked chunks — same trajectory as the inline stacker."""
+    data = _batches(rng, 9)
+    ref = _mlp()
+    for ds in data:
+        ref.fit_minibatch(ds)
+
+    mega = _mlp()
+    core.set_transforms(mega, megastep=3)
+    with PrefetchIterator(ListDataSetIterator(data),
+                          megastep=3) as pf:
+        mega.fit(pf)
+    _assert_bitwise(ref, mega)
+
+
+def test_megastep_zero_trainer_bitwise(rng):
+    """Distributed composition on the 8-device virtual mesh: ZeRO-1
+    sharded moments + fused K-step dispatch + the trainer's sharded
+    chunk placement must replay the per-step ZeRO trajectory."""
+    conftest.require_devices(8)
+    from deeplearning4j_tpu.parallel import (
+        DistributedTrainer, build_mesh,
+    )
+
+    data = _batches(rng, 8, batch=16)
+    ref = _mlp()
+    tr_ref = DistributedTrainer(ref, mesh=build_mesh(), zero=True)
+    for ds in data:
+        tr_ref.fit_minibatch(ds)
+
+    mega = _mlp()
+    tr = DistributedTrainer(mega, mesh=build_mesh(), zero=True)
+    tr.fit(ListDataSetIterator(data), megastep=4)
+    np.testing.assert_array_equal(ref.params_flat(),
+                                  mega.params_flat())
+    assert ref.iteration_count == mega.iteration_count
+
+
+def test_megastep_trainer_prefetch_feed_bitwise(rng):
+    """trainer.fit(prefetch=N, megastep=K): the prefetch worker runs
+    ``place_chunk`` (stack + sharded device_put of whole K-blocks) and
+    the trainer dispatches pre-placed chunks."""
+    conftest.require_devices(8)
+    from deeplearning4j_tpu.parallel import (
+        DistributedTrainer, build_mesh,
+    )
+
+    data = _batches(rng, 8, batch=16)
+    ref = _mlp()
+    tr_ref = DistributedTrainer(ref, mesh=build_mesh())
+    for ds in data:
+        tr_ref.fit_minibatch(ds)
+
+    mega = _mlp()
+    tr = DistributedTrainer(mega, mesh=build_mesh())
+    tr.fit(ListDataSetIterator(data), prefetch=2, megastep=4)
+    np.testing.assert_array_equal(ref.params_flat(),
+                                  mega.params_flat())
+    assert ref.iteration_count == mega.iteration_count
+
+
+# ---------------------------------------------------------------------------
+# the one-readback economy: sync counting + listener cadence
+# ---------------------------------------------------------------------------
+
+
+class _ChunkAware:
+    def __init__(self):
+        self.chunks = []
+        self.iterations = []
+
+    def chunk_done(self, model, it0, k, metrics):
+        self.chunks.append((it0, k, dict(metrics)))
+
+    def iteration_done(self, model, iteration):
+        self.iterations.append(iteration)
+
+
+class _Legacy:
+    supports_batched_iterations = True
+
+    def __init__(self):
+        self.iterations = []
+
+    def iteration_done(self, model, iteration):
+        self.iterations.append(iteration)
+
+
+def test_megastep_single_readback_and_listener_cadence(rng,
+                                                       monkeypatch):
+    """6 batches at K=3 = exactly 2 fused dispatches and exactly 2
+    ``megastep_readback`` calls. A chunk-aware listener gets one
+    ``chunk_done`` per chunk (host dict, zero extra syncs) and NO
+    per-step callbacks; a legacy listener gets its ``iteration_done``
+    replayed per step from the same host copy."""
+    calls = []
+    real = core.megastep_readback
+
+    def counting(metrics):
+        calls.append(1)
+        return real(metrics)
+
+    monkeypatch.setattr(core, "megastep_readback", counting)
+
+    data = _batches(rng, 6)
+    net = _mlp()
+    aware = _ChunkAware()
+    legacy = _Legacy()
+    net.listeners.extend([aware, legacy])
+    net.fit(ListDataSetIterator(data), megastep=3)
+
+    assert len(calls) == 2
+    assert [(it0, k) for it0, k, _ in aware.chunks] == [(0, 3), (3, 3)]
+    assert aware.iterations == []  # never double-notified
+    assert legacy.iterations == [1, 2, 3, 4, 5, 6]
+    scores = aware.chunks[0][2]["scores"]
+    assert len(scores) == 3 and np.all(np.isfinite(scores))
+
+
+def test_megastep_metrics_and_readback_summary(rng):
+    data = _batches(rng, 6)
+    net = _mlp()
+    from deeplearning4j_tpu.observability.metrics import (
+        default_registry,
+    )
+
+    reg = default_registry()
+    fam = reg.get("megastep_dispatches_total")
+    d0 = fam.value if fam is not None else 0.0
+    net.fit(ListDataSetIterator(data), megastep=3)
+    assert reg.get("megastep_dispatches_total").value == d0 + 2
+    assert reg.get("megastep_chunk_size").value == 3.0
+    assert reg.get("megastep_readback_ms")._default().count >= 2
+
+
+# ---------------------------------------------------------------------------
+# guard composition + documented refusals
+# ---------------------------------------------------------------------------
+
+
+def _poisoned(ds):
+    bad = ds.features.copy()
+    bad[0, 0] = np.nan
+    return DataSet(features=bad, labels=ds.labels)
+
+
+def test_megastep_skip_guard_parity(rng):
+    """A NaN step INSIDE a fused chunk: the in-jit select suppresses
+    the update and the post-chunk replay books the skip — same params
+    and same skip count as the per-step guarded loop."""
+    data = _batches(rng, 6)
+    data[2] = _poisoned(data[2])
+
+    ref = _mlp()
+    ref.set_divergence_guard(DivergenceGuard(policy="skip"))
+    for ds in data:
+        ref.fit_minibatch(ds)
+
+    mega = _mlp()
+    mega.set_divergence_guard(DivergenceGuard(policy="skip"))
+    core.set_transforms(mega, megastep=3)
+    assert core.can_megastep(mega)
+    mega.fit(ListDataSetIterator(data))
+
+    np.testing.assert_array_equal(ref.params_flat(),
+                                  mega.params_flat())
+    assert mega.divergence_guard.skipped_steps == 1
+    assert (ref.divergence_guard.skipped_steps
+            == mega.divergence_guard.skipped_steps)
+
+
+def test_megastep_rollback_guard_falls_back_per_step(rng, tmp_path):
+    """ROLLBACK must restore host state mid-trajectory, which a fused
+    dispatch cannot honor — eligibility refuses and fit silently
+    rides the per-step path, trajectory preserved."""
+    from deeplearning4j_tpu.resilience.checkpoint import (
+        CheckpointManager,
+    )
+
+    mgr = CheckpointManager(str(tmp_path))
+    net = _mlp()
+    net.set_divergence_guard(
+        DivergenceGuard(policy="rollback", checkpoint_manager=mgr)
+    )
+    core.set_transforms(net, megastep=3)
+    assert core.megastep_active(net)
+    assert not core.can_megastep(net)
+
+    data = _batches(rng, 6)
+    net.fit(ListDataSetIterator(data))
+    # reference carries the SAME guard flavor (a guarded step is a
+    # different compiled program; unguarded would differ at ulp level)
+    ref = _mlp()
+    ref.set_divergence_guard(
+        DivergenceGuard(policy="rollback",
+                        checkpoint_manager=CheckpointManager(
+                            str(tmp_path / "ref")))
+    )
+    for ds in data:
+        ref.fit_minibatch(ds)
+    np.testing.assert_array_equal(ref.params_flat(),
+                                  net.params_flat())
+
+
+def test_megastep_refused_for_tbptt_like_listeners(rng):
+    """A listener that neither declares batched support nor implements
+    ``chunk_done`` keeps honest per-step callback timing: megastep
+    refuses (falls back) rather than replaying a fiction."""
+
+    class PerStepOnly:
+        def iteration_done(self, model, iteration):
+            pass
+
+    net = _mlp()
+    net.listeners.append(PerStepOnly())
+    core.set_transforms(net, megastep=3)
+    assert not core.can_megastep(net)
+
+
+# ---------------------------------------------------------------------------
+# AOT identity
+# ---------------------------------------------------------------------------
+
+
+def test_megastep_step_kind_and_stale_artifact_refusal(rng):
+    """``_step_kind`` grows ``+mega:K`` — an artifact exported at one
+    K must refuse to install at another K (or none): different arity,
+    different return contract."""
+    net = _mlp()
+    assert "mega" not in net._step_kind()
+    core.set_transforms(net, megastep=3)
+    assert net._step_kind().endswith("+mega:3")
+
+    ds = _batches(rng, 1)[0]
+    blob = net.aot_export_step(ds)
+    plain = _mlp()
+    assert plain.aot_install_step(blob) is False
+    other_k = _mlp()
+    core.set_transforms(other_k, megastep=4)
+    assert other_k.aot_install_step(blob) is False
+    twin = _mlp()
+    core.set_transforms(twin, megastep=3)
+    assert twin.aot_install_step(blob) is True

@@ -451,6 +451,150 @@ def test_chaos_sigterm_mid_epoch_exit_code_and_bitwise_resume(tmp_path):
         )
 
 
+_MEGA_CHILD = r"""
+import os, sys, time
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from deeplearning4j_tpu.datasets.api import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import core
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import DistributedTrainer
+from deeplearning4j_tpu.resilience import (
+    CheckpointManager, PreemptionHandler, exit_on_preemption,
+)
+
+mode, ckpt_dir, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+N, K = 30, 3
+
+def net():
+    conf = (NeuralNetConfiguration.Builder().seed(7)
+            .learning_rate(0.05).updater("ADAM").list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3)).build())
+    return MultiLayerNetwork(conf).init()
+
+def batches():
+    rng = np.random.RandomState(int(os.environ.get(
+        "DL4J_TPU_CHAOS_SEED", "1337")))
+    return [DataSet(
+        features=rng.randn(8, 4).astype(np.float32),
+        labels=np.eye(3)[rng.randint(0, 3, 8)].astype(np.float32),
+    ) for _ in range(N)]
+
+class Paced:
+    # slow source so the parent's SIGTERM lands mid-chunk, between
+    # two megastep dispatches
+    def __init__(self, items):
+        self.items = items
+    def __iter__(self):
+        for ds in self.items:
+            time.sleep(0.05)
+            yield ds
+    def reset(self):
+        pass
+
+m = net()
+tr = DistributedTrainer(m)
+mgr = CheckpointManager(ckpt_dir)
+bs = batches()
+if mode == "train":
+    class Progress:
+        supports_batched_iterations = True
+        def iteration_done(self, model, it):
+            print(f"step {it}", flush=True)
+    m.listeners.append(Progress())
+    core.set_transforms(m, megastep=K)
+    assert core.can_megastep(m), "storm must exercise the fused path"
+    PreemptionHandler(manager=mgr).install()
+    with exit_on_preemption():
+        tr.fit(Paced(bs), epochs=1)
+elif mode == "resume":
+    step = tr.resume(mgr)
+    print(f"resumed {step}", flush=True)
+    tr.fit(ListDataSetIterator(bs[step:]), epochs=1, megastep=K)
+else:  # full
+    tr.fit(ListDataSetIterator(bs), epochs=1, megastep=K)
+flat = {f"{ln}/{pn}": np.asarray(a)
+        for ln, lp in m.params.items() for pn, a in lp.items()}
+np.savez(out_path, step=m.iteration_count, **flat)
+"""
+
+
+def _run_mega_child(mode, ckpt_dir, out_path, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO_ROOT] + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    return subprocess.run(
+        [sys.executable, "-c", _MEGA_CHILD, mode, ckpt_dir, out_path],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.chaos
+def test_chaos_sigterm_megastep_chunk_boundary_bitwise_resume(tmp_path):
+    """SIGTERM a training process with ``megastep=3`` live, mid-chunk.
+    The emergency checkpoint must land on the LAST CHUNK BOUNDARY —
+    a step multiple of K, staleness bounded by K-1: the un-flushed
+    buffer holds no dispatched work, so nothing between boundaries
+    needs saving — and a fresh megastep process resuming from it must
+    finish bitwise-identical to an uninterrupted megastep run."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO_ROOT] + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    ckpt = str(tmp_path / "ckpt")
+    p = subprocess.Popen(
+        [sys.executable, "-c", _MEGA_CHILD, "train", ckpt,
+         str(tmp_path / "train.npz")],
+        env=env, cwd=REPO_ROOT, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        seen = 0
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            line = p.stdout.readline()
+            if line.startswith("step "):
+                seen = int(line.split()[1])
+                if seen >= 3:
+                    break
+        assert seen >= 3, "trainer never finished the first chunk"
+        os.kill(p.pid, signal.SIGTERM)  # the storm, mid-chunk
+        rc = p.wait(timeout=120)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert rc == EXIT_PREEMPTED, f"exit code {rc}, wanted 75"
+
+    mgr = CheckpointManager(ckpt)
+    step = mgr.latest_step()
+    assert step is not None and step >= 3
+    # the chunk-boundary contract: only dispatched chunks are
+    # durable, so the checkpoint step is a multiple of K=3
+    assert step % 3 == 0, (
+        f"emergency checkpoint at step {step}, not a chunk boundary"
+    )
+
+    r = _run_mega_child("resume", ckpt, str(tmp_path / "resume.npz"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    f = _run_mega_child("full", str(tmp_path / "unused"),
+                        str(tmp_path / "full.npz"))
+    assert f.returncode == 0, f.stderr[-2000:]
+
+    resumed = np.load(tmp_path / "resume.npz")
+    full = np.load(tmp_path / "full.npz")
+    assert int(resumed["step"]) == int(full["step"]) == 30
+    for key in full.files:
+        np.testing.assert_array_equal(
+            resumed[key], full[key], err_msg=key,
+        )
+
+
 # -- serving: the same signal becomes the graceful drain ----------------
 
 
