@@ -1612,6 +1612,46 @@ def bench_aot_compile(budget_s=None) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_embeddings(budget_s=None) -> dict:
+    """Sharded-embeddings A/B via the standalone script (subprocess —
+    it builds its own 8-virtual-device mesh). Reports the script's
+    JSON verbatim; the acceptance gates are
+    ``residency.bytes_per_device_ratio`` ~ 1/8 (one device holds one
+    row shard of the 16 MiB table), ``sparse_update.bitwise_match``
+    (the deduped owner-side scatter equals a dense [V, D]-cotangent
+    step bit-for-bit) with ``speedup`` > 1 (update cost scales with
+    the batch's unique rows, not vocab), and
+    ``fused_step.loss_parity`` (the collective-lookup fused NS step
+    matches the single-device reference loss) — rolled up as
+    ``embeddings_ok`` (the script exits nonzero on a gate failure)."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_embeddings.py",
+    )
+    timeout = 300
+    if budget_s is not None:
+        timeout = max(30, min(timeout, int(budget_s)))
+    env = dict(os.environ)
+    env.update({
+        "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE or "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+    })
+    out = subprocess.run(
+        [sys.executable, script, "--budget-s", str(max(30, timeout - 20))],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_embeddings failed (rc {out.returncode}): "
+            f"{out.stderr[-2000:] or out.stdout[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def _bench_transforms(section: str, budget_s=None) -> dict:
     """``compile_vs_depth`` / ``remat_memory`` via the standalone
     transform A/B script (scripts/bench_transforms.py — every
@@ -1998,6 +2038,14 @@ def _section_table(budget_fn):
          "anomaly guard off vs on (scripts/bench_training.py "
          "--defense; overhead <= 5%, zero clean quarantines and the "
          "no-trip bitwise lemmas are the gates)"),
+        ("embeddings",
+         lambda: bench_embeddings(budget_fn()),
+         "mesh-row-sharded embedding tables: per-device residency "
+         "~1/8 of replicated, deduped sparse row update vs dense "
+         "[V, D]-cotangent step (bitwise match + speedup > 1), and "
+         "fused sharded skip-gram/NS step loss parity "
+         "(scripts/bench_embeddings.py; embeddings_ok rolls up the "
+         "gates)"),
         ("aot_compile",
          lambda: bench_aot_compile(budget_fn()),
          "cold-vs-warm serving boot-to-ready "

@@ -71,6 +71,9 @@ _LAZY_IMPORTS = {
     "enable_persistent_cache": "deeplearning4j_tpu.compile",
     "export_serving_bundle": "deeplearning4j_tpu.compile",
     "install_serving_bundle": "deeplearning4j_tpu.compile",
+    "ShardedEmbeddingTable": "deeplearning4j_tpu.embeddings",
+    "ShardedWord2Vec": "deeplearning4j_tpu.embeddings",
+    "ShardedDeepWalk": "deeplearning4j_tpu.embeddings",
     "ContinualTrainer": "deeplearning4j_tpu.loop",
     "ShadowScorer": "deeplearning4j_tpu.loop",
     "Promoter": "deeplearning4j_tpu.loop",

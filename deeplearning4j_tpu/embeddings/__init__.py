@@ -1,0 +1,69 @@
+"""Sharded embeddings: tables larger than one device's memory.
+
+The L6 workloads (Word2Vec, DeepWalk) and the engines' EmbeddingLayer
+all store a ``[V, D]`` table; everywhere else in this codebase that
+table is dense on every device. This package makes the table's ROWS a
+mesh resource — sharded ``P("data", None)``, a genuinely different
+sharding shape from ZeRO's flat elementwise partitioning — so vocab
+capacity scales with mesh width:
+
+- ``sparse.py`` — the gradient discipline: differentiate w.r.t. the
+  GATHERED rows (batch-sized, never ``[V, D]``), fold duplicate ids
+  with sort + ``segment_sum``. Pure array math, no collectives.
+- ``table.py`` — ``ShardedEmbeddingTable`` + the fused jitted steps:
+  collective lookup (owned-rows gather + psum of exact zeros —
+  bitwise equal to unsharded on any mesh width) and owner-only
+  scatter-add updates. The package's single collective site
+  (``scripts/lint_parity.py`` enforces this).
+- ``word2vec.py`` / ``deepwalk.py`` — ``ShardedWord2Vec`` and
+  ``ShardedDeepWalk``: the single-device trainers' exact recipes on
+  sharded storage, with resumable fits and canonical-host-row
+  checkpoints that restore onto a mesh of any width, bitwise.
+
+The engine-side twin is ``nn/layers/feedforward.py``'s
+``SparseEmbeddingLayer`` (sparse row updates through ``nn/core.py`` +
+``DistributedTrainer``, with explicit megastep/ZeRO eligibility
+fallbacks). Metrics: ``embedding_shard_bytes``,
+``embedding_rows_touched``, ``embedding_lookup_ms``,
+``embedding_scatter_ms`` (docs/ARCHITECTURE.md catalog).
+"""
+
+from deeplearning4j_tpu.embeddings.sparse import (  # noqa: F401
+    PAD_ID,
+    apply_rows_dense,
+    dedup_segment_sum,
+    flatten_occurrences,
+    rows_grad,
+)
+from deeplearning4j_tpu.embeddings.table import (  # noqa: F401
+    ShardedEmbeddingTable,
+    note_lookup_ms,
+    note_rows_touched,
+    note_scatter_ms,
+    note_shard_bytes,
+)
+from deeplearning4j_tpu.embeddings.word2vec import (  # noqa: F401
+    ShardedLookupTable,
+    ShardedWord2Vec,
+)
+from deeplearning4j_tpu.embeddings.deepwalk import (  # noqa: F401
+    ShardedDeepWalk,
+    ShardedGraphLookupTable,
+)
+
+__all__ = [
+    "PAD_ID",
+    "ShardedDeepWalk",
+    "ShardedEmbeddingTable",
+    "ShardedGraphLookupTable",
+    "ShardedLookupTable",
+    "ShardedWord2Vec",
+    "apply_rows_dense",
+    "dedup_segment_sum",
+    "flatten_occurrences",
+    "note_lookup_ms",
+    "note_rows_touched",
+    "note_scatter_ms",
+    "note_shard_bytes",
+    "rows_grad",
+]

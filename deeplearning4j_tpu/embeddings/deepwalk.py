@@ -1,0 +1,229 @@
+"""``ShardedDeepWalk``: DeepWalk vertex embeddings on mesh-row-sharded
+tables.
+
+``graph/deepwalk.py`` already trains batched + jitted, but its vertex
+vectors and inner-node weights are dense device arrays — one device
+must hold the whole graph's ``[V, D]`` (twice). Here both tables
+become :class:`ShardedEmbeddingTable` shards and each batch runs the
+fused hierarchical-softmax step from ``embeddings/table.py``
+(collective lookup of the centers + path inner nodes, gradient w.r.t.
+the gathered rows only, dedup + owner scatter) — same graph sign
+convention and batch-averaged loss as the base ``_hs_graph_step``, so
+trajectories agree to numerical parity while per-device residency
+drops to ~1/N.
+
+Eligibility fallback: the reference's single-pair ``iterate`` /
+``vectors_and_gradients`` contract (used by gradient-check tests)
+mutates host rows in place — that does not compose with row-sharded
+device storage, so those methods raise loudly here; use the base
+``InMemoryGraphLookupTable`` for per-pair work.
+
+Persistence is canonical host rows + vertex degrees (the Huffman tree
+rebuilds deterministically from degrees): ``save`` gathers, ``restore``
+re-shards onto whatever mesh is present — train on 8 devices, resume
+on 1, bitwise. ``fit`` continues the per-epoch walk seeds across
+calls (``_epochs_done``), so a resumed run draws the walks the dead
+run never got to, instead of replaying epoch 0.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.embeddings.table import (
+    ShardedEmbeddingTable,
+    _build_hs_graph_step,
+    note_rows_touched,
+)
+from deeplearning4j_tpu.graph.deepwalk import (
+    DeepWalk,
+    GraphHuffman,
+    InMemoryGraphLookupTable,
+)
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+_FORMAT = "sharded-deepwalk-v1"
+
+
+class ShardedGraphLookupTable(InMemoryGraphLookupTable):
+    """Graph lookup table whose vertex vectors and inner-node weights
+    are row-sharded over the mesh. Initial rows come from the same RNG
+    stream (same draw order) as the base class, so weights start
+    bitwise identical."""
+
+    def __init__(self, n_vertices: int, vector_size: int, tree,
+                 learning_rate: float, seed: int = 12345, mesh=None):
+        # No super().__init__: it allocates the dense host tables.
+        self.n_vertices = n_vertices
+        self._vector_size = vector_size
+        self.tree = tree
+        self.learning_rate = learning_rate
+        self.mesh = mesh if mesh is not None else build_mesh()
+        rng = np.random.RandomState(seed)
+        rows0 = (
+            (rng.rand(n_vertices, vector_size) - 0.5) / vector_size
+        ).astype(np.float32)
+        rows1 = (
+            (rng.rand(max(n_vertices - 1, 1), vector_size) - 0.5)
+            / vector_size
+        ).astype(np.float32)
+        self.t0 = ShardedEmbeddingTable.from_rows(rows0, mesh=self.mesh)
+        self.t1 = ShardedEmbeddingTable.from_rows(rows1, mesh=self.mesh)
+
+    # base-class names resolve to the raw sharded device arrays
+    @property
+    def vertex_vectors(self):
+        return self.t0.table
+
+    @property
+    def out_weights(self):
+        return self.t1.table
+
+    def get_vertex_vectors(self) -> np.ndarray:
+        # canonical unpadded rows (the raw array carries vocab padding)
+        return self.t0.to_host()
+
+    def get_vector(self, idx: int) -> np.ndarray:
+        return np.asarray(self.t0.lookup(np.array([idx], np.int32))[0])
+
+    def vectors_and_gradients(self, first: int, second: int):
+        raise NotImplementedError(
+            "per-pair vectors_and_gradients mutates host rows in place "
+            "and does not compose with row-sharded tables; use the "
+            "dense InMemoryGraphLookupTable for gradient checks"
+        )
+
+    def iterate(self, first: int, second: int) -> None:
+        raise NotImplementedError(
+            "per-pair iterate does not compose with row-sharded "
+            "tables; train through batch_update"
+        )
+
+    def batch_update(self, centers: np.ndarray, contexts: np.ndarray,
+                     alpha: float) -> float:
+        """Same contract as the base: one fused jitted HS step for the
+        (centers -> contexts) pair batch, returns mean loss — but the
+        step is the sharded collective-lookup/owner-scatter program."""
+        codes = self.tree.codes[contexts]
+        points = self.tree.points[contexts]
+        L = self.tree.codes.shape[1]
+        pmask = (
+            np.arange(L)[None, :] < self.tree.lengths[contexts][:, None]
+        ).astype(np.float32)
+        step_fn = _build_hs_graph_step(self.mesh)
+        self.t0.table, self.t1.table, loss, touched = step_fn(
+            self.t0.table, self.t1.table,
+            jnp.asarray(centers, jnp.int32),
+            jnp.asarray(codes, jnp.float32),
+            jnp.asarray(points, jnp.int32),
+            jnp.asarray(pmask),
+            jnp.float32(alpha),
+        )
+        note_rows_touched(int(touched))
+        return float(loss)
+
+
+class ShardedDeepWalk(DeepWalk):
+    """DeepWalk whose tables shard over the mesh's data axis. Same
+    builder surface as :class:`DeepWalk` plus ``mesh``; adds
+    ``save``/``restore`` (canonical rows, any-mesh restore) and
+    continues epoch walk seeds across ``fit`` calls for resume."""
+
+    def __init__(self, vector_size: int = 100, window_size: int = 2,
+                 learning_rate: float = 0.01, seed: int = 12345,
+                 batch_size: int = 2048, mesh=None):
+        super().__init__(vector_size=vector_size,
+                         window_size=window_size,
+                         learning_rate=learning_rate, seed=seed,
+                         batch_size=batch_size)
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self._epochs_done = 0
+        self._degrees = None
+
+    def initialize(self, graph_or_degrees) -> None:
+        if isinstance(graph_or_degrees, Graph):
+            degrees = graph_or_degrees.degrees()
+        else:
+            degrees = np.asarray(graph_or_degrees, np.int64)
+        self._degrees = np.asarray(degrees, np.int64)
+        tree = GraphHuffman(degrees)
+        self.lookup_table = ShardedGraphLookupTable(
+            len(degrees), self.vector_size, tree, self.learning_rate,
+            seed=self.seed, mesh=self.mesh,
+        )
+        self._init_called = True
+
+    def fit(self, graph: Graph, walk_length: int = 8,
+            epochs: int = 1) -> None:
+        """Like the base fit, but epoch seeds continue across calls
+        (``seed + epochs_done``, ...): fit(e1) then fit(e2) — on this
+        instance or on one restored from its checkpoint — walks the
+        same ground as a single fit(e1+e2)."""
+        if not self._init_called:
+            self.initialize(graph)
+        from deeplearning4j_tpu.graph.api import NoEdgeHandling
+        from deeplearning4j_tpu.graph.graph import generate_random_walks
+
+        n = graph.num_vertices()
+        first = self._epochs_done
+        for epoch in range(first, first + epochs):
+            rng = np.random.RandomState(self.seed + epoch)
+            starts = np.arange(n, dtype=np.int32)
+            rng.shuffle(starts)
+            walks = generate_random_walks(
+                graph, walk_length, starts,
+                seed=self.seed + 31 * epoch + 1,
+                mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+            )
+            self.fit_walks(walks)
+            self._epochs_done = epoch + 1
+
+    # -- persistence -----------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Canonical host rows + degrees + epoch counter, written
+        atomically; restores onto a mesh of any width bitwise."""
+        from deeplearning4j_tpu.resilience.checkpoint import (
+            atomic_write_bytes,
+        )
+
+        if not self._init_called:
+            raise RuntimeError("nothing to save: not initialized")
+        lt = self.lookup_table
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            format=_FORMAT,
+            vertex_vectors=lt.t0.to_host(),
+            out_weights=lt.t1.to_host(),
+            degrees=self._degrees,
+            epochs_done=self._epochs_done,
+            meta=np.array([self.vector_size, self.window_size,
+                           self.seed, self.batch_size], np.int64),
+        )
+        atomic_write_bytes(os.fspath(path), buf.getvalue())
+
+    def restore(self, path: str) -> None:
+        """Rebuild the Huffman tree from the checkpoint's degrees and
+        place its rows onto THIS instance's mesh."""
+        with np.load(path, allow_pickle=False) as z:
+            if str(z["format"]) != _FORMAT:
+                raise ValueError(f"not a {_FORMAT} checkpoint: {path}")
+            meta = z["meta"]
+            want = np.array([self.vector_size, self.window_size,
+                             self.seed, self.batch_size], np.int64)
+            if not np.array_equal(meta, want):
+                raise ValueError(
+                    f"checkpoint hyperparameters {meta.tolist()} do "
+                    f"not match this trainer's {want.tolist()} "
+                    "(vector/window/seed/batch)"
+                )
+            self.initialize(z["degrees"])
+            self.lookup_table.t0.restore_rows(z["vertex_vectors"])
+            self.lookup_table.t1.restore_rows(z["out_weights"])
+            self._epochs_done = int(z["epochs_done"])

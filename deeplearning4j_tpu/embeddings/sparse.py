@@ -1,0 +1,100 @@
+"""Sparse embedding-gradient machinery: unique-id dedup +
+``segment_sum`` scatter-add.
+
+The dense way to update an embedding table is ``jax.grad`` through the
+row gather: its VJP materializes a full ``[V, D]`` cotangent (zeros
+plus a scatter) every step, and any stateful optimizer then carries
+``[V, D]`` moments — both scale with the vocabulary, not with the rows
+a batch actually touches. TensorFlow's large-scale design (PAPERS.md,
+arxiv 1605.08695) treats sparse lookup/update as a first-class
+primitive for exactly this reason.
+
+Here the gradient is taken with respect to the GATHERED rows only
+(``[B, D]`` — batch-sized), duplicate ids inside the batch are folded
+with a sort + ``segment_sum`` (one summed gradient row per unique id,
+matching the dense scatter-add semantics), and the update applies
+those summed rows back with one scatter-add. Per-step cost scales with
+rows touched, never with ``V``; ``tests/test_embeddings.py`` asserts
+the jaxpr of the sparse step contains no ``[V, D]`` intermediate
+beyond the table itself.
+
+Everything in this module is pure jit-safe array math with NO
+collectives — the mesh-aware exchange lives in ``embeddings/table.py``
+(the one collective site ``scripts/lint_parity.py`` admits for this
+package).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: Sentinel id marking padded slots in a deduped id vector. Negative,
+#: so the masked scatter in table.py (and ``apply_rows_dense`` below)
+#: can never own it.
+PAD_ID = -1
+
+
+def dedup_segment_sum(ids, grads):
+    """Fold duplicate ids: ``(unique_ids, summed_grads, n_unique)``.
+
+    ``ids``: int ``[B]``; ``grads``: ``[B, D]`` per-occurrence gradient
+    rows. Returns fixed shapes (``[B]`` / ``[B, D]`` — jit-static):
+    slot ``j < n_unique`` holds the j-th unique id (ascending) and the
+    sum of its occurrences' gradient rows; slots ``>= n_unique`` hold
+    ``PAD_ID`` and zeros. Duplicates are summed in sorted-position
+    order, so the result is a pure function of (ids, grads) —
+    independent of mesh shape, which is what makes the sharded update
+    bitwise-reproducible across mesh widths.
+    """
+    b = ids.shape[0]
+    ids = ids.astype(jnp.int32)
+    order = jnp.argsort(ids)
+    sid = jnp.take(ids, order, axis=0)
+    sg = jnp.take(grads, order, axis=0)
+    # first-occurrence flags -> segment index per sorted position
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sid[1:] != sid[:-1]]
+    )
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    summed = jax.ops.segment_sum(sg, seg, num_segments=b)
+    n_unique = jnp.sum(first.astype(jnp.int32))
+    # unique id per segment: scatter sorted ids at their segment slot
+    # (drop-mode scatter; every slot < n_unique is written at least
+    # once, with the same value each time)
+    uids = jnp.full((b,), PAD_ID, jnp.int32).at[seg].set(sid)
+    return uids, summed, n_unique
+
+
+def rows_grad(loss_of_rows, *rows):
+    """``(loss, grads)`` of a loss expressed over GATHERED rows.
+
+    ``loss_of_rows(*rows)`` must be a scalar function of batch-sized
+    row arrays (``[B, D]``, ``[B, K, D]``, ...). Differentiating here
+    — instead of through the table gather — is what keeps the ``[V,
+    D]`` cotangent out of the program entirely.
+    """
+    return jax.value_and_grad(
+        lambda rs: loss_of_rows(*rs), argnums=0
+    )(rows)
+
+
+def flatten_occurrences(ids, grads):
+    """Collapse leading batch dims: ``[..., D]`` gradient rows and
+    matching ``[...]`` ids into flat ``[N]`` / ``[N, D]`` occurrence
+    lists ready for :func:`dedup_segment_sum`."""
+    d = grads.shape[-1]
+    return ids.reshape(-1), grads.reshape(-1, d)
+
+
+def apply_rows_dense(table, uids, summed, alpha):
+    """Reference (unsharded) sparse SGD apply: one scatter-add of the
+    deduped rows, ``table[uid] -= alpha * summed[uid]``. ``PAD_ID``
+    slots contribute exact zeros at a clamped index, so padded slots
+    never perturb row 0. This is the single-device twin of the
+    per-shard owner update in ``table.py`` — the bitwise parity tests
+    compare the two."""
+    ok = (uids >= 0) & (uids < table.shape[0])
+    idx = jnp.clip(uids, 0, table.shape[0] - 1)
+    upd = jnp.where(ok[:, None], -alpha * summed, 0.0).astype(table.dtype)
+    return table.at[idx].add(upd)

@@ -1,0 +1,367 @@
+"""``ShardedEmbeddingTable``: embedding rows sharded ``P("data",
+None)`` over the mesh, with collective lookup and owner-only sparse
+scatter-add.
+
+Sharding shape (ROADMAP "sharded embeddings": a genuinely different
+shape than ZeRO's flat elementwise math): the ``[V, D]`` table is
+row-partitioned over the mesh's ``data`` axis — device ``i`` of ``N``
+holds rows ``[i*V/N, (i+1)*V/N)`` and nothing else, so the largest
+table grows with the mesh instead of being bounded by one device's
+HBM (``embedding_shard_bytes`` gauges the per-device residency,
+~1/N of a replicated table).
+
+- **Lookup** gathers only OWNED rows per shard (out-of-shard ids
+  produce exact zeros) and exchanges via one ``psum`` — every term but
+  the owner's contributes ``+0.0``, so the result is bitwise equal to
+  an unsharded gather, on any mesh width.
+- **Update** applies the deduped row gradients from
+  ``embeddings/sparse.py`` owner-side only: each unique row is
+  rewritten exactly once, by the shard that owns it, from replicated
+  (mesh-width-independent) gradient math — which is what makes a run
+  checkpointed on an 8-wide mesh resume bitwise on a 1-wide one.
+
+This module is the package's ONE collective site: the raw
+``psum``/``shard_map`` calls the ``scripts/lint_parity.py``
+collective-locality rule admits for ``embeddings/`` all live here —
+the Word2Vec/DeepWalk workloads compose the fused steps below and
+never touch a collective themselves.
+
+Batch math is deliberately REPLICATED (ids and gradients identical on
+every device): the subsystem scales table *memory* with the mesh, not
+batch compute — sharding the batch would make per-shard partial sums
+mesh-width-dependent and break the cross-mesh bitwise contract.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.embeddings import sparse
+from deeplearning4j_tpu.parallel.compat import shard_map_compat
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+# -- metrics (lazy module-level instruments, nn/core.py idiom) ----------
+
+_SHARD_BYTES = None
+_ROWS_TOUCHED = None
+_LOOKUP_MS = None
+_SCATTER_MS = None
+
+
+def _instruments():
+    global _SHARD_BYTES, _ROWS_TOUCHED, _LOOKUP_MS, _SCATTER_MS
+    if _SHARD_BYTES is None:
+        from deeplearning4j_tpu.observability.metrics import (
+            default_registry,
+        )
+
+        reg = default_registry()
+        _SHARD_BYTES = reg.gauge(
+            "embedding_shard_bytes",
+            help="embedding-table bytes resident on ONE device (the "
+                 "row shard; ~1/N of the replicated table on an "
+                 "N-wide data axis)",
+        )._default()
+        _ROWS_TOUCHED = reg.gauge(
+            "embedding_rows_touched",
+            help="unique embedding rows written by the last sparse "
+                 "update (the quantity per-step cost scales with, "
+                 "instead of vocab)",
+        )._default()
+        _LOOKUP_MS = reg.summary(
+            "embedding_lookup_ms",
+            help="sharded embedding lookup wall time (ms): owned-row "
+                 "gather + psum exchange, measured to completion",
+        )._default()
+        _SCATTER_MS = reg.summary(
+            "embedding_scatter_ms",
+            help="sparse embedding update wall time (ms): dedup + "
+                 "segment_sum + owner-side scatter-add, measured to "
+                 "completion",
+        )._default()
+    return _SHARD_BYTES, _ROWS_TOUCHED, _LOOKUP_MS, _SCATTER_MS
+
+
+def note_shard_bytes(nbytes: int) -> None:
+    _instruments()[0].set(float(nbytes))
+
+
+def note_rows_touched(n: int) -> None:
+    _instruments()[1].set(float(n))
+
+
+def note_lookup_ms(ms: float) -> None:
+    _instruments()[2].observe(float(ms))
+
+
+def note_scatter_ms(ms: float) -> None:
+    _instruments()[3].observe(float(ms))
+
+
+# -- per-shard primitives (called inside shard_map over "data") ---------
+
+
+def owned_rows(local_table, ids):
+    """Gather ``ids`` against this shard's rows: out-of-shard ids read
+    a clamped row but are masked to exact ``0.0`` before the ``psum``,
+    so the sum over shards reconstructs ``table[ids]`` bitwise (every
+    non-owner term is ``+0.0``). ``ids`` may be any integer shape; the
+    result appends the row dim."""
+    shard = local_table.shape[0]
+    base = jax.lax.axis_index("data") * shard
+    local = ids.astype(jnp.int32) - base
+    own = (local >= 0) & (local < shard)
+    rows = jnp.take(local_table, jnp.clip(local, 0, shard - 1), axis=0)
+    rows = jnp.where(own[..., None], rows, jnp.zeros((), rows.dtype))
+    return jax.lax.psum(rows, "data")
+
+
+def scatter_owned(local_table, uids, deltas):
+    """Add ``deltas[j]`` to row ``uids[j]`` on its owner shard only.
+    ``uids`` comes from ``sparse.dedup_segment_sum`` (unique, PAD_ID
+    padding), so every row is rewritten at most once — no cross-shard
+    accumulation, no collective, and the per-row arithmetic is
+    identical on every mesh width."""
+    shard = local_table.shape[0]
+    base = jax.lax.axis_index("data") * shard
+    local = uids.astype(jnp.int32) - base
+    own = (local >= 0) & (local < shard)
+    idx = jnp.clip(local, 0, shard - 1)
+    upd = jnp.where(
+        own[:, None], deltas, jnp.zeros((), deltas.dtype)
+    ).astype(local_table.dtype)
+    return local_table.at[idx].add(upd)
+
+
+# -- jitted mesh programs (cached per mesh) -----------------------------
+
+_ROW = P("data", None)
+_REP = P()
+
+
+@functools.lru_cache(maxsize=None)
+def _build_lookup(mesh):
+    sm = shard_map_compat()
+    body = sm(owned_rows, mesh=mesh, in_specs=(_ROW, _REP),
+              out_specs=_REP)
+    return jax.jit(body)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sparse_apply(mesh):
+    """jit(table, ids, grads, alpha) -> (new_table, rows_touched):
+    dedup outside the shard_map (replicated math), owner scatter
+    inside it. The table buffer is donated — the update is in-place
+    per shard."""
+    sm = shard_map_compat()
+    scatter = sm(scatter_owned, mesh=mesh,
+                 in_specs=(_ROW, _REP, _REP), out_specs=_ROW)
+
+    def apply(table, ids, grads, alpha):
+        uids, summed, n = sparse.dedup_segment_sum(ids, grads)
+        return scatter(table, uids, -alpha * summed), n
+
+    return jax.jit(apply, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sg_ns_step(mesh):
+    """Fused skip-gram negative-sampling step over sharded syn0 /
+    syn1neg: collective lookup -> replicated loss/grad over the
+    GATHERED rows only (same objective as ``nlp/word2vec.py``'s
+    ``_ns_step_raw``, collision mask included) -> dedup -> owner
+    scatter. One dispatch; no ``[V, D]`` intermediate beyond the
+    sharded tables themselves."""
+    sm = shard_map_compat()
+
+    def body(s0, s1n, centers, contexts, negs, mask, alpha):
+        v = owned_rows(s0, centers)          # [B, D]
+        u_pos = owned_rows(s1n, contexts)    # [B, D]
+        u_neg = owned_rows(s1n, negs)        # [B, K, D]
+
+        def loss_fn(v_, up_, un_):
+            pos = jax.nn.log_sigmoid(jnp.sum(v_ * up_, axis=-1))
+            nvalid = (negs != contexts[:, None]).astype(v_.dtype)
+            neg = jnp.sum(
+                nvalid * jax.nn.log_sigmoid(
+                    -jnp.einsum("bd,bkd->bk", v_, un_)
+                ),
+                axis=-1,
+            )
+            return -jnp.sum(mask * (pos + neg)) / jnp.maximum(
+                jnp.sum(mask), 1.0
+            )
+
+        loss, (gv, gp, gn) = sparse.rows_grad(loss_fn, v, u_pos, u_neg)
+        u0, g0, n0 = sparse.dedup_segment_sum(centers, gv)
+        ids1, rows1 = sparse.flatten_occurrences(
+            jnp.concatenate([contexts, negs.reshape(-1)]),
+            jnp.concatenate([gp, gn.reshape(-1, gn.shape[-1])]),
+        )
+        u1, g1, n1 = sparse.dedup_segment_sum(ids1, rows1)
+        s0 = scatter_owned(s0, u0, -alpha * g0)
+        s1n = scatter_owned(s1n, u1, -alpha * g1)
+        return s0, s1n, loss, n0 + n1
+
+    step = sm(body, mesh=mesh,
+              in_specs=(_ROW, _ROW, _REP, _REP, _REP, _REP, _REP),
+              out_specs=(_ROW, _ROW, _REP, _REP))
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hs_graph_step(mesh):
+    """Fused hierarchical-softmax step over sharded vertex vectors /
+    inner-node weights, graph sign convention (``graph/deepwalk.py``
+    ``_hs_graph_step``: loss per node -log sigmoid((2·bit-1)·dot))."""
+    sm = shard_map_compat()
+
+    def body(s0, s1, centers, codes, points, pmask, alpha):
+        v = owned_rows(s0, centers)          # [B, D]
+        u = owned_rows(s1, points)           # [B, L, D]
+
+        def loss_fn(v_, u_):
+            x = jnp.einsum("bd,bld->bl", v_, u_)
+            sign = 2.0 * codes - 1.0
+            logp = jax.nn.log_sigmoid(sign * x)
+            return -jnp.sum(pmask * logp) / jnp.maximum(
+                jnp.sum(jnp.any(pmask > 0, axis=1)), 1.0
+            )
+
+        loss, (gv, gu) = sparse.rows_grad(loss_fn, v, u)
+        u0, g0, n0 = sparse.dedup_segment_sum(centers, gv)
+        ids1, rows1 = sparse.flatten_occurrences(points, gu)
+        u1, g1, n1 = sparse.dedup_segment_sum(ids1, rows1)
+        s0 = scatter_owned(s0, u0, -alpha * g0)
+        s1 = scatter_owned(s1, u1, -alpha * g1)
+        return s0, s1, loss, n0 + n1
+
+    step = sm(body, mesh=mesh,
+              in_specs=(_ROW, _ROW, _REP, _REP, _REP, _REP, _REP),
+              out_specs=(_ROW, _ROW, _REP, _REP))
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+# -- the table ----------------------------------------------------------
+
+
+class ShardedEmbeddingTable:
+    """A ``[V, D]`` embedding table row-sharded ``P("data", None)``.
+
+    ``V`` is zero-padded up to a multiple of the data-axis width (the
+    pad rows are never owned by any valid id, so they are inert);
+    queries and checkpoints always see the canonical unpadded rows.
+
+    The device arrays live on ``self.table``; the fused workload steps
+    (``_build_sg_ns_step`` / ``_build_hs_graph_step``) operate on the
+    raw arrays of two tables at once, so Word2Vec/DeepWalk thread
+    ``table.table`` through their jitted programs directly.
+    """
+
+    def __init__(self, vocab: int, dim: int, *, mesh=None,
+                 dtype=jnp.float32, seed: int = 12345, rows=None):
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.n_shards = int(self.mesh.shape["data"])
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.padded_vocab = -(-self.vocab // self.n_shards) * self.n_shards
+        self.dtype = jnp.dtype(dtype)
+        if rows is None:
+            # word2vec resetWeights convention: U(-0.5, 0.5)/dim
+            rng = np.random.RandomState(seed)
+            rows = (
+                (rng.rand(self.vocab, self.dim) - 0.5) / self.dim
+            ).astype(self.dtype)
+        self.table = self._place(rows)
+
+    @classmethod
+    def zeros(cls, vocab: int, dim: int, *, mesh=None,
+              dtype=jnp.float32) -> "ShardedEmbeddingTable":
+        return cls(vocab, dim, mesh=mesh, dtype=dtype,
+                   rows=np.zeros((vocab, dim), dtype))
+
+    @classmethod
+    def from_rows(cls, rows, *, mesh=None) -> "ShardedEmbeddingTable":
+        rows = np.asarray(rows)
+        return cls(rows.shape[0], rows.shape[1], mesh=mesh,
+                   dtype=rows.dtype, rows=rows)
+
+    # -- placement / persistence ---------------------------------------
+
+    def _place(self, rows):
+        rows = np.asarray(rows)
+        if rows.shape != (self.vocab, self.dim):
+            raise ValueError(
+                f"rows shape {rows.shape} != ({self.vocab}, {self.dim})"
+            )
+        host = np.zeros((self.padded_vocab, self.dim), self.dtype)
+        host[: self.vocab] = rows
+        placed = jax.device_put(
+            host, NamedSharding(self.mesh, _ROW)
+        )
+        note_shard_bytes(self.shard_bytes(placed))
+        return placed
+
+    def shard_bytes(self, table=None) -> int:
+        """Bytes of ONE device's row shard (what
+        ``embedding_shard_bytes`` publishes; ~1/N of
+        ``replicated_bytes``)."""
+        t = self.table if table is None else table
+        shards = t.addressable_shards
+        return int(shards[0].data.nbytes) if shards else 0
+
+    def replicated_bytes(self) -> int:
+        """Bytes a replicated copy of the (padded) table would pin on
+        EVERY device — the baseline the shard ratio is measured
+        against."""
+        return self.padded_vocab * self.dim * self.dtype.itemsize
+
+    def to_host(self) -> np.ndarray:
+        """Canonical unpadded host rows — the mesh-independent form
+        checkpoints persist (gather-then-save; restore re-shards onto
+        whatever mesh is present)."""
+        return np.asarray(self.table)[: self.vocab].copy()
+
+    def restore_rows(self, rows) -> None:
+        """Re-place canonical host rows onto THIS table's mesh (the
+        resume half of the canonicalize-gather-then-reshard
+        discipline; the source mesh's width is irrelevant)."""
+        self.table = self._place(rows)
+
+    # -- ops ------------------------------------------------------------
+
+    def lookup(self, ids):
+        """``table[ids]`` (canonical row values, any id shape), via the
+        sharded owned-rows gather + psum exchange. Bitwise equal to an
+        unsharded gather."""
+        t0 = time.perf_counter()
+        out = _build_lookup(self.mesh)(
+            self.table, jnp.asarray(ids, jnp.int32)
+        )
+        out.block_until_ready()
+        note_lookup_ms((time.perf_counter() - t0) * 1000.0)
+        return out
+
+    def apply_sparse_grads(self, ids, grads, lr) -> int:
+        """SGD row update from per-occurrence gradients: dedup +
+        ``segment_sum`` + owner scatter-add. Returns (and gauges) the
+        unique rows touched; cost scales with that count, not with
+        ``V``. ``ids``/``grads`` may carry extra leading dims."""
+        ids = jnp.asarray(ids, jnp.int32)
+        grads = jnp.asarray(grads, self.dtype)
+        ids, grads = sparse.flatten_occurrences(ids, grads)
+        t0 = time.perf_counter()
+        self.table, n = _build_sparse_apply(self.mesh)(
+            self.table, ids, grads, jnp.asarray(lr, self.dtype)
+        )
+        self.table.block_until_ready()
+        note_scatter_ms((time.perf_counter() - t0) * 1000.0)
+        touched = int(n)
+        note_rows_touched(touched)
+        return touched

@@ -42,17 +42,15 @@ from deeplearning4j_tpu.nlp.vocab import (
 
 
 def _dense_rows() -> bool:
-    """Route table lookups through one-hot matmuls instead of gathers
-    on TPU (small vocabs only): the GRADIENT of a gather is a scatter-
-    add, which TPUs execute row-serially — the dominant cost of the NS
-    step at bench scale — while the gradient of ``one_hot @ table`` is
-    a transpose matmul on the MXU. The matmul runs at bf16 input
-    precision (f32 accumulation), so results match the gather path to
-    ~1e-4 — SGD-level rounding, within the statistical-parity contract
-    this trainer already documents vs the reference's racy hogwild
-    (module docstring; SURVEY.md §7 hard part 3). Both engine paths
-    (per-batch and scan) route through the same lookup, so path-
-    equivalence tests stay exact. Env override: DL4J_TPU_W2V_DENSE=1/0."""
+    """Historical knob, kept for signature/compile-cache stability: it
+    used to route TPU lookups through a bf16 one-hot matmul (MXU-
+    friendly gradient), but that materialized a ``[B, V]`` one-hot and
+    rounded rows through bf16 — ``_rows`` is a plain gather on every
+    platform now, bitwise-identical across this flag. The value still
+    threads into the jitted steps as a static argument (so flipping
+    ``DL4J_TPU_W2V_DENSE`` still re-keys the compile cache exactly as
+    before), and sparse-gradient row updates live in
+    ``embeddings/sparse.py``. Env override: DL4J_TPU_W2V_DENSE=1/0."""
     import os
 
     from deeplearning4j_tpu.ops.dispatch import effective_platform
@@ -65,26 +63,25 @@ def _dense_rows() -> bool:
     return effective_platform() == "tpu"
 
 
-_DENSE_VOCAB_MAX = 8192  # above this the one-hot outweighs the scatter
-
-
 def _rows(table, ids, dense):
-    """table[ids] with a dense (MXU) gradient when allowed.
+    """table[ids] — always a gather, on every platform.
+
+    The ``dense=True`` branch used to lower this as
+    ``one_hot(ids, V, bf16) @ table``: that materializes a ``[B, V]``
+    one-hot (cost scales with VOCAB, not batch — the exact failure
+    mode the sharded embeddings subsystem exists to avoid) and rounds
+    the looked-up rows through bf16, so the two paths diverged by
+    ~1e-4. ``jnp.take`` keeps the lookup O(B·D) and bitwise-identical
+    whichever way ``dense`` is flipped; the MXU-gradient question is
+    the sparse update's job now (``embeddings/sparse.py``).
 
     ``dense`` is REQUIRED and must be threaded in as a STATIC jit
-    argument by the callers — reading the env var at trace time would
-    let a flipped ``DL4J_TPU_W2V_DENSE`` silently keep the previously
-    compiled path for already-seen shapes (the compile cache is keyed
-    only on shapes/dtypes)."""
-    if dense and table.shape[0] <= _DENSE_VOCAB_MAX:
-        oh = jax.nn.one_hot(
-            ids, table.shape[0], dtype=jnp.bfloat16
-        )
-        return jnp.einsum(
-            "...v,vd->...d", oh, table,
-            preferred_element_type=table.dtype,
-        )
-    return table[ids]
+    argument by the callers — it no longer changes the math (tests
+    assert bitwise-equal loss across it), but it stays in every step
+    signature so compile-cache keys and the ``DL4J_TPU_W2V_DENSE``
+    override surface are unchanged."""
+    del dense
+    return jnp.take(table, ids, axis=0)
 
 
 def _ns_step_raw(syn0, syn1neg, centers, contexts, negs, mask, alpha,
@@ -494,10 +491,7 @@ class SequenceVectors:
         # last fit stopped instead of restarting it
         self._dev_fit_no = 0
         self._dev_steps_done = 0
-        self.lookup = InMemoryLookupTable(
-            cache, layer_size, seed=seed, use_hs=use_hierarchic_softmax,
-            negative=negative,
-        )
+        self.lookup = self._make_lookup()
         self._rng = np.random.RandomState(seed)
         if use_hierarchic_softmax:
             huff = Huffman(cache.words)
@@ -506,6 +500,16 @@ class SequenceVectors:
         if negative > 0:
             self._table = build_unigram_table(cache)
         self._counts = np.array([w.count for w in cache.words], np.int64)
+
+    def _make_lookup(self) -> InMemoryLookupTable:
+        """Lookup-table factory hook: the mesh-sharded subclass
+        (``embeddings/word2vec.py``) substitutes row-sharded tables
+        here, so the dense ``[V, D]`` device arrays never allocate for
+        vocabularies that don't fit one device."""
+        return InMemoryLookupTable(
+            self.cache, self.layer_size, seed=self.seed,
+            use_hs=self.use_hs, negative=self.negative,
+        )
 
     # -- corpus plumbing ----------------------------------------------------
 

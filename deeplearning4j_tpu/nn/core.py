@@ -1545,11 +1545,16 @@ def can_megastep(model) -> bool:
     recurrent models (conservative — per-step semantics preserved via
     fallback), a ROLLBACK-policy guard (its host restore must
     interrupt the trajectory mid-chunk, which a fused dispatch cannot
-    do), and listeners that neither declare
+    do), row-sharded embeddings (the K-step scan carry would bake the
+    ``P("data", None)`` table layout into a program the megastep
+    cache/AOT identity doesn't key on — per-step dispatch preserves
+    semantics), and listeners that neither declare
     ``supports_batched_iterations`` nor implement ``chunk_done``."""
     from deeplearning4j_tpu.resilience.guard import ROLLBACK
 
     if not megastep_active(model):
+        return False
+    if has_row_sharded_embedding(model):
         return False
     conf = model.conf
     guard = getattr(model, "divergence_guard", None)
@@ -2040,6 +2045,12 @@ def transform_kind_suffix(model) -> str:
         # the plain XLA walk; an executable compiled with the kernels
         # off must be refused when dispatch is on (and vice versa)
         parts.append("convblock")
+    if has_row_sharded_embedding(model):
+        # a +semb executable was traced with the embedding table's
+        # rows sharded P("data", None); feeding it replicated params
+        # (or vice versa) would silently recompile or mis-place — the
+        # suffix forces the refusal path instead
+        parts.append("semb")
     return ("+" + "+".join(parts)) if parts else ""
 
 
@@ -2053,6 +2064,24 @@ def _model_layer_confs(model):
     verts = getattr(conf, "vertices", None) or {}
     return [lc for lc in (v.layer() for v in verts.values())
             if lc is not None]
+
+
+def has_row_sharded_embedding(model) -> bool:
+    """True when either engine's config carries a
+    ``SparseEmbeddingLayer`` with ``row_sharded=True`` — the marker
+    the eligibility gates key on: ``DistributedTrainer`` shards that
+    layer's ``W`` rows ``P("data", None)`` and must take the GSPMD
+    step, ZeRO keeps the param replicated, and megastep refuses the
+    model (see each gate's comment)."""
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        SparseEmbeddingLayer,
+    )
+
+    return any(
+        isinstance(lc, SparseEmbeddingLayer)
+        and getattr(lc, "row_sharded", False)
+        for lc in _model_layer_confs(model)
+    )
 
 
 def conv_block_dispatch_active(model) -> bool:

@@ -17,6 +17,7 @@ from deeplearning4j_tpu.nn.layers.feedforward import (  # noqa: F401
     EmbeddingLayer,
     LossLayer,
     OutputLayer,
+    SparseEmbeddingLayer,
 )
 from deeplearning4j_tpu.nn.layers.convolution import (  # noqa: F401
     BatchNormalization,

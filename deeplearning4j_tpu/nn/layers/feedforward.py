@@ -175,3 +175,31 @@ class EmbeddingLayer(FeedForwardLayerSpec):
         idx = x.reshape(-1).astype(jnp.int32)
         out = params["W"][idx] + params["b"]
         return self.activate_fn()(out), state
+
+
+@register_layer
+@dataclass(frozen=True)
+class SparseEmbeddingLayer(EmbeddingLayer):
+    """EmbeddingLayer whose ``[vocab, dim]`` table is a MESH resource:
+    under ``DistributedTrainer`` the ``W`` rows shard ``P("data",
+    None)`` over the data axis (the ``embeddings/`` subsystem's
+    sharding shape), so table capacity — and, under GSPMD, the
+    gradient/updater rows for it — scales with mesh width instead of
+    one device's memory. The forward is the same gather as the base
+    layer; the partitioning is declared by the TRAINER's rules keying
+    on this type, which keeps the layer itself engine-agnostic (both
+    engines build it through ``nn/core.py``: guard, telemetry, AOT
+    ``_step_kind`` identity and checkpoint canonicalize-gather-then-
+    reshard all treat ``W`` as an ordinary param).
+
+    Eligibility fallbacks (sparse rows don't compose everywhere):
+    megastep refuses models carrying this layer (``core.can_megastep``
+    — the fused K-step scan would bake the row sharding into its
+    carry), ``zero=True`` keeps ``W`` replicated (the flat ``P("data")``
+    moment layout and the row layout can't both own the data axis),
+    and the trainer always takes the GSPMD step (the shard_map step
+    replicates every param per device). ``row_sharded=False`` opts a
+    layer back into plain replicated behavior without a config change.
+    """
+
+    row_sharded: bool = True

@@ -19,6 +19,7 @@ Two modes, matching the reference's semantics split:
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -96,6 +97,23 @@ def default_partition_rules(layer, param_name: str, shape) -> P:
         if param_name in ("W", "RW", "WF", "WB", "RWF", "RWB"):
             return P(None, "model")  # column parallel
     return P()  # replicate biases / small vectors
+
+
+def _row_sharded_embedding_param(layer, param_name: str) -> bool:
+    """The ``embeddings/`` sharding shape inside the engines: a
+    ``SparseEmbeddingLayer``'s table rows partition over the DATA axis
+    (independent of tensor_parallel — this is capacity sharding, not
+    TP), so the table, and under GSPMD its gradient and updater rows,
+    scale with mesh width."""
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        SparseEmbeddingLayer,
+    )
+
+    return (
+        isinstance(layer, SparseEmbeddingLayer)
+        and getattr(layer, "row_sharded", False)
+        and param_name == "W"
+    )
 
 
 class DistributedTrainer:
@@ -263,6 +281,11 @@ class DistributedTrainer:
     def _pick_shard_map(self, has_masks: bool) -> bool:
         if self.tensor_parallel:
             return False
+        if core.has_row_sharded_embedding(self.model):
+            # the shard_map step replicates every param per device —
+            # the opposite of a row-sharded table; GSPMD places the
+            # P("data", None) W and shards its gradient to match
+            return False
         if self.zero:
             # the flattened P("data") updater layout is a GSPMD
             # sharding; the shard_map step would replicate it again
@@ -296,10 +319,36 @@ class DistributedTrainer:
         return m.conf.layers[idx]
 
     def _spec_for(self, lname: str, pname: str, arr) -> P:
+        layer = self._layer_of(lname)
+        if _row_sharded_embedding_param(layer, pname):
+            # Eligibility fallbacks, loud not silent:
+            # - zero=True: the flattened P("data") moment layout and
+            #   the row-sharded param layout can't both own the data
+            #   axis for this leaf — keep W replicated under zero.
+            # - vocab not divisible by the data axis: replicate
+            #   (ShardedEmbeddingTable pads; engine params don't).
+            if self.zero:
+                warnings.warn(
+                    f"SparseEmbeddingLayer {lname!r}: row sharding "
+                    "falls back to replication under zero=True (the "
+                    "flat P('data') updater layout owns the data "
+                    "axis); use the embeddings/ subsystem for tables "
+                    "that need both", stacklevel=3,
+                )
+                return P()
+            if arr.shape[0] % self.mesh.shape["data"] == 0:
+                return P("data", None)
+            warnings.warn(
+                f"SparseEmbeddingLayer {lname!r}: vocab "
+                f"{arr.shape[0]} not divisible by data axis "
+                f"{self.mesh.shape['data']}; falling back to "
+                "replication", stacklevel=3,
+            )
+            return P()
         if not self.tensor_parallel:
             return P()
         spec = self.partition_rules(
-            self._layer_of(lname), pname, arr.shape
+            layer, pname, arr.shape
         )
         # Fall back to replication when a sharded dim isn't divisible
         # by its mesh axis (e.g. a 3-class output head on model=4).
@@ -407,6 +456,28 @@ class DistributedTrainer:
                 shard_bytes += nb
         self._m_upd_bytes.set(float(per_dev))
         self._m_zero_shard_bytes.set(float(shard_bytes))
+        self._publish_embedding_gauge()
+
+    def _publish_embedding_gauge(self) -> None:
+        """Per-device residency of row-sharded embedding tables (the
+        ``embedding_shard_bytes`` the embeddings/ subsystem also
+        publishes): bytes of ONE device's shard of every
+        SparseEmbeddingLayer ``W``, summed."""
+        if not core.has_row_sharded_embedding(self.model):
+            return
+        total = 0
+        for lname, lp in self.model.params.items():
+            if not _row_sharded_embedding_param(
+                self._layer_of(lname), "W"
+            ) or "W" not in lp:
+                continue
+            w = lp["W"]
+            shards = getattr(w, "addressable_shards", None)
+            if shards:
+                total += int(shards[0].data.nbytes)
+        from deeplearning4j_tpu.embeddings.table import note_shard_bytes
+
+        note_shard_bytes(total)
 
     # -- step -----------------------------------------------------------
 

@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Sharded-embeddings smoke benchmark (CPU, seeded, seconds).
+
+A/Bs the ``embeddings/`` subsystem against its dense single-device
+equivalents on an 8-virtual-device mesh and prints ONE JSON line::
+
+    {"vocab": ..., "dim": ..., "batch": ...,
+     "residency": {"shard_bytes": ..., "replicated_bytes": ...,
+                   "bytes_per_device_ratio": ...},
+     "sparse_update": {"sparse_steps_per_s": ...,
+                       "dense_steps_per_s": ..., "speedup": ...,
+                       "rows_touched": ..., "bitwise_match": true},
+     "fused_step": {"sharded_steps_per_s": ...,
+                    "single_steps_per_s": ..., "loss_parity": true},
+     "windows": ...}
+
+The acceptance gates this makes falsifiable on CPU:
+
+- ``bytes_per_device_ratio`` <= 0.15: one device holds ~1/8 of the
+  table (the capacity claim — the largest trainable vocabulary
+  scales with the mesh instead of one device's HBM);
+- ``sparse_update.bitwise_match``: the deduped segment-sum +
+  owner-side scatter produces bit-identical rows to a dense
+  ``[V, D]``-cotangent SGD step — sparsity changes the cost shape,
+  never the bits;
+- ``sparse_update.speedup`` > 1 at this vocab: per-step update cost
+  scales with the unique rows in the batch, not with ``V`` (the
+  dense step materializes and subtracts a full ``[V, D]`` array);
+- ``fused_step.loss_parity``: the sharded collective-lookup fused
+  skip-gram/NS step computes the same loss as the single-device
+  reference step (allclose; reduction orders differ across the
+  psum). Sharded steps/sec is reported honestly — on a CPU host the
+  8-way collective exchange is overhead, the win is capacity; real
+  TPU meshes get the ICI bandwidth this shape is designed for.
+
+Windows are interleaved A/B best-of-N (host noise only ever slows a
+run). Runnable standalone (``python scripts/bench_embeddings.py``)
+or from ``bench.py``'s ``embeddings`` section under
+``BENCH_BUDGET_S``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _timed_steps(fn, n_steps: int) -> float:
+    """Wall seconds for n_steps sequential calls of fn (each call must
+    block on its own result)."""
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        fn()
+    return time.perf_counter() - t0
+
+
+def bench_sparse_vs_dense_update(vocab, dim, batch, steps, windows,
+                                 deadline):
+    """Per-step wall of the deduped sparse row update vs a dense
+    [V, D]-cotangent SGD step, interleaved, plus the bitwise gate."""
+    from deeplearning4j_tpu.embeddings import sparse
+    from deeplearning4j_tpu.embeddings.table import (
+        ShardedEmbeddingTable,
+    )
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, batch).astype(np.int32)
+    grads = rng.randn(batch, dim).astype(np.float32)
+    lr = 0.05
+
+    t = ShardedEmbeddingTable.zeros(vocab, dim)
+    rows0 = t.to_host()
+
+    @jax.jit
+    def dense_step(table, ids, grads):
+        # the cost shape the subsystem exists to avoid: a full [V, D]
+        # cotangent materialized and subtracted every step
+        cot = jnp.zeros_like(table).at[ids].add(grads)
+        return table - lr * cot
+
+    dense_table = jnp.asarray(rows0)
+    jids, jgrads = jnp.asarray(ids), jnp.asarray(grads)
+
+    # one warm-up + bitwise gate on the FIRST step of each path
+    touched = t.apply_sparse_grads(ids, grads, lr)
+    dense_table = dense_step(dense_table, jids, jgrads)
+    dense_table.block_until_ready()
+    bitwise = bool(
+        np.array_equal(t.to_host(), np.asarray(dense_table)[:vocab])
+    )
+
+    best_sparse = best_dense = float("inf")
+    done = 0
+    for _ in range(windows):
+        if time.monotonic() > deadline:
+            break
+        best_sparse = min(best_sparse, _timed_steps(
+            lambda: t.apply_sparse_grads(ids, grads, lr), steps))
+
+        def one_dense():
+            nonlocal dense_table
+            dense_table = dense_step(dense_table, jids, jgrads)
+            dense_table.block_until_ready()
+
+        best_dense = min(best_dense, _timed_steps(one_dense, steps))
+        done += 1
+    return {
+        "sparse_steps_per_s": round(steps / best_sparse, 2),
+        "dense_steps_per_s": round(steps / best_dense, 2),
+        "speedup": round(best_dense / best_sparse, 3),
+        "rows_touched": int(touched),
+        "bitwise_match": bitwise,
+        "windows_completed": done,
+    }
+
+
+def bench_fused_step(vocab, dim, batch, negatives, steps, windows,
+                     deadline):
+    """Throughput of the fused sharded skip-gram/NS step vs the jitted
+    single-device reference step, same seeded batch; parity gate on
+    the loss."""
+    from deeplearning4j_tpu.embeddings.table import (
+        ShardedEmbeddingTable,
+        _build_sg_ns_step,
+    )
+    from deeplearning4j_tpu.nlp.word2vec import _ns_step_raw
+
+    rng = np.random.RandomState(1)
+    centers = jnp.asarray(rng.randint(0, vocab, batch), jnp.int32)
+    contexts = jnp.asarray(rng.randint(0, vocab, batch), jnp.int32)
+    negs = jnp.asarray(
+        rng.randint(0, vocab, (batch, negatives)), jnp.int32
+    )
+    mask = jnp.ones(batch, jnp.float32)
+    alpha = jnp.float32(0.025)
+
+    rows0 = ((np.random.RandomState(2).rand(vocab, dim) - 0.5)
+             / dim).astype(np.float32)
+    s0 = ShardedEmbeddingTable.from_rows(rows0)
+    s1 = ShardedEmbeddingTable.zeros(vocab, dim)
+    step_fn = _build_sg_ns_step(s0.mesh)
+
+    ref_step = jax.jit(_ns_step_raw, static_argnums=(7,))
+    r0, r1 = jnp.asarray(rows0), jnp.zeros((vocab, dim), jnp.float32)
+
+    # warm-up + loss parity on step 1
+    a0, a1, sh_loss, _ = step_fn(s0.table, s1.table, centers, contexts,
+                                 negs, mask, alpha)
+    r0, r1, ref_loss = ref_step(r0, r1, centers, contexts, negs, mask,
+                                alpha, False)
+    parity = bool(np.allclose(float(sh_loss), float(ref_loss),
+                              atol=1e-6))
+    state = {"t": (a0, a1), "r": (r0, r1)}
+
+    def one_sharded():
+        t0, t1 = state["t"]
+        t0, t1, loss, _ = step_fn(t0, t1, centers, contexts, negs,
+                                  mask, alpha)
+        loss.block_until_ready()
+        state["t"] = (t0, t1)
+
+    def one_single():
+        t0, t1 = state["r"]
+        t0, t1, loss = ref_step(t0, t1, centers, contexts, negs, mask,
+                                alpha, False)
+        loss.block_until_ready()
+        state["r"] = (t0, t1)
+
+    best_sh = best_single = float("inf")
+    done = 0
+    for _ in range(windows):
+        if time.monotonic() > deadline:
+            break
+        best_sh = min(best_sh, _timed_steps(one_sharded, steps))
+        best_single = min(best_single, _timed_steps(one_single, steps))
+        done += 1
+    return {
+        "sharded_steps_per_s": round(steps / best_sh, 2),
+        "single_steps_per_s": round(steps / best_single, 2),
+        "loss_parity": parity,
+        "windows_completed": done,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--vocab", type=int, default=65536)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--negatives", type=int, default=5)
+    ap.add_argument("--steps", type=int, default=8,
+                    help="steps per timing window")
+    ap.add_argument("--windows", type=int, default=3,
+                    help="interleaved best-of windows")
+    ap.add_argument("--fused-vocab", type=int, default=4096,
+                    help="vocab for the fused-step A/B (the dense "
+                    "reference must also fit comfortably)")
+    ap.add_argument("--budget-s", type=float, default=0,
+                    help="wall budget; 0 = unbounded")
+    args = ap.parse_args()
+
+    deadline = (time.monotonic() + args.budget_s if args.budget_s
+                else float("inf"))
+
+    from deeplearning4j_tpu.embeddings.table import (
+        ShardedEmbeddingTable,
+    )
+
+    t = ShardedEmbeddingTable.zeros(args.vocab, args.dim)
+    n_dev = t.n_shards
+    residency = {
+        "shard_bytes": t.shard_bytes(),
+        "replicated_bytes": t.replicated_bytes(),
+        "bytes_per_device_ratio": round(
+            t.shard_bytes() / t.replicated_bytes(), 4
+        ),
+        "devices": n_dev,
+    }
+    del t
+
+    doc = {
+        "vocab": args.vocab, "dim": args.dim, "batch": args.batch,
+        "windows": args.windows,
+        "residency": residency,
+        "sparse_update": bench_sparse_vs_dense_update(
+            args.vocab, args.dim, args.batch, args.steps,
+            args.windows, deadline,
+        ),
+        "fused_step": bench_fused_step(
+            args.fused_vocab, args.dim, args.batch, args.negatives,
+            args.steps, args.windows, deadline,
+        ),
+    }
+    ok = (
+        doc["residency"]["bytes_per_device_ratio"] <= 1.0 / n_dev + 0.02
+        and doc["sparse_update"]["bitwise_match"]
+        and doc["fused_step"]["loss_parity"]
+    )
+    doc["embeddings_ok"] = bool(ok)
+    print(json.dumps(doc))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -251,6 +251,77 @@ def check_megastep_readback(errors: list) -> None:
         )
 
 
+def check_embedding_locality(errors: list) -> None:
+    """Collective-locality rules for the sharded embeddings subsystem.
+
+    1. Raw cross-device collectives (``FORBIDDEN_COLLECTIVES``) may be
+       CALLED only under ``parallel/``, in ``nn/core.py``, or in
+       ``embeddings/table.py`` — the subsystem's one designated
+       collective site. A workload module (``embeddings/word2vec.py``,
+       ``embeddings/deepwalk.py``) growing its own psum has re-inlined
+       the exchange the table owns; anywhere else it has re-inlined a
+       distribution concern (same rationale as the engine rule above).
+    2. ``segment_sum`` — the sparse scatter-add primitive — may be
+       called only in ``embeddings/`` + ``nn/core.py``: a layer or
+       workload summing duplicate-id gradients itself bypasses the
+       dedup contract (PAD_ID padding, sorted-order determinism) the
+       cross-mesh bitwise tests pin down in ``embeddings/sparse.py``.
+    3. ``embeddings/table.py`` must consult the shard_map machinery it
+       claims to ride (``shard_map_compat`` from ``parallel/compat``)
+       — a raw ``jax.shard_map``/``Mesh`` context grown there would
+       bypass the version-compat shim every other mesh program uses.
+    """
+    pkg = REPO / "deeplearning4j_tpu"
+    emb_dir = pkg / "embeddings"
+    table_py = emb_dir / "table.py"
+    collective_ok = lambda p: (  # noqa: E731
+        (pkg / "parallel") in p.parents
+        or p == CORE
+        or p == table_py
+    )
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            if cn in FORBIDDEN_COLLECTIVES and not collective_ok(path):
+                errors.append(
+                    f"{path.relative_to(REPO)}:{node.lineno}: calls "
+                    f"{cn}() — raw collectives live only in parallel/, "
+                    "nn/core.py, and embeddings/table.py (the "
+                    "subsystem's designated collective site)"
+                )
+            if cn == "segment_sum" and not (
+                emb_dir in path.parents or path == CORE
+            ):
+                errors.append(
+                    f"{path.relative_to(REPO)}:{node.lineno}: calls "
+                    "segment_sum() — sparse scatter-add dedup lives in "
+                    "embeddings/ (+ nn/core.py); use "
+                    "embeddings.sparse.dedup_segment_sum"
+                )
+    if table_py.exists():
+        tree = ast.parse(table_py.read_text(), filename=str(table_py))
+        names = {
+            n.attr if isinstance(n, ast.Attribute) else
+            getattr(n, "id", "")
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.Attribute, ast.Name))
+        }
+        if "shard_map_compat" not in names:
+            errors.append(
+                "embeddings/table.py: never consults "
+                "shard_map_compat() — mesh programs ride the "
+                "parallel/compat shim, not a raw shard_map"
+            )
+    else:
+        errors.append(
+            "embeddings/table.py: missing — the collective-locality "
+            "rule has nothing to protect"
+        )
+
+
 def check_core(errors: list) -> None:
     tree = ast.parse(CORE.read_text(), filename=str(CORE))
     defined = {
@@ -271,6 +342,7 @@ def main() -> int:
     for name, path in ENGINES.items():
         check_engine(name, path, errors)
     check_pallas_locality(errors)
+    check_embedding_locality(errors)
     if errors:
         print("engine/core parity violations:", file=sys.stderr)
         for e in errors:
@@ -279,7 +351,8 @@ def main() -> int:
     print(
         "lint_parity: both engines delegate step/apply/fit hot paths "
         "to nn/core.py; Pallas kernels stay in ops/ behind dispatch; "
-        "megastep drivers keep one readback site"
+        "megastep drivers keep one readback site; embedding "
+        "collectives stay in embeddings/table.py"
     )
     return 0
 

@@ -145,6 +145,16 @@ python scripts/perf_gate.py || exit 1
 #                                  either lands whole or aborts, and
 #                                  the restored shards merge bitwise
 #                                  onto a 1-device mesh)
+#   tests/test_embeddings.py     — sharded embeddings: a ShardedWord2Vec
+#                                  run on the 8-device mesh is killed
+#                                  with os._exit(137) at a seed-derived
+#                                  step mid-epoch (no cleanup, no
+#                                  flush); a second process restores the
+#                                  last write-behind checkpoint on ONE
+#                                  device and finishes — final tables
+#                                  bitwise equal to an uninterrupted
+#                                  run (the canonical-host-rows +
+#                                  mesh-independent-update contract)
 STORMS=(
     tests/test_resilience.py
     tests/test_serving.py
@@ -160,6 +170,7 @@ STORMS=(
     tests/test_profiler.py
     tests/test_control_plane.py
     tests/test_async_checkpoint.py
+    tests/test_embeddings.py
 )
 
 declare -a names rcs
