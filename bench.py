@@ -1719,6 +1719,38 @@ def bench_fused_kernels(budget_s=None) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_kernel_autotune(budget_s=None) -> dict:
+    """Autotuner A/B via ``scripts/bench_kernels.py --tuned``: a cold
+    ``DL4J_TPU_TUNE=on`` pass searches conv/matmul tilings into a
+    fresh cache (heuristic measured first and budget-exempt, winner =
+    argmin of the same interleaved timings, so the per-config delta is
+    non-negative by construction), then a warm ``cached``-mode pass
+    re-resolves every entry from disk with the search and measurement
+    counters asserted at ZERO. Gates: non-negative ``tuned_delta`` per
+    kernel, warm-cache zero measurements, and cold/warm config
+    agreement (``autotune_ok`` rolls them up)."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_kernels.py",
+    )
+    timeout = 240
+    if budget_s is not None:
+        timeout = max(30, min(timeout, int(budget_s)))
+    out = subprocess.run(
+        [sys.executable, script, "--tuned",
+         "--budget-s", str(max(10, timeout - 10))],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ,
+             "JAX_COMPILATION_CACHE_DIR": _COMPILE_CACHE or ""},
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"bench_kernels --tuned failed (delta or warm-cache "
+            f"gate): {out.stderr[-2000:] or out.stdout[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_observability(iters=300, windows=5) -> dict:
     """Overhead of the observability substrate on the two hot paths.
 
@@ -2075,6 +2107,12 @@ def _section_table(budget_fn):
          "A/B per config (scripts/bench_kernels.py; parity <= 1e-5 "
          "and compiled-op round-trip evidence are the gates; "
          "timing + MFU delta on real TPUs only)"),
+        ("kernel_autotune",
+         lambda: bench_kernel_autotune(budget_fn()),
+         "measured tiling search vs divisor heuristic "
+         "(scripts/bench_kernels.py --tuned; non-negative "
+         "tuned_delta per kernel and a warm cached-mode pass with "
+         "ZERO searches/measurements are the gates)"),
     ]
 
 

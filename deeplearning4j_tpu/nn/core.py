@@ -2040,11 +2040,16 @@ def transform_kind_suffix(model) -> str:
         # layout; a stale plain-step artifact must be refused, not
         # fed flat state (and vice versa)
         parts.append("zero")
-    if conv_block_dispatch_active(model):
+    kernels = kernel_kind_suffix(model)
+    if kernels:
         # Pallas fused conv/dense kernels produce different HLO than
         # the plain XLA walk; an executable compiled with the kernels
-        # off must be refused when dispatch is on (and vice versa)
-        parts.append("convblock")
+        # off must be refused when dispatch is on (and vice versa).
+        # "+tuned" extends the same refusal to the autotuner: measured
+        # block configs change the kernels' tiling (and thus the HLO),
+        # so an artifact compiled with tuning off must not install
+        # while tuning is active (and vice versa).
+        parts.extend(kernels.lstrip("+").split("+"))
     if has_row_sharded_embedding(model):
         # a +semb executable was traced with the embedding table's
         # rows sharded P("data", None); feeding it replicated params
@@ -2052,6 +2057,21 @@ def transform_kind_suffix(model) -> str:
         # suffix forces the refusal path instead
         parts.append("semb")
     return ("+" + "+".join(parts)) if parts else ""
+
+
+def kernel_kind_suffix(model) -> str:
+    """The Pallas-kernel part of an AOT artifact kind, shared by the
+    training-step suffix above and both engines' inference
+    ``_output_kind``: ``+convblock`` when fused kernel dispatch is
+    active, plus ``+tuned`` when the autotuner may swap in measured
+    block configs (``DL4J_TPU_TUNE`` != off) — tuned tilings compile
+    different HLO, so a mixed artifact must be refused, not
+    mis-dispatched."""
+    if not conv_block_dispatch_active(model):
+        return ""
+    from deeplearning4j_tpu.ops import autotune
+
+    return "+convblock" + ("+tuned" if autotune.tuning_active() else "")
 
 
 def _model_layer_confs(model):

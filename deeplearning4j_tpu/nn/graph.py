@@ -920,8 +920,7 @@ class ComputationGraph:
         # scan AND kernel dispatch both change the compiled inference
         # program (conv/dense kernels + the eval conv->BN peephole)
         return ("output" + ("+scan" if self.scan_layers else "")
-                + ("+convblock"
-                   if core.conv_block_dispatch_active(self) else ""))
+                + core.kernel_kind_suffix(self))
 
     def aot_fingerprint(self, shapes, kind: Optional[str] = None) -> str:
         from deeplearning4j_tpu.compile.aot import artifact_fingerprint

@@ -1059,8 +1059,7 @@ class MultiLayerNetwork:
         remat/loss-scale do not touch inference), so both are part of
         the artifact identity."""
         return ("output" + ("+scan" if self.scan_layers else "")
-                + ("+convblock"
-                   if core.conv_block_dispatch_active(self) else ""))
+                + core.kernel_kind_suffix(self))
 
     def aot_fingerprint(self, shape, kind: Optional[str] = None) -> str:
         """Validity fingerprint for this model's AOT artifacts at

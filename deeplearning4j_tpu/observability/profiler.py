@@ -235,6 +235,20 @@ def output_cost_key(model, batch_shape, dtype) -> str:
     return f"{kind}:{_shape_tag(batch_shape)}:{dtype}"
 
 
+def kernel_cost_key(kernel: str, identity: dict,
+                    config=None) -> str:
+    """Cost-model identity of ONE Pallas kernel variant — the
+    autotuner's prior records. Same spirit as ``step_cost_key``: the
+    kernel kind plus the exact shape/dtype identity the tuning cache
+    is keyed by, with the candidate block config appended when the
+    record describes one specific tiling."""
+    tag = ";".join(f"{k}={identity[k]}" for k in sorted(identity))
+    key = f"kernel:{kernel}:{tag}"
+    if config is not None:
+        key += ":cfg=" + "x".join(str(int(v)) for v in config)
+    return key
+
+
 class CostModelCache:
     """Per-executable cost models, computed once per shape/kind key.
 

@@ -3,8 +3,18 @@
 plain jax.numpy/lax — XLA's fusion already covers it; notably the
 embedding scatter-add and negative-sampling updates lower to native
 TPU scatter ops via ``jnp.ndarray.at``/``segment_sum``, so a custom
-kernel would only re-derive what the compiler emits."""
+kernel would only re-derive what the compiler emits.
 
+Block-size selection is centralized: ``ops/tiling.py`` owns the VMEM
+budget and every divisor heuristic, and ``ops/autotune.py`` runs the
+measured tiling search over the same candidate space
+(``DL4J_TPU_TUNE`` = off / cached / on) with winners persisted under
+``DL4J_TPU_TUNE_CACHE_DIR``."""
+
+from deeplearning4j_tpu.ops.autotune import (
+    tuning_active,
+    tuning_mode,
+)
 from deeplearning4j_tpu.ops.conv_block import (
     SUPPORTED_EPILOGUES,
     conv_block,
@@ -22,8 +32,10 @@ from deeplearning4j_tpu.ops.matmul_block import (
     matmul_block_ok,
     matmul_block_reference,
 )
+from deeplearning4j_tpu.ops.tiling import VMEM_BUDGET_BYTES
 
 __all__ = ["flash_attention", "mha", "lstm_cell", "lstm_cell_diff",
            "use_pallas_lstm", "conv_block", "conv_block_ok",
            "conv_block_reference", "matmul_block", "matmul_block_ok",
-           "matmul_block_reference", "SUPPORTED_EPILOGUES"]
+           "matmul_block_reference", "SUPPORTED_EPILOGUES",
+           "tuning_active", "tuning_mode", "VMEM_BUDGET_BYTES"]

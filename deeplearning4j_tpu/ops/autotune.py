@@ -1,0 +1,446 @@
+"""Measured tiling search with a persistent on-disk tuning cache.
+
+TVM-style autotuning for the Pallas kernel library: per
+(kernel, shape, dtype, backend) the tuner enumerates every
+VMEM-feasible block config from ``ops/tiling.py`` (the same module the
+divisor heuristics live in, so search and heuristic can never disagree
+about feasibility), ranks candidates by the ``CostModel`` prior
+(padded-MXU flops + modeled HBM refetch bytes), measures the top-K
+with interleaved best-of-N wall timing under a time budget, and
+persists the winner as an atomic JSON entry.
+
+Modes (``DL4J_TPU_TUNE``, read once per process like
+``DL4J_TPU_PALLAS`` and re-read only via ``reset_for_tests()``):
+
+* ``off``    — the divisor heuristic, byte-identical to the
+  pre-autotuner behavior; this module is never consulted.
+* ``cached`` — the zero-budget DEFAULT: dispatch persisted winners,
+  never measure; ANY cache miss (absent, corrupt, truncated, stale
+  fingerprint, config not feasible) silently degrades to the heuristic
+  and bumps ``tuner_fallback_total``.
+* ``on``     — measure misses under ``DL4J_TPU_TUNE_BUDGET_MS``, then
+  persist to ``DL4J_TPU_TUNE_CACHE_DIR``.
+
+Cache entries carry the same sha256 fingerprint discipline as
+``compile/aot.py`` artifacts — jax/jaxlib versions, backend, kernel
+kind, entry format — so a cache written by a different jaxlib or for a
+different backend is refused, never mis-applied. The heuristic config
+is always measured alongside the candidates, so a persisted winner is
+never slower than the heuristic *as measured* (the bench asserts the
+non-negative delta per config).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import (Any, Callable, Dict, List, Optional, Sequence,
+                    Tuple)
+
+_TUNE_FORMAT = 1
+_MODES = ("off", "cached", "on")
+_DEFAULT_BUDGET_MS = 2000.0
+_TOP_K = 4
+_MEASURE_ROUNDS = 3  # interleaved best-of-N: N rounds over candidates
+_MEASURE_INNER = 4   # timed calls per (candidate, round)
+
+_LOCK = threading.RLock()
+# (mode, cache_dir or None, budget_ms) — read once per process
+_ENV: Optional[Tuple[str, Optional[str], float]] = None
+# (kernel, digest) -> chosen config; the per-process resolution memo
+_RESOLVED: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+_FP_CACHE: Dict[str, str] = {}
+
+
+# --- env knobs (read-once discipline) --------------------------------------
+
+
+def _env() -> Tuple[str, Optional[str], float]:
+    global _ENV
+    if _ENV is None:
+        mode = os.environ.get("DL4J_TPU_TUNE", "cached").strip().lower()
+        if mode not in _MODES:
+            mode = "cached"
+        cache = os.environ.get("DL4J_TPU_TUNE_CACHE_DIR", "").strip()
+        try:
+            budget = float(os.environ.get("DL4J_TPU_TUNE_BUDGET_MS",
+                                          _DEFAULT_BUDGET_MS))
+        except ValueError:
+            budget = _DEFAULT_BUDGET_MS
+        _ENV = (mode, cache or None, budget)
+    return _ENV
+
+
+def tuning_mode() -> str:
+    """``off`` | ``cached`` | ``on`` (DL4J_TPU_TUNE, default cached)."""
+    return _env()[0]
+
+
+def tuning_active() -> bool:
+    """Whether tuned configs may replace the heuristic (mode != off).
+    Folded into the ``+tuned`` transform-kind suffix so AOT artifacts
+    exported without tuning refuse to install under it."""
+    return _env()[0] != "off"
+
+
+def cache_dir() -> Optional[str]:
+    return _env()[1]
+
+
+def measure_budget_ms() -> float:
+    return _env()[2]
+
+
+def reset_for_tests() -> None:
+    """Drop the cached env reads, the per-process resolution memo and
+    the fingerprint cache so the next kernel dispatch re-reads
+    ``DL4J_TPU_TUNE*`` and re-consults the on-disk cache. Cascaded
+    from ``ops.dispatch.reset_for_tests()`` (the autouse conftest
+    fixture), so every test starts with a cold tuner."""
+    global _ENV
+    with _LOCK:
+        _ENV = None
+        _RESOLVED.clear()
+        _FP_CACHE.clear()
+
+
+# --- observability ---------------------------------------------------------
+
+_METRICS_FOR = None
+
+
+def _tuner_metrics():
+    global _METRICS_FOR
+    from deeplearning4j_tpu.observability.metrics import default_registry
+
+    reg = default_registry()
+    if _METRICS_FOR is None or _METRICS_FOR[0] is not reg:
+        searches = reg.counter(
+            "tuner_searches_total",
+            help="measured tuning searches executed (mode=on misses)",
+            labels=("kernel",),
+        )
+        hits = reg.counter(
+            "tuner_cache_hits_total",
+            help="kernel dispatches resolved from a persisted tuning "
+                 "cache entry",
+            labels=("kernel",),
+        )
+        fallback = reg.counter(
+            "tuner_fallback_total",
+            help="tuning-cache misses degraded to the divisor "
+                 "heuristic, by reason (absent/corrupt/stale/invalid/"
+                 "measure)",
+            labels=("kernel", "reason"),
+        )
+        measure_ms = reg.histogram(
+            "tuner_measure_ms",
+            buckets=(0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 100.0, 300.0,
+                     1000.0, 3000.0),
+            help="wall time of one candidate measurement round (ms)",
+        )
+        block_cfg = reg.gauge(
+            "kernel_block_config",
+            help="info gauge: 1 for the block config each kernel "
+                 "currently dispatches (heuristic or tuned winner)",
+            labels=("kernel", "config"),
+        )
+        _METRICS_FOR = (reg, searches, hits, fallback, measure_ms,
+                        block_cfg)
+    return _METRICS_FOR[1:]
+
+
+def _cfg_tag(cfg: Sequence[int]) -> str:
+    return "x".join(str(int(v)) for v in cfg)
+
+
+# --- cache identity & IO ---------------------------------------------------
+
+
+def fingerprint(kernel: str) -> str:
+    """Environment fingerprint for tuning-cache entries — the
+    ``compile/aot.py`` discipline (jax/jaxlib versions, backend,
+    kind, format) so entries from another toolchain or backend are
+    refused as stale, never mis-applied."""
+    with _LOCK:
+        fp = _FP_CACHE.get(kernel)
+    if fp is not None:
+        return fp
+    import jax
+
+    from deeplearning4j_tpu.ops.dispatch import effective_platform
+
+    try:
+        import jaxlib
+        jaxlib_version = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover - jaxlib ships with jax
+        jaxlib_version = "?"
+    doc = json.dumps({
+        "kind": f"tune:{kernel}",
+        "backend": str(effective_platform()),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "format": _TUNE_FORMAT,
+    }, sort_keys=True)
+    fp = hashlib.sha256(doc.encode()).hexdigest()[:32]
+    with _LOCK:
+        _FP_CACHE[kernel] = fp
+    return fp
+
+
+def _digest(kernel: str, identity: Dict[str, Any]) -> str:
+    doc = json.dumps({"fingerprint": fingerprint(kernel),
+                      "identity": identity}, sort_keys=True)
+    return hashlib.sha256(doc.encode()).hexdigest()[:32]
+
+
+def entry_path(kernel: str, identity: Dict[str, Any]) -> Optional[str]:
+    """On-disk path a tuning entry for this (kernel, identity) lives
+    at, or None without a cache dir. Exposed for the bench and the
+    cache-integrity tests."""
+    d = cache_dir()
+    if not d:
+        return None
+    return os.path.join(d, f"{kernel}-{_digest(kernel, identity)}.json")
+
+
+def _persist(path: str, doc: Dict[str, Any]) -> None:
+    """Atomic write: temp file in the destination dir + os.replace, so
+    readers only ever see a complete entry (a crashed writer leaves a
+    temp file, never a truncated entry under the final name)."""
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _load_entry(kernel: str, path: Optional[str],
+                candidates: Sequence[Tuple[int, ...]],
+                ) -> Tuple[Optional[Tuple[int, ...]], str]:
+    """(config, reason) — config None unless reason == ``hit``.
+    Reasons: absent / corrupt / stale / invalid. A persisted config
+    that is no longer in the candidate set (VMEM budget or shape
+    formulas changed) is ``invalid``: refusing it is what "never
+    mis-applied" means."""
+    if path is None or not os.path.exists(path):
+        return None, "absent"
+    try:
+        with open(path, "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None, "corrupt"
+    if not isinstance(doc, dict):
+        return None, "corrupt"
+    if doc.get("format") != _TUNE_FORMAT:
+        return None, "stale"
+    if doc.get("fingerprint") != fingerprint(kernel):
+        return None, "stale"
+    if doc.get("kernel") != kernel:
+        return None, "stale"
+    cfg = doc.get("config")
+    if (not isinstance(cfg, (list, tuple)) or not cfg
+            or not all(isinstance(v, int) and not isinstance(v, bool)
+                       for v in cfg)):
+        return None, "corrupt"
+    cfg = tuple(int(v) for v in cfg)
+    if cfg not in set(map(tuple, candidates)):
+        return None, "invalid"
+    return cfg, "hit"
+
+
+def read_entry(kernel: str,
+               identity: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Raw persisted entry for (kernel, identity), or None. The bench
+    reads ``timings_ms`` from here for the tuned-vs-heuristic delta."""
+    path = entry_path(kernel, identity)
+    if path is None or not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+# --- measurement -----------------------------------------------------------
+
+
+def _measure_candidates(
+        kernel: str,
+        cfgs: Sequence[Tuple[int, ...]],
+        measure_factory: Callable[[Tuple[int, ...]],
+                                  Callable[[], Any]],
+        budget_ms: float,
+) -> Dict[Tuple[int, ...], float]:
+    """Interleaved best-of-N timing: N rounds over the candidate list
+    (so drift hits every candidate equally), keeping each candidate's
+    best round. The first listed candidate (the heuristic) is always
+    measured, budget or not; once the budget is spent, candidates
+    without a complete round are dropped rather than reported from
+    partial data."""
+    (_, _, _, measure_ms, _) = _tuner_metrics()
+    fns: Dict[Tuple[int, ...], Callable[[], Any]] = {}
+    best: Dict[Tuple[int, ...], float] = {}
+    start = time.perf_counter()
+
+    def spent_ms() -> float:
+        return (time.perf_counter() - start) * 1e3
+
+    for rnd in range(_MEASURE_ROUNDS):
+        for idx, cfg in enumerate(cfgs):
+            # heuristic (idx 0, round 0) is exempt from the budget so
+            # a winner can always be compared against it
+            if (rnd or idx) and spent_ms() > budget_ms:
+                return best
+            fn = fns.get(cfg)
+            if fn is None:
+                try:
+                    fn = measure_factory(cfg)
+                    fn()  # warmup: compile outside the timed region
+                except Exception:
+                    fns[cfg] = _FAILED
+                    continue
+                fns[cfg] = fn
+            if fn is _FAILED:
+                continue
+            t0 = time.perf_counter()
+            try:
+                for _ in range(_MEASURE_INNER):
+                    fn()
+            except Exception:
+                fns[cfg] = _FAILED
+                best.pop(cfg, None)
+                continue
+            ms = (time.perf_counter() - t0) * 1e3 / _MEASURE_INNER
+            measure_ms.observe(ms)
+            if cfg not in best or ms < best[cfg]:
+                best[cfg] = ms
+    return best
+
+
+def _FAILED() -> None:  # sentinel: candidate crashed during measure
+    raise RuntimeError("failed measurement candidate")
+
+
+def _search(kernel: str, identity: Dict[str, Any],
+            heuristic: Tuple[int, ...],
+            candidates: Sequence[Tuple[int, ...]],
+            cost_fn: Optional[Callable[[Tuple[int, ...]],
+                                       Tuple[float, float]]],
+            measure_factory: Callable[[Tuple[int, ...]],
+                                      Callable[[], Any]],
+            ) -> Tuple[int, ...]:
+    """Rank by the CostModel prior, measure heuristic + top-K, persist
+    the winner (with every candidate's timing, so the bench can report
+    the measured delta without re-running the search)."""
+    from deeplearning4j_tpu.observability.profiler import (
+        CostModel, kernel_cost_key)
+
+    (searches, _, fallback, _, _) = _tuner_metrics()
+    searches.labels(kernel=kernel).inc()
+
+    ranked = list(map(tuple, candidates))
+    if cost_fn is not None:
+        def prior(cfg):
+            flops, bytes_ = cost_fn(cfg)
+            cm = CostModel(key=kernel_cost_key(kernel, identity, cfg),
+                           flops=flops, bytes_accessed=bytes_)
+            return cm.flops + 8.0 * cm.bytes_accessed
+        ranked.sort(key=prior)
+    short = ranked[:_TOP_K]
+    if heuristic in short:
+        short.remove(heuristic)
+    short.insert(0, heuristic)  # measured first, budget-exempt
+
+    timings = _measure_candidates(kernel, short, measure_factory,
+                                  measure_budget_ms())
+    if heuristic not in timings:
+        fallback.labels(kernel=kernel, reason="measure").inc()
+        return heuristic
+    winner = min(timings, key=lambda c: timings[c])
+    path = entry_path(kernel, identity)
+    if path is not None:
+        _persist(path, {
+            "format": _TUNE_FORMAT,
+            "fingerprint": fingerprint(kernel),
+            "kernel": kernel,
+            "identity": identity,
+            "config": list(winner),
+            "best_ms": timings[winner],
+            "measured": len(timings),
+            "timings_ms": {_cfg_tag(c): t for c, t in timings.items()},
+        })
+    return winner
+
+
+# --- the resolution entry point --------------------------------------------
+
+
+def resolve(kernel: str,
+            identity: Dict[str, Any],
+            heuristic: Optional[Tuple[int, ...]],
+            candidates: Sequence[Tuple[int, ...]],
+            cost_fn: Optional[Callable[[Tuple[int, ...]],
+                                       Tuple[float, float]]] = None,
+            measure_factory: Optional[
+                Callable[[Tuple[int, ...]],
+                         Callable[[], Any]]] = None,
+            ) -> Optional[Tuple[int, ...]]:
+    """Resolve the block config one kernel dispatch should use.
+
+    ``heuristic`` is the divisor pick from ``ops/tiling.py`` (None
+    propagates untouched: infeasible stays infeasible — tuning never
+    changes ROUTING, only the block shape of an already-eligible
+    call). ``candidates`` is the feasible set from the same module;
+    a cache entry outside it is refused. ``measure_factory(cfg)``
+    returns a zero-arg callable running the kernel with that config
+    on canned inputs (only consulted in mode ``on``).
+
+    Resolution is memoized per process under the same fingerprint
+    digest the cache file is named by; ``reset_for_tests()`` clears
+    the memo."""
+    mode = tuning_mode()
+    if mode == "off" or heuristic is None:
+        return heuristic
+    heuristic = tuple(int(v) for v in heuristic)
+    key = (kernel, _digest(kernel, identity))
+    with _LOCK:
+        got = _RESOLVED.get(key)
+    if got is not None:
+        return got
+
+    (_, hits, fallback, _, block_cfg) = _tuner_metrics()
+    cand_list = [tuple(int(v) for v in c) for c in candidates]
+    cfg, reason = _load_entry(kernel, entry_path(kernel, identity),
+                              cand_list)
+    if cfg is not None:
+        hits.labels(kernel=kernel).inc()
+        chosen = cfg
+    elif mode == "cached" or measure_factory is None:
+        # zero-budget mode: ANY miss degrades to the heuristic
+        fallback.labels(kernel=kernel, reason=reason).inc()
+        chosen = heuristic
+    else:
+        if reason != "absent":
+            # refused entry (corrupt/stale/invalid): count it, then
+            # re-measure and overwrite
+            fallback.labels(kernel=kernel, reason=reason).inc()
+        chosen = _search(kernel, identity, heuristic, cand_list,
+                         cost_fn, measure_factory)
+    block_cfg.labels(kernel=kernel, config=_cfg_tag(chosen)).set(1.0)
+    with _LOCK:
+        _RESOLVED[key] = chosen
+    return chosen

@@ -22,10 +22,21 @@ operand. The transposes and the explicit zero-pad sit OUTSIDE the
 kernel where XLA fuses them; the epilogue round-trips are what this
 kernel deletes, not the relayout.
 
-Backward falls back to XLA (``jax.vjp`` through the reference math):
-the transposed convolutions lower straight to MXU convs that XLA
-already schedules well, so a hand kernel is not justified there —
-measured-first per the r5 roofline, same policy as ``lstm_cell``.
+Backward is hand-written Pallas too (same paper's recipe, so the whole
+conv hot path is measured kernels): dL/dx is a stride-1 direct conv of
+the interior-dilated, edge-padded gradient with the flipped/transposed
+weights — the SAME forward kernel on transformed operands; dL/dw is a
+dedicated kernel with batch as the innermost (revisited) grid axis,
+accumulating per-tap [C, oh*ow] x [oh*ow, oc_b] MXU products into an
+f32-resident [kh, kw, C, oc_b] output block. Both carry f32
+accumulators and fall back to ``jax.vjp`` through the XLA reference
+when their tilings don't fit VMEM — the same gate pattern as the
+forward.
+
+Block sizes come from ``ops/tiling.py`` (the shared divisor heuristic)
+and, when ``DL4J_TPU_TUNE`` is active, from the measured winners in
+``ops/autotune.py``. Both are resolved HERE at the public entry,
+before the custom-vjp boundary, so forward and backward always agree.
 """
 
 from __future__ import annotations
@@ -37,6 +48,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.ops import autotune, tiling
 
 
 # Epilogue nonlinearities the kernel applies in-register (in f32,
@@ -51,79 +64,41 @@ _EPILOGUES = {
 }
 SUPPORTED_EPILOGUES = tuple(_EPILOGUES)
 
-# Per-core VMEM is ~16 MB; leave headroom for Mosaic's own pipeline
-# buffers (same policy as lstm_cell's sequence kernel).
-_VMEM_BUDGET = 13 * 2 ** 20
-
-
-def _largest_divisor_leq(n: int, cap: int) -> int:
-    for d in range(min(n, cap), 0, -1):
-        if n % d == 0:
-            return d
-    return 1
-
-
-def _conv_geometry(x_shape, w_shape, stride, padding):
-    n, c, h, w = (int(v) for v in x_shape)
-    o, ci, kh, kw = (int(v) for v in w_shape)
-    sh, sw = stride
-    ph, pw = padding
-    hp, wp = h + 2 * ph, w + 2 * pw
-    oh = (hp - kh) // sh + 1
-    ow = (wp - kw) // sw + 1
-    return n, c, hp, wp, o, kh, kw, oh, ow
-
-
-def _pick_blocks(x_shape, w_shape, stride, padding, itemsize):
-    """(oc_block, oh_block) tiling, or None when nothing fits VMEM.
-
-    Residents: the full padded image of one batch item (its block index
-    is constant over the channel/spatial grid dims, so it is fetched
-    once per item), one weight tile, the f32 accumulator and the output
-    block. oc_block is capped at 128 (one MXU tile of output lanes);
-    oh_block shrinks toward 1 until the budget holds — odd geometries
-    always admit oh_block=1 unless the image itself overflows."""
-    n, c, hp, wp, o, kh, kw, oh, ow = _conv_geometry(
-        x_shape, w_shape, stride, padding
-    )
-    if oh <= 0 or ow <= 0:
-        return None
-    oc_b = _largest_divisor_leq(o, 128)
-    fixed = (hp * wp * c * itemsize            # padded image (resident)
-             + kh * kw * c * oc_b * itemsize   # weight tile
-             + 2 * oc_b * 4)                   # f32 scale/shift
-    if fixed > _VMEM_BUDGET:
-        return None
-    cols = (ow - 1) * stride[1] + 1
-    for oh_b in range(oh, 0, -1):
-        if oh % oh_b:
-            continue
-        rows = (oh_b - 1) * stride[0] + 1
-        per = (oh_b * ow * oc_b * (4 + itemsize)  # f32 acc + out block
-               + rows * cols * c * itemsize       # tap window view
-               + oh_b * ow * c * itemsize)        # matmul operand
-        if fixed + per <= _VMEM_BUDGET:
-            return oc_b, oh_b
-    return None
+# d(act)/dz on the f32 pre-activation — the backward's epilogue.
+# Numerics match jax.vjp through _EPILOGUES exactly: lax.max splits
+# the tie at z == 0 evenly (balanced_eq), hence relu's 0.5 there.
+_EPILOGUE_GRADS = {
+    "identity": lambda z: jnp.ones_like(z),
+    "relu": lambda z: jnp.where(
+        z > 0, 1.0, jnp.where(z == 0, 0.5, 0.0)),
+    "leakyrelu": lambda z: jnp.where(z >= 0, 1.0, 0.01),
+    "tanh": lambda z: 1.0 - jnp.square(jnp.tanh(z)),
+}
 
 
 def conv_block_ok(x_shape, w_shape, stride=(1, 1), padding=(0, 0),
                   dtype=jnp.float32) -> bool:
     """Gate: 4-d NCHW/OIHW geometry with matching channels and a
     VMEM-fitting tiling. Callers route to ``conv_block`` only when
-    this holds (else the plain XLA layer path)."""
+    this holds (else the plain XLA layer path). Keyed to the divisor
+    HEURISTIC on purpose: tuning changes block shapes, never
+    routing."""
     if len(x_shape) != 4 or len(w_shape) != 4:
         return False
     if int(x_shape[1]) != int(w_shape[1]):
         return False
     try:
         itemsize = np.dtype(dtype).itemsize
-        return _pick_blocks(x_shape, w_shape,
-                            (int(stride[0]), int(stride[1])),
-                            (int(padding[0]), int(padding[1])),
-                            itemsize) is not None
+        return tiling.pick_conv_blocks(
+            x_shape, w_shape,
+            (int(stride[0]), int(stride[1])),
+            (int(padding[0]), int(padding[1])),
+            itemsize) is not None
     except (TypeError, ValueError):
         return False
+
+
+# --- forward (and backward-data) direct-conv kernel ------------------------
 
 
 def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, out_ref, *,
@@ -151,23 +126,18 @@ def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, out_ref, *,
     out_ref[0] = act(z).reshape(oh_b, ow, oc_b).astype(out_ref.dtype)
 
 
-def _conv_block_call(x, w, scale, shift, sh, sw, ph, pw, activation,
-                     interpret):
-    n, c, hp, wp, o, kh, kw, oh, ow = _conv_geometry(
-        x.shape, w.shape, (sh, sw), (ph, pw)
-    )
-    blocks = _pick_blocks(x.shape, w.shape, (sh, sw), (ph, pw),
-                          jnp.dtype(x.dtype).itemsize)
-    if blocks is None:
-        raise ValueError("conv_block: no VMEM-fitting tiling (callers "
-                         "must gate on conv_block_ok)")
-    oc_b, oh_b = blocks
-    xh = jnp.transpose(x, (0, 2, 3, 1))        # NCHW -> NHWC
-    if ph or pw:
-        xh = jnp.pad(xh, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
-    wh = jnp.transpose(w, (2, 3, 1, 0))        # OIHW -> HWIO
-    scale2 = scale.astype(jnp.float32).reshape(1, o)
-    shift2 = shift.astype(jnp.float32).reshape(1, o)
+def _direct_conv_call(xh, wh, scale2, shift2, sh, sw, oc_b, oh_b,
+                      activation, out_dtype, interpret):
+    """The raw blocked direct-conv dispatch on NHWC/HWIO operands that
+    are ALREADY padded/transposed: xh [n, hp, wp, c], wh
+    [kh, kw, c, o], scale2/shift2 f32 [1, o]. Shared by the forward
+    (out_dtype = x.dtype) and the backward-data pass (identity
+    epilogue on the dilated gradient, f32 out), and the unit the
+    autotuner measures candidates through."""
+    n, hp, wp, c = (int(v) for v in xh.shape)
+    kh, kw, _, o = (int(v) for v in wh.shape)
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
     kern = functools.partial(_conv_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
                              act=_EPILOGUES[activation])
     out = pl.pallas_call(
@@ -187,16 +157,213 @@ def _conv_block_call(x, w, scale, shift, sh, sw, ph, pw, activation,
         out_specs=pl.BlockSpec((1, oh_b, ow, oc_b),
                                lambda i, j, k: (i, k, 0, j),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n, oh, ow, o), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, oh, ow, o), out_dtype),
         interpret=interpret,
     )(xh, wh, scale2, shift2)
+    return out
+
+
+def _conv_block_call(x, w, scale, shift, sh, sw, ph, pw, activation,
+                     blocks, interpret):
+    oc_b, oh_b = blocks
+    o = int(w.shape[0])
+    xh = jnp.transpose(x, (0, 2, 3, 1))        # NCHW -> NHWC
+    if ph or pw:
+        xh = jnp.pad(xh, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    wh = jnp.transpose(w, (2, 3, 1, 0))        # OIHW -> HWIO
+    scale2 = scale.astype(jnp.float32).reshape(1, o)
+    shift2 = shift.astype(jnp.float32).reshape(1, o)
+    out = _direct_conv_call(xh, wh, scale2, shift2, sh, sw, oc_b, oh_b,
+                            activation, x.dtype, interpret)
     return jnp.transpose(out, (0, 3, 1, 2))    # NHWC -> NCHW
 
 
+# --- backward-weights kernel ------------------------------------------------
+
+
+def _conv_bwd_w_kernel(x_ref, g_ref, out_ref, *, kh, kw, sh, sw):
+    """dL/dw: batch is the innermost grid axis and the [kh, kw, C,
+    oc_b] output block's index is constant over it — the block stays
+    VMEM-resident (f32) while batch items stream, zero-initialized on
+    the first visit then accumulated (the standard Pallas reduction
+    idiom). Each tap contracts the strided image window with the
+    gradient block over the oh*ow axis: one [C, oh*ow] x [oh*ow, oc_b]
+    MXU product per (dh, dw)."""
+    i = pl.program_id(1)
+    oh, ow, oc_b = g_ref.shape[1], g_ref.shape[2], g_ref.shape[3]
+    c = x_ref.shape[3]
+    rows = (oh - 1) * sh + 1
+    cols = (ow - 1) * sw + 1
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros(out_ref.shape, out_ref.dtype)
+
+    g2 = g_ref[0].reshape(oh * ow, oc_b)
+    for dh in range(kh):
+        for dw in range(kw):
+            patch = x_ref[0, pl.ds(dh, rows), pl.ds(dw, cols), :]
+            if sh > 1 or sw > 1:
+                patch = patch[::sh, ::sw, :]
+            tap = jax.lax.dot_general(
+                patch.reshape(oh * ow, c), g2,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [c, oc_b]
+            out_ref[dh, dw] = out_ref[dh, dw] + tap
+
+
+def _conv_bwd_w_call(xh, dacc, kh, kw, sh, sw, oc_b, interpret):
+    """Blocked dL/dw on padded NHWC image xh [n, hp, wp, c] and the f32
+    pre-epilogue gradient dacc [n, oh, ow, o]; returns [kh, kw, c, o]
+    f32 (HWIO — the caller transposes back to OIHW)."""
+    n, hp, wp, c = (int(v) for v in xh.shape)
+    _, oh, ow, o = (int(v) for v in dacc.shape)
+    kern = functools.partial(_conv_bwd_w_kernel, kh=kh, kw=kw, sh=sh,
+                             sw=sw)
+    return pl.pallas_call(
+        kern,
+        grid=(o // oc_b, n),
+        in_specs=[
+            pl.BlockSpec((1, hp, wp, c), lambda j, i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, oh, ow, oc_b), lambda j, i: (i, 0, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((kh, kw, c, oc_b),
+                               lambda j, i: (0, 0, 0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((kh, kw, c, o), jnp.float32),
+        interpret=interpret,
+    )(xh, dacc)
+
+
+# --- block resolution (tiling heuristic + autotuner) ------------------------
+
+
+def _identity(x_shape, w_shape, stride, padding, dtype):
+    return {
+        "x": [int(v) for v in x_shape],
+        "w": [int(v) for v in w_shape],
+        "stride": [int(v) for v in stride],
+        "padding": [int(v) for v in padding],
+        "dtype": str(jnp.dtype(dtype)),
+    }
+
+
+def _fwd_measure_factory(x_shape, w_shape, stride, padding, dtype,
+                         interpret):
+    """measure_factory for the forward/backward-data kernel: canned
+    deterministic inputs, one eager blocked dispatch per call."""
+    def factory(cfg):
+        oc_b, oh_b = cfg
+        n, c, hp, wp, o, kh, kw, oh, ow = tiling.conv_geometry(
+            x_shape, w_shape, stride, padding)
+        rng = np.random.RandomState(0)
+        xh = jnp.asarray(rng.standard_normal((n, hp, wp, c)), dtype)
+        wh = jnp.asarray(rng.standard_normal((kh, kw, c, o)), dtype)
+        scale2 = jnp.ones((1, o), jnp.float32)
+        shift2 = jnp.zeros((1, o), jnp.float32)
+        sh, sw = stride
+
+        def run():
+            out = _direct_conv_call(xh, wh, scale2, shift2, sh, sw,
+                                    oc_b, oh_b, "identity", dtype,
+                                    interpret)
+            jax.block_until_ready(out)
+        return run
+    return factory
+
+
+def _bwd_w_measure_factory(x_shape, w_shape, stride, padding, dtype,
+                           interpret):
+    def factory(cfg):
+        (oc_b,) = cfg
+        n, c, hp, wp, o, kh, kw, oh, ow = tiling.conv_geometry(
+            x_shape, w_shape, stride, padding)
+        rng = np.random.RandomState(0)
+        xh = jnp.asarray(rng.standard_normal((n, hp, wp, c)), dtype)
+        dacc = jnp.asarray(rng.standard_normal((n, oh, ow, o)),
+                           jnp.float32)
+        sh, sw = stride
+
+        def run():
+            out = _conv_bwd_w_call(xh, dacc, kh, kw, sh, sw, oc_b,
+                                   interpret)
+            jax.block_until_ready(out)
+        return run
+    return factory
+
+
+def _resolve_fwd_blocks(x_shape, w_shape, stride, padding, dtype,
+                        interpret, kernel="conv_block"):
+    itemsize = jnp.dtype(dtype).itemsize
+    heur = tiling.pick_conv_blocks(x_shape, w_shape, stride, padding,
+                                   itemsize)
+    if heur is None or not autotune.tuning_active():
+        return heur
+    factory = None
+    if autotune.tuning_mode() == "on":
+        factory = _fwd_measure_factory(x_shape, w_shape, stride,
+                                       padding, dtype, interpret)
+    return autotune.resolve(
+        kernel,
+        _identity(x_shape, w_shape, stride, padding, dtype),
+        heur,
+        tiling.conv_candidates(x_shape, w_shape, stride, padding,
+                               itemsize),
+        lambda cfg: tiling.conv_candidate_cost(
+            cfg, x_shape, w_shape, stride, padding, itemsize),
+        factory,
+    )
+
+
+def _resolve_bwd_blocks(x_shape, w_shape, stride, padding, dtype,
+                        interpret):
+    """((dx_oc_b, dx_oh_b), dw_oc_b) for the hand-written backward, or
+    None → the ``jax.vjp`` reference fallback. dL/dx reuses the
+    forward kernel on the equivalent stride-1 conv (dilated gradient x
+    flipped weights, f32), so its tiling comes from the SAME picker on
+    the equivalent geometry."""
+    n, c, hp, wp, o, kh, kw, oh, ow = tiling.conv_geometry(
+        x_shape, w_shape, stride, padding)
+    if oh <= 0 or ow <= 0:
+        return None
+    dx_x_shape = (n, o, hp + kh - 1, wp + kw - 1)
+    dx_w_shape = (c, o, kh, kw)
+    dx = _resolve_fwd_blocks(dx_x_shape, dx_w_shape, (1, 1), (0, 0),
+                             jnp.float32, interpret,
+                             kernel="conv_bwd_data")
+    itemsize = jnp.dtype(dtype).itemsize
+    dw_heur = tiling.pick_conv_bwd_w_block(x_shape, w_shape, stride,
+                                           padding, itemsize)
+    if dx is None or dw_heur is None:
+        return None
+    dw = (dw_heur,)
+    if autotune.tuning_active():
+        factory = None
+        if autotune.tuning_mode() == "on":
+            factory = _bwd_w_measure_factory(x_shape, w_shape, stride,
+                                             padding, dtype, interpret)
+        dw = autotune.resolve(
+            "conv_bwd_w",
+            _identity(x_shape, w_shape, stride, padding, dtype),
+            dw,
+            tiling.conv_bwd_w_candidates(x_shape, w_shape, stride,
+                                         padding, itemsize),
+            lambda cfg: tiling.conv_bwd_w_candidate_cost(
+                cfg, x_shape, w_shape, stride, padding, itemsize),
+            factory,
+        )
+    return (tuple(int(v) for v in dx), int(dw[0]))
+
+
+# --- reference + custom-vjp boundary ---------------------------------------
+
+
 def _reference_core(sh, sw, ph, pw, activation, x, w, scale, shift):
-    """XLA reference math — also the backward path (pallas_call has no
-    automatic transpose, so grads recompute through this; the
-    transposed convs it produces are already MXU-optimal). Same
+    """XLA reference math — the parity baseline and the backward
+    fallback when the hand-written tilings don't fit VMEM. Same
     semantics as the kernel: f32 accumulation, f32 epilogue, one final
     cast. The CPU branch mirrors the layer's NHWC detour (Eigen has no
     fast NCHW conv)."""
@@ -226,28 +393,87 @@ def _reference_core(sh, sw, ph, pw, activation, x, w, scale, shift):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _conv_block_vjp(meta, x, w, scale, shift):
-    sh, sw, ph, pw, activation, interpret = meta
+    sh, sw, ph, pw, activation, interpret, fwd_blocks, _ = meta
     return _conv_block_call(x, w, scale, shift, sh, sw, ph, pw,
-                            activation, interpret)
+                            activation, fwd_blocks, interpret)
 
 
 def _conv_block_fwd(meta, x, w, scale, shift):
-    sh, sw, ph, pw, activation, interpret = meta
+    sh, sw, ph, pw, activation, interpret, fwd_blocks, _ = meta
     return (
         _conv_block_call(x, w, scale, shift, sh, sw, ph, pw,
-                         activation, interpret),
+                         activation, fwd_blocks, interpret),
         (x, w, scale, shift),
     )
 
 
 def _conv_block_bwd(meta, res, g):
-    sh, sw, ph, pw, activation, _ = meta
+    """Hand-written backward (see module docstring). Recomputes the
+    f32 pre-epilogue accumulator through the forward kernel (cheaper
+    than saving it: one recompute vs an [n, oh, ow, o] f32 residual
+    held across the whole backward), applies the epilogue gradient in
+    f32, then one Pallas dispatch each for dL/dx and dL/dw."""
+    sh, sw, ph, pw, activation, interpret, fwd_blocks, bwd = meta
     x, w, scale, shift = res
-    _, vjp = jax.vjp(
-        lambda *a: _reference_core(sh, sw, ph, pw, activation, *a),
-        x, w, scale, shift,
-    )
-    return vjp(g)
+    if bwd is None:
+        _, vjp = jax.vjp(
+            lambda *a: _reference_core(sh, sw, ph, pw, activation, *a),
+            x, w, scale, shift,
+        )
+        return vjp(g)
+
+    (dx_oc_b, dx_oh_b), dw_oc_b = bwd
+    n, c, h, w_in = (int(v) for v in x.shape)
+    o, _, kh, kw = (int(v) for v in w.shape)
+    hp, wp = h + 2 * ph, w_in + 2 * pw
+
+    xh = jnp.transpose(x, (0, 2, 3, 1))
+    if ph or pw:
+        xh = jnp.pad(xh, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    wh = jnp.transpose(w, (2, 3, 1, 0))
+    o_ones = jnp.ones((1, o), jnp.float32)
+    o_zeros = jnp.zeros((1, o), jnp.float32)
+    fwd_oc_b, fwd_oh_b = fwd_blocks
+    acc = _direct_conv_call(xh, wh, o_ones, o_zeros, sh, sw, fwd_oc_b,
+                            fwd_oh_b, "identity", jnp.float32,
+                            interpret)  # [n, oh, ow, o] f32
+
+    # epilogue gradient in f32 (cast vjp: g comes in as x.dtype)
+    g_nhwc = jnp.transpose(g, (0, 2, 3, 1)).astype(jnp.float32)
+    scale_f = scale.astype(jnp.float32)
+    z = acc * scale_f + shift.astype(jnp.float32)
+    dz = g_nhwc * _EPILOGUE_GRADS[activation](z)
+    dshift = dz.sum((0, 1, 2)).astype(shift.dtype)
+    dscale = (dz * acc).sum((0, 1, 2)).astype(scale.dtype)
+    dacc = dz * scale_f  # [n, oh, ow, o] f32
+
+    # dL/dx: interior-dilate dacc by the stride, pad by (k-1) plus the
+    # edge rows the strided forward never read, then a stride-1 direct
+    # conv with the spatially-flipped, in/out-transposed weights — the
+    # SAME forward kernel on transformed operands.
+    rh = tiling.conv_edge_remainder(hp, kh, sh)
+    rw = tiling.conv_edge_remainder(wp, kw, sw)
+    gdil = jax.lax.pad(
+        dacc, jnp.float32(0),
+        ((0, 0, 0), (kh - 1, kh - 1 + rh, sh - 1),
+         (kw - 1, kw - 1 + rw, sw - 1), (0, 0, 0)),
+    )  # [n, hp + kh - 1, wp + kw - 1, o]
+    wflip = jnp.transpose(w[:, :, ::-1, ::-1],
+                          (2, 3, 0, 1)).astype(jnp.float32)
+    c_ones = jnp.ones((1, c), jnp.float32)
+    c_zeros = jnp.zeros((1, c), jnp.float32)
+    dxp = _direct_conv_call(gdil, wflip, c_ones, c_zeros, 1, 1,
+                            dx_oc_b, dx_oh_b, "identity", jnp.float32,
+                            interpret)  # [n, hp, wp, c]
+    if ph or pw:
+        dxp = dxp[:, ph:ph + h, pw:pw + w_in, :]
+    dx = jnp.transpose(dxp, (0, 3, 1, 2)).astype(x.dtype)
+
+    # dL/dw: direct correlation of the padded image with dacc
+    dw_hwio = _conv_bwd_w_call(xh, dacc, kh, kw, sh, sw, dw_oc_b,
+                               interpret)  # [kh, kw, c, o] f32
+    dw = jnp.transpose(dw_hwio, (3, 2, 0, 1)).astype(w.dtype)
+    return dx, dw, dscale, dshift
 
 
 _conv_block_vjp.defvjp(_conv_block_fwd, _conv_block_bwd)
@@ -272,11 +498,11 @@ def conv_block(x, w, bias=None, bn_scale=None, bn_shift=None, *,
                stride=(1, 1), padding=(0, 0), activation="identity",
                interpret: bool = False):
     """Fused ``activation((conv2d(x, w) + bias) * bn_scale + bn_shift)``
-    via ONE Pallas kernel. x NCHW [n,c,h,w], w OIHW [o,c,kh,kw], bias/
-    bn_scale/bn_shift per-channel [o] (each optional). Differentiable
-    (backward recomputes through the XLA reference). ``interpret`` is
-    resolved HERE, before the custom-vjp boundary (nondiff argument:
-    forward and backward must agree on it) — off-TPU the kernel
+    via ONE Pallas kernel, with a hand-written Pallas backward. x NCHW
+    [n,c,h,w], w OIHW [o,c,kh,kw], bias/bn_scale/bn_shift per-channel
+    [o] (each optional). ``interpret`` and every block config are
+    resolved HERE, before the custom-vjp boundary (nondiff arguments:
+    forward and backward must agree on them) — off-TPU the kernel
     self-arms interpreter mode even when ``DL4J_TPU_PALLAS=1`` forces
     routing."""
     from deeplearning4j_tpu.ops.dispatch import pallas_interpret
@@ -288,9 +514,20 @@ def conv_block(x, w, bias=None, bn_scale=None, bn_shift=None, *,
         )
     scale, shift = _fold_epilogue(int(w.shape[0]), bias, bn_scale,
                                   bn_shift)
-    meta = (int(stride[0]), int(stride[1]), int(padding[0]),
-            int(padding[1]), activation,
-            bool(interpret or pallas_interpret()))
+    stride = (int(stride[0]), int(stride[1]))
+    padding = (int(padding[0]), int(padding[1]))
+    interp = bool(interpret or pallas_interpret())
+    fwd_blocks = _resolve_fwd_blocks(
+        tuple(int(v) for v in x.shape), tuple(int(v) for v in w.shape),
+        stride, padding, x.dtype, interp)
+    if fwd_blocks is None:
+        raise ValueError("conv_block: no VMEM-fitting tiling (callers "
+                         "must gate on conv_block_ok)")
+    bwd = _resolve_bwd_blocks(
+        tuple(int(v) for v in x.shape), tuple(int(v) for v in w.shape),
+        stride, padding, x.dtype, interp)
+    meta = (stride[0], stride[1], padding[0], padding[1], activation,
+            interp, tuple(int(v) for v in fwd_blocks), bwd)
     return _conv_block_vjp(meta, x, w, scale, shift)
 
 
@@ -299,7 +536,7 @@ def conv_block_reference(x, w, bias=None, bn_scale=None, bn_shift=None,
                          activation="identity"):
     """The XLA-fused reference path: identical semantics, no Pallas —
     the A/B baseline for ``scripts/bench_kernels.py`` and the parity
-    tests, and the math the backward pass recomputes through."""
+    tests, and the math the backward fallback recomputes through."""
     if activation not in _EPILOGUES:
         raise ValueError(
             f"conv_block: unsupported epilogue '{activation}' "

@@ -49,11 +49,17 @@ def _pallas_env() -> str:
 
 def reset_for_tests() -> None:
     """Drop the cached ``DL4J_TPU_PALLAS`` read so the NEXT
-    ``use_pallas()`` call re-reads the environment. The only supported
-    way to flip kernel dispatch mid-process (tests, bench A/Bs);
-    production processes read the knob once at first dispatch."""
+    ``use_pallas()`` call re-reads the environment, and cascade to the
+    autotuner (its ``DL4J_TPU_TUNE*`` knobs follow the same
+    read-once-per-process discipline, plus in-process resolution
+    memos). The only supported way to flip kernel dispatch or tuning
+    mid-process (tests, bench A/Bs); production processes read the
+    knobs once at first dispatch."""
     global _ENV_CACHE
     _ENV_CACHE = None
+    from deeplearning4j_tpu.ops import autotune
+
+    autotune.reset_for_tests()
 
 
 def use_pallas() -> bool:

@@ -20,8 +20,11 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.ops import autotune, tiling
 
 _NEG = -1e9
 
@@ -109,7 +112,7 @@ def flash_attention(q, k, v, causal: bool = False,
     b, h, t, d = q.shape
     block_q = min(block_q, t)
     block_k = min(block_k, t)
-    if t % block_q or t % block_k:
+    if not tiling.attention_blocks_ok(t, block_q, block_k):
         raise ValueError(
             f"sequence length {t} must be divisible by block sizes "
             f"({block_q}, {block_k})"
@@ -240,26 +243,32 @@ def _use_blockwise_bwd(t: int) -> bool:
     return t > _BWD_MATERIALIZE_T_LIMIT
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_diff(q, k, v, causal, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, causal, interpret=False, block_q=128,
+                block_k=128):
     """Differentiable wrapper: Pallas forward; backward is the XLA
     reference recompute at short sequences (cheapest to compile) and
     the blockwise flash backward beyond ``_BWD_MATERIALIZE_T_LIMIT``
     — O(t*block) memory instead of the [t, t] score matrix, so
     long-context TRAINING is HBM-bound like the forward.
-    ``interpret`` exists for off-TPU tests of this exact path."""
-    return flash_attention(q, k, v, causal=causal, interpret=interpret)
+    ``interpret`` exists for off-TPU tests of this exact path; the
+    block sizes are nondiff arguments so tuned configs resolve OUTSIDE
+    the vjp boundary (in ``mha``) and forward/backward agree."""
+    return flash_attention(q, k, v, causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
 
 
-def _flash_fwd(q, k, v, causal, interpret=False):
-    out = flash_attention(q, k, v, causal=causal, interpret=interpret)
+def _flash_fwd(q, k, v, causal, interpret=False, block_q=128,
+               block_k=128):
+    out = flash_attention(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
     # the recompute branch never reads `out`; saving it there would
     # pin an extra O(b*h*t*d) activation per layer for nothing
     keep = out if _use_blockwise_bwd(q.shape[2]) else None
     return out, (q, k, v, keep)
 
 
-def _flash_bwd(causal, interpret, res, g):
+def _flash_bwd(causal, interpret, block_q, block_k, res, g):
     q, k, v, out = res
     if _use_blockwise_bwd(q.shape[2]):
         return _blockwise_attention_bwd(q, k, v, out, g, causal)
@@ -289,11 +298,9 @@ def _blockwise_attention_bwd(q, k, v, out, do, causal,
     path still computes fully-masked key blocks (a scan has static
     per-iteration shapes) — both trade FLOPs, never memory."""
     b, h, t, d = q.shape
-    block_k = min(block_k, t)
-    while t % block_k:
-        # shrink to a power-of-2 divisor: block_k = t would rebuild
-        # the [t, t] intermediates this path exists to avoid
-        block_k //= 2
+    # shrink to a power-of-2 divisor: block_k = t would rebuild the
+    # [t, t] intermediates this path exists to avoid
+    block_k = tiling.pow2_divisor_leq(t, min(block_k, t))
     n_blk = t // block_k
     f32 = jnp.float32
     scale = 1.0 / (d ** 0.5)
@@ -373,6 +380,51 @@ def _use_pallas() -> bool:
     return use_pallas()
 
 
+def _attn_measure_factory(b, h, t, d, dtype, causal, interpret):
+    def factory(cfg):
+        bq, bk = cfg
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.standard_normal((b, h, t, d)), dtype)
+        k = jnp.asarray(rng.standard_normal((b, h, t, d)), dtype)
+        v = jnp.asarray(rng.standard_normal((b, h, t, d)), dtype)
+
+        def run():
+            out = flash_attention(q, k, v, causal=causal, block_q=bq,
+                                  block_k=bk, interpret=interpret)
+            jax.block_until_ready(out)
+        return run
+    return factory
+
+
+def _resolve_attention_blocks(b, h, t, d, dtype, causal):
+    """(block_q, block_k) for one dispatch: the historical 128s
+    heuristic, or the autotuner's measured winner when tuning is
+    active. Measurement runs in interpreter mode off-TPU (eager,
+    outside any trace) regardless of how the dispatch itself lowers."""
+    from deeplearning4j_tpu.ops.dispatch import pallas_interpret
+
+    heur = tiling.pick_attention_blocks(t)
+    if not autotune.tuning_active():
+        return heur
+    itemsize = jnp.dtype(dtype).itemsize
+    factory = None
+    if autotune.tuning_mode() == "on":
+        factory = _attn_measure_factory(int(b), int(h), int(t), int(d),
+                                        dtype, causal,
+                                        pallas_interpret())
+    got = autotune.resolve(
+        "flash_attention",
+        {"b": int(b), "h": int(h), "t": int(t), "d": int(d),
+         "dtype": str(jnp.dtype(dtype)), "causal": bool(causal)},
+        heur,
+        tiling.attention_candidates(int(t), int(d), itemsize),
+        lambda cfg: tiling.attention_candidate_cost(cfg, int(t),
+                                                    int(d), itemsize),
+        factory,
+    )
+    return int(got[0]), int(got[1])
+
+
 _fallback_warned = False
 
 
@@ -394,12 +446,12 @@ def mha(q, k, v, causal: bool = False, mask=None):
     from deeplearning4j_tpu.parallel.sequence import attention
 
     t = q.shape[2]
-    if (
-        mask is None and _use_pallas()
-        and t % min(128, t) == 0 and t >= 8
-    ):
+    if mask is None and _use_pallas() and tiling.attention_seq_ok(t):
         try:
-            return _flash_diff(q, k, v, causal)
+            b, h, _, d = q.shape
+            bq, bk = _resolve_attention_blocks(b, h, t, d, q.dtype,
+                                               causal)
+            return _flash_diff(q, k, v, causal, False, bq, bk)
         except (ValueError, TypeError, JaxRuntimeError) as e:
             global _fallback_warned
             if not _fallback_warned:

@@ -18,8 +18,11 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.ops import autotune, tiling
 
 
 def _cell_kernel(xproj_ref, h_ref, c_ref, rw_ref, h_out, c_out, *,
@@ -153,10 +156,12 @@ def use_pallas_lstm() -> bool:
 # VMEM budget at the saturated shape: RW 8 MB (bf16) + xproj block
 # 2 MB + h/c scratch 2x1 MB (f32) + out blocks 2x0.5 MB + z temp 4 MB
 # (f32) ~ 16 MB — one core's VMEM. Larger n needs batch-blocking
-# (outer batch grid dim); gated to n*4n*2 <= _SEQ_RW_BYTES_MAX.
-
-
-_SEQ_RW_BYTES_MAX = 9 * 2 ** 20
+# (outer batch grid dim); gated to n*4n*itemsize <=
+# tiling.SEQ_RW_BYTES_MAX. The batch block comes from
+# tiling.pick_lstm_batch_block (the shared divisor heuristic) or, when
+# DL4J_TPU_TUNE is active, the autotuner's measured winner — the block
+# is numerics-neutral (batch rows are independent), so it resolves at
+# trace time without threading through the vjp meta.
 
 
 def _seq_fwd_core(xproj_ref, rw_ref, h0_ref, c0_ref,
@@ -261,38 +266,79 @@ def _seq_bwd_kernel(xproj_ref, hprev_ref, cprev_ref, cseq_ref, rw_ref,
     dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)
 
 
-def _seq_batch_block(b: int, n: int, four_n: int, itemsize: int,
-                     bwd: bool = False):
-    """Largest batch block DIVIDING b that keeps the kernel's VMEM
-    residents under ~13 MB of the core's 16 MB. The backward kernel
-    holds roughly twice the forward's per-row state (extra saved
-    blocks, the 4n dgates stream and f32 dz temps), so it sizes with
-    its own formula. None when even the smallest divisor overflows
-    (callers fall back to the per-step cell)."""
-    budget = 13 * 2 ** 20
-    rw_bytes = n * four_n * itemsize
-    if bwd:
-        # xproj + dgates blocks + dz/z f32 temps on the 4n axis;
-        # hprev/cprev/cseq/dhseq blocks + dh0/dc0 + scratches on n
-        per_row = (four_n * (2 * itemsize + 8)
-                   + n * (4 * itemsize + 4 * 4))
-    else:
-        per_row = (four_n * (itemsize + 4)   # xproj block + z f32
-                   + n * (4 * 4 + 2 * itemsize))  # scratches + outs
-    bb = b
-    while bb >= 1:
-        if b % bb == 0 and rw_bytes + bb * per_row <= budget:
-            return bb
-        bb //= 2
-    return None
+def _seq_measure_factory(T, b, n, four_n, dtype, bwd, interpret):
+    """measure_factory for the sequence kernels: canned deterministic
+    inputs, one eager dispatch per call with the candidate batch
+    block."""
+    def factory(cfg):
+        (bb,) = cfg
+        rng = np.random.RandomState(0)
+        xproj = jnp.asarray(
+            rng.standard_normal((T, b, four_n)) * 0.1, dtype)
+        rw = jnp.asarray(rng.standard_normal((n, four_n)) * 0.1, dtype)
+        if not bwd:
+            h0 = jnp.zeros((b, n), dtype)
+            c0 = jnp.zeros((b, n), dtype)
+
+            def run():
+                out = _lstm_sequence_fwd_call(xproj, h0, c0, rw,
+                                              interpret, bb=bb)
+                jax.block_until_ready(out)
+            return run
+        hprev = jnp.asarray(rng.standard_normal((T, b, n)) * 0.1,
+                            dtype)
+        cprev = jnp.asarray(rng.standard_normal((T, b, n)) * 0.1,
+                            dtype)
+        cseq = jnp.asarray(rng.standard_normal((T, b, n)) * 0.1, dtype)
+        dhseq = jnp.asarray(rng.standard_normal((T, b, n)) * 0.1,
+                            dtype)
+        dhT = jnp.zeros((b, n), dtype)
+        dcT = jnp.zeros((b, n), dtype)
+
+        def run():
+            out = _lstm_sequence_bwd_call(xproj, hprev, cprev, cseq,
+                                          rw, dhseq, dhT, dcT,
+                                          interpret, bb=bb)
+            jax.block_until_ready(out)
+        return run
+    return factory
+
+
+def _resolve_seq_block(T, b, n, four_n, dtype, bwd, interpret):
+    """The batch block one sequence dispatch uses: the shared divisor
+    heuristic, or the autotuner's measured winner when tuning is
+    active (forward and backward kernels tune independently — the
+    block is numerics-neutral)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    heur = tiling.pick_lstm_batch_block(b, n, four_n, itemsize,
+                                        bwd=bwd)
+    if heur is None or not autotune.tuning_active():
+        return heur
+    factory = None
+    if autotune.tuning_mode() == "on":
+        factory = _seq_measure_factory(T, b, n, four_n, dtype, bwd,
+                                       interpret)
+    got = autotune.resolve(
+        "lstm_seq_bwd" if bwd else "lstm_seq_fwd",
+        {"T": int(T), "b": int(b), "n": int(n),
+         "dtype": str(jnp.dtype(dtype))},
+        (heur,),
+        tiling.lstm_batch_candidates(b, n, four_n, itemsize, bwd=bwd),
+        lambda cfg: tiling.lstm_candidate_cost(cfg, b, n, four_n, T,
+                                               itemsize),
+        factory,
+    )
+    return int(got[0])
 
 
 def _lstm_sequence_fwd_call(xproj, h0, c0, rw, interpret,
-                            save_cseq=True):
+                            save_cseq=True, bb=None):
     T, b, four_n = xproj.shape
     n = four_n // 4
     dt = h0.dtype
-    bb = _seq_batch_block(b, n, four_n, jnp.dtype(rw.dtype).itemsize)
+    if bb is None:
+        bb = _resolve_seq_block(T, b, n, four_n, rw.dtype, False,
+                                interpret)
     if bb is None:
         raise ValueError("lstm_sequence: no VMEM-fitting batch block "
                          "(callers must gate on lstm_sequence_ok)")
@@ -339,12 +385,13 @@ def _lstm_sequence_fwd_call(xproj, h0, c0, rw, interpret,
 
 
 def _lstm_sequence_bwd_call(xproj, hprev, cprev, cseq, rw, dhseq,
-                            dhT, dcT, interpret):
+                            dhT, dcT, interpret, bb=None):
     T, b, four_n = xproj.shape
     n = four_n // 4
     dt = rw.dtype
-    bb = _seq_batch_block(b, n, four_n, jnp.dtype(rw.dtype).itemsize,
-                          bwd=True)
+    if bb is None:
+        bb = _resolve_seq_block(T, b, n, four_n, rw.dtype, True,
+                                interpret)
     if bb is None:
         raise ValueError("lstm_sequence: no VMEM-fitting batch block "
                          "(callers must gate on lstm_sequence_ok)")
@@ -385,16 +432,16 @@ def _lstm_sequence_bwd_call(xproj, hprev, cprev, cseq, rw, dhseq,
 def lstm_sequence_ok(n: int, four_n: int, dtype, b: int) -> bool:
     """Gate: standard gates, no peephole/mask, RW small enough to sit
     resident in VMEM, and a batch block exists that divides b and
-    fits BOTH kernels' VMEM budgets."""
-    import numpy as _np
-
-    itemsize = _np.dtype(dtype).itemsize
+    fits BOTH kernels' VMEM budgets. Keyed to the divisor HEURISTIC:
+    tuning changes block shapes, never routing."""
+    itemsize = np.dtype(dtype).itemsize
     return (
         four_n == 4 * n
-        and itemsize * n * four_n <= _SEQ_RW_BYTES_MAX
-        and _seq_batch_block(b, n, four_n, itemsize) is not None
-        and _seq_batch_block(b, n, four_n, itemsize, bwd=True)
+        and itemsize * n * four_n <= tiling.SEQ_RW_BYTES_MAX
+        and tiling.pick_lstm_batch_block(b, n, four_n, itemsize)
         is not None
+        and tiling.pick_lstm_batch_block(b, n, four_n, itemsize,
+                                         bwd=True) is not None
     )
 
 

@@ -1,0 +1,419 @@
+"""Shared block-size selection for the Pallas kernel library.
+
+One module owns every tiling decision the kernels make — the VMEM
+budget constant, the divisor heuristics that used to be copy-pasted
+into ``conv_block``/``matmul_block``/``lstm_cell``, and the candidate
+enumeration the autotuner (``ops/autotune.py``) searches over. The
+heuristic pickers here are byte-identical to the pre-refactor ones
+(``DL4J_TPU_TUNE=off`` must not change a single block choice), and the
+candidate enumerators share the same feasibility formulas, so the
+heuristic and the measured search can never disagree about what fits.
+
+``scripts/lint_parity.py`` enforces the locality: kernel modules under
+``ops/`` may not carry inline divisor math — block selection goes
+through this module (or the autotuner, which enumerates from it).
+
+Per-candidate cost priors: each ``*_candidate_cost`` returns a
+``(flops, bytes)`` pair modeling the candidate's *scheduled* work —
+MXU-padding waste (sublane multiples of 8, lane multiples of 128) and
+the HBM refetch traffic implied by the kernel's grid/index maps. The
+autotuner wraps these in the PR-15 ``CostModel`` record and ranks the
+search by the prior; measurement decides the winner.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+# Per-core VMEM is ~16 MB; leave headroom for Mosaic's own pipeline
+# buffers. THE single budget constant for every kernel's tiling (the
+# old per-module 13 MiB copies collapsed here).
+VMEM_BUDGET_BYTES = 13 * 2 ** 20
+
+# lstm_sequence additionally requires the recurrent weight matrix to
+# sit resident across all timesteps.
+SEQ_RW_BYTES_MAX = 9 * 2 ** 20
+
+# MXU geometry: output lanes come in 128s, sublanes in 8s — the cost
+# priors charge candidates for the padding waste of partial tiles.
+_LANES = 128
+_SUBLANES = 8
+
+
+def largest_divisor_leq(n: int, cap: int) -> int:
+    for d in range(min(n, cap), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def divisors_desc(v: int, cap: int) -> List[int]:
+    return [d for d in range(min(v, cap), 0, -1) if v % d == 0]
+
+
+def pow2_divisor_leq(n: int, cap: int) -> int:
+    """Largest power-of-two divisor of ``n`` that is <= cap (>= 1)."""
+    p = 1
+    while p * 2 <= cap and n % (p * 2) == 0:
+        p *= 2
+    return p
+
+
+def _pad_up(v: int, mult: int) -> int:
+    return ((v + mult - 1) // mult) * mult
+
+
+# ---------------------------------------------------------------------------
+# conv_block forward (also the backward-data pass: same direct-conv
+# kernel on the dilated gradient with flipped weights)
+# ---------------------------------------------------------------------------
+
+
+def conv_geometry(x_shape, w_shape, stride, padding):
+    n, c, h, w = (int(v) for v in x_shape)
+    o, ci, kh, kw = (int(v) for v in w_shape)
+    sh, sw = stride
+    ph, pw = padding
+    hp, wp = h + 2 * ph, w + 2 * pw
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
+    return n, c, hp, wp, o, kh, kw, oh, ow
+
+
+def conv_edge_remainder(hp: int, kh: int, sh: int) -> int:
+    """(hp - kh) mod sh without ``%`` at the call site — the rows the
+    strided forward never reads at the bottom/right edge; the
+    backward-data pass pads the dilated gradient by this much."""
+    oh = (hp - kh) // sh + 1
+    return (hp - kh) - (oh - 1) * sh
+
+
+def _conv_fixed_bytes(hp, wp, c, kh, kw, oc_b, itemsize) -> int:
+    return (hp * wp * c * itemsize            # padded image (resident)
+            + kh * kw * c * oc_b * itemsize   # weight tile
+            + 2 * oc_b * 4)                   # f32 scale/shift
+
+
+def _conv_block_bytes(oh_b, ow, oc_b, c, stride, itemsize) -> int:
+    rows = (oh_b - 1) * stride[0] + 1
+    cols = (ow - 1) * stride[1] + 1
+    return (oh_b * ow * oc_b * (4 + itemsize)  # f32 acc + out block
+            + rows * cols * c * itemsize       # tap window view
+            + oh_b * ow * c * itemsize)        # matmul operand
+
+
+def pick_conv_blocks(x_shape, w_shape, stride, padding,
+                     itemsize) -> Optional[Tuple[int, int]]:
+    """(oc_block, oh_block) heuristic tiling, or None when nothing fits
+    VMEM — byte-identical to the pre-autotuner divisor heuristic.
+
+    Residents: the full padded image of one batch item (its block index
+    is constant over the channel/spatial grid dims, so it is fetched
+    once per item), one weight tile, the f32 accumulator and the output
+    block. oc_block is capped at 128 (one MXU tile of output lanes);
+    oh_block shrinks toward 1 until the budget holds — odd geometries
+    always admit oh_block=1 unless the image itself overflows."""
+    n, c, hp, wp, o, kh, kw, oh, ow = conv_geometry(
+        x_shape, w_shape, stride, padding
+    )
+    if oh <= 0 or ow <= 0:
+        return None
+    oc_b = largest_divisor_leq(o, 128)
+    fixed = _conv_fixed_bytes(hp, wp, c, kh, kw, oc_b, itemsize)
+    if fixed > VMEM_BUDGET_BYTES:
+        return None
+    for oh_b in range(oh, 0, -1):
+        if oh % oh_b:
+            continue
+        per = _conv_block_bytes(oh_b, ow, oc_b, c, stride, itemsize)
+        if fixed + per <= VMEM_BUDGET_BYTES:
+            return oc_b, oh_b
+    return None
+
+
+def conv_candidates(x_shape, w_shape, stride, padding, itemsize,
+                    limit: int = 24) -> List[Tuple[int, int]]:
+    """Every VMEM-feasible (oc_block, oh_block) pair — the autotuner's
+    search space. Shares the heuristic's feasibility formulas exactly,
+    so the heuristic pick is always a member when it exists."""
+    n, c, hp, wp, o, kh, kw, oh, ow = conv_geometry(
+        x_shape, w_shape, stride, padding
+    )
+    if oh <= 0 or ow <= 0:
+        return []
+    out: List[Tuple[int, int]] = []
+    for oc_b in divisors_desc(o, 256):
+        fixed = _conv_fixed_bytes(hp, wp, c, kh, kw, oc_b, itemsize)
+        if fixed > VMEM_BUDGET_BYTES:
+            continue
+        for oh_b in divisors_desc(oh, oh):
+            per = _conv_block_bytes(oh_b, ow, oc_b, c, stride,
+                                    itemsize)
+            if fixed + per <= VMEM_BUDGET_BYTES:
+                out.append((oc_b, oh_b))
+            if len(out) >= limit:
+                return out
+    return out
+
+
+def conv_candidate_cost(cfg, x_shape, w_shape, stride, padding,
+                        itemsize) -> Tuple[float, float]:
+    """(flops, bytes) prior for one (oc_b, oh_b) candidate: MXU work
+    padded to sublane/lane multiples, plus modeled HBM traffic from
+    the grid's index maps (image once per batch item; the weight tile
+    refetched per (item, oc-block); output written once)."""
+    n, c, hp, wp, o, kh, kw, oh, ow = conv_geometry(
+        x_shape, w_shape, stride, padding
+    )
+    oc_b, oh_b = cfg
+    tiles = n * (o // oc_b) * (oh // oh_b)
+    flops = (tiles * kh * kw
+             * 2.0 * _pad_up(oh_b * ow, _SUBLANES) * c
+             * _pad_up(oc_b, _LANES))
+    bytes_ = (n * hp * wp * c * itemsize
+              + n * (o // oc_b) * kh * kw * c * oc_b * itemsize
+              + n * oh * ow * o * itemsize)
+    return flops, float(bytes_)
+
+
+# ---------------------------------------------------------------------------
+# conv_block backward-weights (direct correlation of the padded image
+# with the incoming gradient, batch as the accumulated grid axis)
+# ---------------------------------------------------------------------------
+
+
+def _conv_bwd_w_bytes(hp, wp, c, kh, kw, oh, ow, oc_b, itemsize) -> int:
+    rows = (oh - 1) * 1 + 1  # placeholder; real window counted below
+    del rows
+    return (hp * wp * c * itemsize        # padded image (resident)
+            + hp * wp * c * itemsize      # tap window view (worst case)
+            + oh * ow * c * 4             # f32 patch operand
+            + oh * ow * oc_b * 4          # f32 gradient block
+            + kh * kw * c * oc_b * 4      # f32 accumulator output
+            + c * oc_b * 4)               # per-tap dot result
+
+
+def pick_conv_bwd_w_block(x_shape, w_shape, stride, padding,
+                          itemsize) -> Optional[int]:
+    """Largest divisor-of-O out-channel block (<= 128) whose
+    backward-weights residents fit VMEM, or None (the backward then
+    falls to the XLA ``jax.vjp`` reference, same pattern as the
+    forward's gate)."""
+    n, c, hp, wp, o, kh, kw, oh, ow = conv_geometry(
+        x_shape, w_shape, stride, padding
+    )
+    if oh <= 0 or ow <= 0:
+        return None
+    for oc_b in divisors_desc(o, 128):
+        if _conv_bwd_w_bytes(hp, wp, c, kh, kw, oh, ow, oc_b,
+                             itemsize) <= VMEM_BUDGET_BYTES:
+            return oc_b
+    return None
+
+
+def conv_bwd_w_candidates(x_shape, w_shape, stride, padding, itemsize,
+                          limit: int = 16) -> List[Tuple[int]]:
+    n, c, hp, wp, o, kh, kw, oh, ow = conv_geometry(
+        x_shape, w_shape, stride, padding
+    )
+    if oh <= 0 or ow <= 0:
+        return []
+    out: List[Tuple[int]] = []
+    for oc_b in divisors_desc(o, 256):
+        if _conv_bwd_w_bytes(hp, wp, c, kh, kw, oh, ow, oc_b,
+                             itemsize) <= VMEM_BUDGET_BYTES:
+            out.append((oc_b,))
+        if len(out) >= limit:
+            break
+    return out
+
+
+def conv_bwd_w_candidate_cost(cfg, x_shape, w_shape, stride, padding,
+                              itemsize) -> Tuple[float, float]:
+    n, c, hp, wp, o, kh, kw, oh, ow = conv_geometry(
+        x_shape, w_shape, stride, padding
+    )
+    (oc_b,) = cfg
+    flops = (n * (o // oc_b) * kh * kw
+             * 2.0 * _pad_up(c, _SUBLANES) * oh * ow
+             * _pad_up(oc_b, _LANES))
+    bytes_ = ((o // oc_b) * n * hp * wp * c * itemsize
+              + n * oh * ow * o * 4
+              + kh * kw * c * o * 4)
+    return flops, float(bytes_)
+
+
+# ---------------------------------------------------------------------------
+# matmul_block
+# ---------------------------------------------------------------------------
+
+
+def pick_matmul_blocks(m: int, k: int, n: int,
+                       itemsize: int) -> Optional[Tuple[int, int]]:
+    """(bm, bn) heuristic tile, or None when no tile fits VMEM —
+    byte-identical to the pre-autotuner picker. Residents per grid
+    step: one [bm, K] row block, one [K, bn] weight panel, the f32
+    bias slice, accumulator and output block."""
+    for bm in divisors_desc(m, 256):
+        x_bytes = bm * k * itemsize
+        if x_bytes >= VMEM_BUDGET_BYTES:
+            continue
+        for bn in divisors_desc(n, 512):
+            total = (x_bytes + k * bn * itemsize + bn * 4
+                     + bm * bn * (4 + itemsize))
+            if total <= VMEM_BUDGET_BYTES:
+                return bm, bn
+    return None
+
+
+def matmul_candidates(m: int, k: int, n: int, itemsize: int,
+                      limit: int = 24) -> List[Tuple[int, int]]:
+    out: List[Tuple[int, int]] = []
+    for bm in divisors_desc(m, 1024):
+        x_bytes = bm * k * itemsize
+        if x_bytes >= VMEM_BUDGET_BYTES:
+            continue
+        for bn in divisors_desc(n, 1024):
+            total = (x_bytes + k * bn * itemsize + bn * 4
+                     + bm * bn * (4 + itemsize))
+            if total <= VMEM_BUDGET_BYTES:
+                out.append((bm, bn))
+            if len(out) >= limit:
+                return out
+    return out
+
+
+def matmul_candidate_cost(cfg, m: int, k: int, n: int,
+                          itemsize: int) -> Tuple[float, float]:
+    """Prior for one (bm, bn): padded MXU work plus the weight-panel
+    refetch traffic — the [K, bn] panel is re-fetched once per row
+    block, so larger bm means less HBM traffic."""
+    bm, bn = cfg
+    tiles = (m // bm) * (n // bn)
+    flops = tiles * 2.0 * _pad_up(bm, _SUBLANES) * k * _pad_up(bn, _LANES)
+    bytes_ = (m * k * itemsize                  # x: once per row block
+              + (m // bm) * k * n * itemsize    # w panels refetched
+              + m * n * itemsize + n * 4)       # out + bias
+    return flops, float(bytes_)
+
+
+# ---------------------------------------------------------------------------
+# lstm_sequence batch block
+# ---------------------------------------------------------------------------
+
+
+def _lstm_per_row_bytes(n: int, four_n: int, itemsize: int,
+                        bwd: bool) -> int:
+    if bwd:
+        # xproj + dgates blocks + dz/z f32 temps on the 4n axis;
+        # hprev/cprev/cseq/dhseq blocks + dh0/dc0 + scratches on n
+        return (four_n * (2 * itemsize + 8)
+                + n * (4 * itemsize + 4 * 4))
+    return (four_n * (itemsize + 4)        # xproj block + z f32
+            + n * (4 * 4 + 2 * itemsize))  # scratches + outs
+
+
+def pick_lstm_batch_block(b: int, n: int, four_n: int, itemsize: int,
+                          bwd: bool = False) -> Optional[int]:
+    """Largest batch block DIVIDING b that keeps the sequence kernel's
+    VMEM residents under the budget — byte-identical to the
+    pre-autotuner halving search. The backward kernel holds roughly
+    twice the forward's per-row state, so it sizes with its own
+    formula. None when even the smallest divisor overflows (callers
+    fall back to the per-step cell)."""
+    rw_bytes = n * four_n * itemsize
+    per_row = _lstm_per_row_bytes(n, four_n, itemsize, bwd)
+    bb = b
+    while bb >= 1:
+        if b % bb == 0 and rw_bytes + bb * per_row <= VMEM_BUDGET_BYTES:
+            return bb
+        bb //= 2
+    return None
+
+
+def lstm_batch_candidates(b: int, n: int, four_n: int, itemsize: int,
+                          bwd: bool = False,
+                          limit: int = 16) -> List[Tuple[int]]:
+    rw_bytes = n * four_n * itemsize
+    per_row = _lstm_per_row_bytes(n, four_n, itemsize, bwd)
+    out: List[Tuple[int]] = []
+    for bb in divisors_desc(b, b):
+        if rw_bytes + bb * per_row <= VMEM_BUDGET_BYTES:
+            out.append((bb,))
+        if len(out) >= limit:
+            break
+    return out
+
+
+def lstm_candidate_cost(cfg, b: int, n: int, four_n: int, seq_len: int,
+                        itemsize: int) -> Tuple[float, float]:
+    """Prior for one (bb,): the recurrent matmul padded to sublane
+    multiples per (batch-block, timestep) grid cell; RW's index map is
+    constant so its traffic is block-independent."""
+    (bb,) = cfg
+    tiles = (b // bb) * max(1, seq_len)
+    flops = tiles * 2.0 * _pad_up(bb, _SUBLANES) * n * _pad_up(four_n,
+                                                               _LANES)
+    bytes_ = (n * four_n * itemsize
+              + max(1, seq_len) * b * four_n * itemsize
+              + max(1, seq_len) * b * n * 2 * itemsize)
+    return flops, float(bytes_)
+
+
+# ---------------------------------------------------------------------------
+# flash_attention blocks
+# ---------------------------------------------------------------------------
+
+
+def attention_seq_ok(t: int) -> bool:
+    """The dispatch eligibility the ``mha`` entry point applies: the
+    sequence must divide by the default (clamped) block size."""
+    return t >= 8 and t % min(128, t) == 0
+
+
+def attention_blocks_ok(t: int, block_q: int, block_k: int) -> bool:
+    """Divisibility feasibility after clamping — the check the kernel
+    entry raises on."""
+    return t % block_q == 0 and t % block_k == 0
+
+
+def pick_attention_blocks(t: int) -> Tuple[int, int]:
+    """Heuristic (block_q, block_k) — the historical fixed 128s,
+    clamped to the sequence."""
+    return min(128, t), min(128, t)
+
+
+def attention_candidates(t: int, d: int, itemsize: int,
+                         limit: int = 16) -> List[Tuple[int, int]]:
+    """Power-of-two divisor block pairs that fit the streamed
+    schedule's VMEM residents (the resident-K/V schedule is strictly
+    smaller, so one feasibility formula conservatively covers both)."""
+    sizes = []
+    p = pow2_divisor_leq(t, 512)
+    while p >= 8:
+        sizes.append(p)
+        p //= 2
+    out: List[Tuple[int, int]] = []
+    for bq in sizes:
+        for bk in sizes:
+            resident = ((bq + 2 * bk) * d * itemsize
+                        + bq * d * 4 + 2 * bq * 4   # acc + l/m scratch
+                        + bq * bk * 4)               # score tile
+            if resident <= VMEM_BUDGET_BYTES:
+                out.append((bq, bk))
+            if len(out) >= limit:
+                return out
+    return out
+
+
+def attention_candidate_cost(cfg, t: int, d: int,
+                             itemsize: int) -> Tuple[float, float]:
+    """Prior for one (bq, bk): padded QK^T + PV work per tile, plus
+    K/V refetch traffic (each k-block streams once per q-block)."""
+    bq, bk = cfg
+    tiles = (t // bq) * (t // bk)
+    flops = tiles * 2.0 * 2.0 * _pad_up(bq, _SUBLANES) * d * _pad_up(
+        bk, _LANES)
+    bytes_ = ((t // bq) * 2 * t * d * itemsize   # K/V per q-block
+              + 2 * t * d * itemsize)            # q in + out
+    return flops, float(bytes_)

@@ -39,6 +39,18 @@ executes as a correctness shim, so CPU runs report correctness-only
 and set ``timing_skipped``. Prints ONE JSON line; runnable standalone
 or from ``bench.py``'s ``fused_kernels`` section (PR-5 SIGALRM budget
 box + PR-6 compile-stats sidecar ride along in the bench harness).
+
+``--tuned`` runs the autotuner A/B instead (``bench.py``'s
+``kernel_autotune`` section): a cold ``DL4J_TPU_TUNE=on`` pass
+searches conv/matmul tilings into a fresh cache (the tuner's own
+interleaved best-of-N measures heuristic + top-K candidates), then a
+``cached``-mode pass re-resolves from the persisted entries with the
+searches/measure counters asserted at ZERO — the warm-cache
+zero-measurement contract. Per kernel it reports the winner config,
+the measured heuristic-vs-winner times from the persisted entry, and
+``tuned_delta`` (fractional improvement, non-negative by construction
+since the heuristic is always in the measured set and the winner is
+the argmin).
 """
 
 from __future__ import annotations
@@ -315,6 +327,145 @@ def _measure(name, run_kernel, run_fused, run_unfused, stages, args,
     return name, out
 
 
+def _counter_total(name):
+    """Summed value of every child of a counter family (0 when the
+    family has not been created yet)."""
+    from deeplearning4j_tpu.observability.metrics import default_registry
+
+    fam = default_registry().get(name)
+    if fam is None:
+        return 0.0
+    return float(sum(c.value for c in fam.children()))
+
+
+def _hist_count(name):
+    from deeplearning4j_tpu.observability.metrics import default_registry
+
+    fam = default_registry().get(name)
+    if fam is None:
+        return 0
+    return int(sum(c.count for c in fam.children()))
+
+
+def _autotune_ab(budget_s=None, cache_dir=None):
+    """Tuned-vs-heuristic A/B through the real autotuner: cold
+    ``on``-mode search into a fresh cache, then a warm ``cached``-mode
+    resolve asserted to perform zero searches and zero measurements.
+    Timings come from the persisted entries (the tuner's own
+    interleaved best-of-N), so the delta is exactly what dispatch will
+    see."""
+    import importlib
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import dispatch
+
+    autotune = importlib.import_module("deeplearning4j_tpu.ops.autotune")
+    tiling = importlib.import_module("deeplearning4j_tpu.ops.tiling")
+    cbm = importlib.import_module("deeplearning4j_tpu.ops.conv_block")
+    mmm = importlib.import_module("deeplearning4j_tpu.ops.matmul_block")
+
+    if cache_dir is None:
+        cache_dir = tempfile.mkdtemp(prefix="dl4j_tune_bench_")
+    saved = {k: os.environ.get(k)
+             for k in ("DL4J_TPU_TUNE", "DL4J_TPU_TUNE_CACHE_DIR",
+                       "DL4J_TPU_TUNE_BUDGET_MS")}
+    # split the soft budget across the searches; the tuner's heuristic
+    # measurement is budget-exempt so even a tiny box yields a delta
+    per_search_ms = 1500.0
+    if budget_s:
+        per_search_ms = max(250.0, float(budget_s) * 1e3 / 4)
+    out = {"cache_dir": cache_dir, "kernels": {}}
+    try:
+        os.environ["DL4J_TPU_TUNE"] = "on"
+        os.environ["DL4J_TPU_TUNE_CACHE_DIR"] = cache_dir
+        os.environ["DL4J_TPU_TUNE_BUDGET_MS"] = str(per_search_ms)
+        dispatch.reset_for_tests()
+        interp = dispatch.pallas_interpret()
+        out["mode"] = "interpret" if interp else "pallas"
+
+        conv_args = ((4, 8, 16, 16), (16, 8, 3, 3), (1, 1), (1, 1))
+        subjects = {
+            "conv_block": {
+                "resolve": lambda: cbm._resolve_fwd_blocks(
+                    *conv_args, jnp.float32, interp),
+                "identity": cbm._identity(*conv_args, jnp.float32),
+                "heuristic": tiling.pick_conv_blocks(*conv_args, 4),
+            },
+            "matmul_block": {
+                "resolve": lambda: mmm._resolve_blocks(
+                    128, 256, 256, jnp.float32, False, interp),
+                "identity": {"m": 128, "k": 256, "n": 256,
+                             "dtype": "float32", "residual": False},
+                "heuristic": tiling.pick_matmul_blocks(128, 256, 256,
+                                                       4),
+            },
+        }
+
+        s0 = _counter_total("tuner_searches_total")
+        cold = {k: sub["resolve"]() for k, sub in subjects.items()}
+        out["cold_searches"] = _counter_total(
+            "tuner_searches_total") - s0
+
+        # warm pass: cached mode must resolve every entry from disk
+        # with ZERO searches and ZERO measurement rounds
+        os.environ["DL4J_TPU_TUNE"] = "cached"
+        dispatch.reset_for_tests()
+        s1 = _counter_total("tuner_searches_total")
+        m1 = _hist_count("tuner_measure_ms")
+        warm = {k: sub["resolve"]() for k, sub in subjects.items()}
+        out["warm_searches"] = _counter_total(
+            "tuner_searches_total") - s1
+        out["warm_measurements"] = _hist_count("tuner_measure_ms") - m1
+        out["warm_cache_hits"] = _counter_total(
+            "tuner_cache_hits_total")
+
+        deltas_ok = True
+        for name, sub in subjects.items():
+            doc = autotune.read_entry(name, sub["identity"]) or {}
+            timings = doc.get("timings_ms") or {}
+            heur_tag = autotune._cfg_tag(sub["heuristic"])
+            heur_ms = timings.get(heur_tag)
+            best_ms = doc.get("best_ms")
+            delta = None
+            if heur_ms and best_ms is not None:
+                delta = (heur_ms - best_ms) / heur_ms
+                deltas_ok = deltas_ok and delta >= -1e-9
+            else:
+                deltas_ok = False
+            out["kernels"][name] = {
+                "heuristic": heur_tag,
+                "config": ("x".join(str(v) for v in cold[name])
+                           if cold[name] else None),
+                "warm_config": ("x".join(str(v) for v in warm[name])
+                                if warm[name] else None),
+                "heuristic_ms": heur_ms,
+                "best_ms": best_ms,
+                "tuned_delta": delta,
+                "measured": doc.get("measured"),
+            }
+        out["tuned_nonneg_ok"] = deltas_ok
+        out["warm_zero_measure_ok"] = bool(
+            out["warm_searches"] == 0 and out["warm_measurements"] == 0
+        )
+        out["warm_configs_match"] = all(
+            cold[k] == warm[k] for k in subjects
+        )
+        out["autotune_ok"] = bool(
+            out["tuned_nonneg_ok"] and out["warm_zero_measure_ok"]
+            and out["warm_configs_match"]
+        )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        dispatch.reset_for_tests()
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--budget-s", type=float, default=None,
@@ -322,7 +473,21 @@ def main() -> int:
                          "bench harness owns the hard SIGALRM box)")
     ap.add_argument("--config", choices=["conv_stack", "resnet50_block",
                                          "mlp"], default=None)
+    ap.add_argument("--tuned", action="store_true",
+                    help="run the autotuner A/B (cold search + warm "
+                         "zero-measurement resolve) instead of the "
+                         "fused-kernel configs")
+    ap.add_argument("--tune-cache-dir", default=None,
+                    help="tuning cache dir for --tuned (default: a "
+                         "fresh temp dir, so the cold pass really "
+                         "searches)")
     args = ap.parse_args()
+
+    if args.tuned:
+        auto = _autotune_ab(args.budget_s, args.tune_cache_dir)
+        doc = {"autotune": auto, "autotune_ok": auto["autotune_ok"]}
+        print(json.dumps(doc))
+        return 0 if doc["autotune_ok"] else 1
 
     configs = {}
 

@@ -201,6 +201,73 @@ def check_pallas_locality(errors: list) -> None:
             )
 
 
+TILING_OWNERS = {"tiling.py", "autotune.py"}
+# legacy per-module block pickers the tiling refactor deleted; one of
+# these reappearing means a kernel grew a private divisor heuristic
+# the autotuner can't see (its candidate space and the dispatch
+# heuristic would disagree about feasibility)
+LEGACY_PICKERS = {
+    "_pick_blocks", "_seq_batch_block", "_divisors_desc",
+    "_largest_divisor_leq",
+}
+
+
+def check_tiling_locality(errors: list) -> None:
+    """Block-size selection for the Pallas kernels lives ONLY in
+    ``ops/tiling.py`` (VMEM budget, divisor heuristics, candidate
+    enumeration) and ``ops/autotune.py`` (measured winners over that
+    same candidate space). A kernel module doing its own inline
+    divisor math (the ``%`` operator) or re-growing a private picker
+    forks the feasibility rules: the heuristic, the tuner's candidate
+    space, and the ``*_ok`` routing gates drift apart, and a persisted
+    tuning entry can validate against one rule set and dispatch under
+    another. (String ``%``-formatting is exempt; blocked-grid
+    ``//`` arithmetic is fine — only divisibility/remainder tests are
+    selection logic.)"""
+    ops_dir = REPO / "deeplearning4j_tpu" / "ops"
+    for path in sorted(ops_dir.glob("*.py")):
+        if path.name in TILING_OWNERS:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Mod)
+                    and not (isinstance(node.left, ast.Constant)
+                             and isinstance(node.left.value, str))):
+                errors.append(
+                    f"ops/{path.name}:{node.lineno}: inline '%' "
+                    "remainder math — block feasibility/selection "
+                    "lives in ops/tiling.py (+ measured winners in "
+                    "ops/autotune.py)"
+                )
+            if (isinstance(node,
+                           (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name in LEGACY_PICKERS):
+                errors.append(
+                    f"ops/{path.name}:{node.lineno}: defines "
+                    f"{node.name}() — a private block picker grew "
+                    "back; extend ops/tiling.py instead"
+                )
+        calls_pallas = any(
+            isinstance(n, ast.Call) and call_name(n) == "pallas_call"
+            for n in ast.walk(tree)
+        )
+        if not calls_pallas:
+            continue
+        names = {
+            n.attr if isinstance(n, ast.Attribute) else
+            getattr(n, "id", "")
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.Attribute, ast.Name))
+        }
+        if not names & {"tiling", "autotune"}:
+            errors.append(
+                f"ops/{path.name}: calls pallas_call() but never "
+                "consults ops.tiling/ops.autotune — its block "
+                "configs come from somewhere private"
+            )
+
+
 def check_megastep_readback(errors: list) -> None:
     """The megastep driver functions may not read device values
     except through the single ``megastep_readback()`` call — one
@@ -342,6 +409,7 @@ def main() -> int:
     for name, path in ENGINES.items():
         check_engine(name, path, errors)
     check_pallas_locality(errors)
+    check_tiling_locality(errors)
     check_embedding_locality(errors)
     if errors:
         print("engine/core parity violations:", file=sys.stderr)
@@ -351,6 +419,7 @@ def main() -> int:
     print(
         "lint_parity: both engines delegate step/apply/fit hot paths "
         "to nn/core.py; Pallas kernels stay in ops/ behind dispatch; "
+        "block selection stays in ops/tiling.py + ops/autotune.py; "
         "megastep drivers keep one readback site; embedding "
         "collectives stay in embeddings/table.py"
     )

@@ -59,6 +59,19 @@ def load_configs(path):
         v = sec.get("value")
         if isinstance(v, (int, float)) and v > 0:
             vals[name] = float(v)
+        # kernel_autotune: gate each kernel's tuned-vs-heuristic
+        # delta as a ratio (1.0 = heuristic parity; bigger is
+        # better, same direction as every other section)
+        auto = sec.get("autotune")
+        kernels = (auto.get("kernels")
+                   if isinstance(auto, dict) else None)
+        if isinstance(kernels, dict):
+            for sub, info in sorted(kernels.items()):
+                d = (info.get("tuned_delta")
+                     if isinstance(info, dict) else None)
+                if isinstance(d, (int, float)):
+                    vals[f"{name}.{sub}.tuned"] = \
+                        1.0 + max(0.0, float(d))
     return vals or None
 
 

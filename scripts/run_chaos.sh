@@ -145,6 +145,17 @@ python scripts/perf_gate.py || exit 1
 #                                  either lands whole or aborts, and
 #                                  the restored shards merge bitwise
 #                                  onto a 1-device mesh)
+#   tests/test_autotune.py       — kernel tuning cache: a seeded storm
+#                                  mangles persisted entries (truncate,
+#                                  garbage bytes, flipped fingerprint,
+#                                  infeasible config, deleted file)
+#                                  between resolves — every mangled
+#                                  read must degrade to the divisor
+#                                  heuristic (counted by reason in
+#                                  tuner_fallback_total), never crash,
+#                                  never dispatch a mangled config;
+#                                  dispatch outputs stay bitwise equal
+#                                  to tuning off throughout
 #   tests/test_embeddings.py     — sharded embeddings: a ShardedWord2Vec
 #                                  run on the 8-device mesh is killed
 #                                  with os._exit(137) at a seed-derived
@@ -167,6 +178,7 @@ STORMS=(
     tests/test_elastic.py
     tests/test_data_defense.py
     tests/test_conv_block.py
+    tests/test_autotune.py
     tests/test_profiler.py
     tests/test_control_plane.py
     tests/test_async_checkpoint.py

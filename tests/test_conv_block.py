@@ -173,6 +173,138 @@ class TestConvBlockKernel:
                        activation="softmax", interpret=True)
 
 
+# stride/padding/odd-geometry sweep for the hand-written backward:
+# asymmetric strides, stride > kernel, padding > kernel//2, prime-ish
+# spatial dims (edge remainders), every epilogue family
+_BWD_SWEEP = [
+    ((2, 3, 9, 7), (5, 3, 3, 3), (1, 1), (1, 1), "relu"),
+    ((2, 3, 10, 10), (4, 3, 5, 5), (2, 1), (2, 2), "leakyrelu"),
+    ((1, 2, 8, 5), (3, 2, 3, 2), (2, 2), (0, 1), "tanh"),
+    ((2, 4, 7, 7), (8, 4, 3, 3), (3, 3), (1, 1), "identity"),
+    ((1, 1, 5, 6), (2, 1, 2, 3), (1, 2), (1, 0), "relu"),
+]
+
+
+class TestConvBlockBackward:
+    """The hand-written Pallas backward (dL/dx via the dilated-
+    gradient x flipped-weights forward kernel, dL/dw via the dedicated
+    per-tap kernel) against ``jax.vjp`` through the XLA reference.
+
+    Comparison uses a per-array magnitude-scaled tolerance
+    (``atol + rtol * max|ref|``): the kernel accumulates taps in a
+    fixed order, XLA schedules its conv reduction differently, so
+    elements of an ill-conditioned sum legitimately differ by ~1 ulp
+    of the LARGEST gradient in the array, not of each element."""
+
+    @staticmethod
+    def _assert_grads(g_k, g_r, rtol, atol):
+        for name, ka, ra in zip(("dx", "dw", "db", "dscale", "dshift"),
+                                g_k, g_r):
+            ka = np.asarray(ka, np.float32)
+            ra = np.asarray(ra, np.float32)
+            tol = atol + rtol * max(1.0, float(np.abs(ra).max()))
+            err = float(np.abs(ka - ra).max())
+            assert err <= tol, (name, err, tol)
+
+    @pytest.mark.parametrize(
+        "x_shape,w_shape,stride,padding,activation", _BWD_SWEEP)
+    def test_f32_grad_sweep(self, x_shape, w_shape, stride, padding,
+                            activation):
+        n, c, h, w_in = x_shape
+        o = w_shape[0]
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(*x_shape), jnp.float32)
+        w = jnp.asarray(rng.randn(*w_shape) * 0.2, jnp.float32)
+        b = jnp.asarray(rng.randn(o) * 0.1, jnp.float32)
+        a = jnp.asarray(rng.rand(o) + 0.5, jnp.float32)
+        s = jnp.asarray(rng.randn(o) * 0.1, jnp.float32)
+        assert conv_block_ok(x_shape, w_shape, stride, padding,
+                             jnp.float32)
+
+        def loss(fn, *p):
+            y = fn(*p)
+            return jnp.sum(y ** 2) + jnp.sum(y)
+
+        g_k = jax.grad(
+            lambda *p: loss(
+                lambda *q: conv_block(
+                    *q, stride=stride, padding=padding,
+                    activation=activation,
+                    interpret=pallas_interpret()), *p),
+            argnums=(0, 1, 2, 3, 4))(x, w, b, a, s)
+        g_r = jax.grad(
+            lambda *p: loss(
+                lambda *q: conv_block_reference(
+                    *q, stride=stride, padding=padding,
+                    activation=activation), *p),
+            argnums=(0, 1, 2, 3, 4))(x, w, b, a, s)
+        rtol, atol = kernel_tols()
+        self._assert_grads(g_k, g_r, rtol, atol)
+
+    @pytest.mark.parametrize(
+        "x_shape,w_shape,stride,padding,activation", _BWD_SWEEP)
+    def test_bf16_grad_sweep(self, x_shape, w_shape, stride, padding,
+                             activation):
+        """bf16 primals, f32 accumulators on both sides. The reference
+        upcasts to f32 and rounds once at the end (jax.vjp through a
+        mixed bf16/f32 conv_general_dilated is broken in this jaxlib —
+        its transpose emits a dtype-mismatched conv), which is also
+        exactly the kernel's accumulation contract."""
+        o = w_shape[0]
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(*x_shape), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(*w_shape) * 0.2, jnp.bfloat16)
+        b = jnp.asarray(rng.randn(o) * 0.1, jnp.float32)
+        a = jnp.asarray(rng.rand(o) + 0.5, jnp.float32)
+        s = jnp.asarray(rng.randn(o) * 0.1, jnp.float32)
+
+        def ref(x_, w_, b_, a_, s_):
+            y = conv_block_reference(
+                x_.astype(jnp.float32), w_.astype(jnp.float32),
+                b_, a_, s_, stride=stride, padding=padding,
+                activation=activation)
+            return y.astype(jnp.bfloat16)
+
+        def loss(fn, *p):
+            y = fn(*p)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        g_k = jax.grad(
+            lambda *p: loss(
+                lambda *q: conv_block(
+                    *q, stride=stride, padding=padding,
+                    activation=activation,
+                    interpret=pallas_interpret()), *p),
+            argnums=(0, 1, 2, 3, 4))(x, w, b, a, s)
+        g_r = jax.grad(
+            lambda *p: loss(ref, *p),
+            argnums=(0, 1, 2, 3, 4))(x, w, b, a, s)
+        # bf16 grads round to 8 mantissa bits: fixed bf16-eps band,
+        # magnitude-scaled like the f32 sweep
+        self._assert_grads(g_k, g_r, 2e-2, 8e-3)
+
+    def test_relu_tie_at_zero_matches_reference(self):
+        """The epilogue-grad table must reproduce lax.max's balanced
+        0.5 subgradient at z == 0, or grads drift on exact-zero
+        pre-activations (common with zero bias/shift)."""
+        x = jnp.zeros((1, 1, 3, 3), jnp.float32)
+        w = jnp.zeros((2, 1, 2, 2), jnp.float32)
+
+        def k_loss(w_):
+            return jnp.sum(conv_block(
+                x, w_, stride=(1, 1), padding=(0, 0),
+                activation="relu", interpret=pallas_interpret()))
+
+        def r_loss(w_):
+            return jnp.sum(conv_block_reference(
+                x, w_, stride=(1, 1), padding=(0, 0),
+                activation="relu"))
+
+        gk = np.asarray(jax.grad(k_loss)(w))
+        gr = np.asarray(jax.grad(r_loss)(w))
+        np.testing.assert_array_equal(gk, gr)
+
+
 class TestMatmulBlockKernel:
     @pytest.mark.parametrize("activation", sorted(SUPPORTED_EPILOGUES))
     def test_forward_matches_reference(self, activation):
@@ -208,6 +340,61 @@ class TestMatmulBlockKernel:
                 np.asarray(ka), np.asarray(ra), rtol=rtol, atol=atol,
                 err_msg=name,
             )
+
+    @pytest.mark.parametrize("activation", ["identity", "relu"])
+    def test_residual_forward_matches_reference(self, activation):
+        """The widened epilogue: activation(x @ w + b + residual) as
+        the same single kernel (pre-activation skip add)."""
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(6, 10), jnp.float32)
+        w = jnp.asarray(rng.randn(10, 12) * 0.2, jnp.float32)
+        b = jnp.asarray(rng.randn(12) * 0.1, jnp.float32)
+        r = jnp.asarray(rng.randn(6, 12) * 0.3, jnp.float32)
+        out = matmul_block(x, w, b, r, activation=activation,
+                           interpret=pallas_interpret())
+        ref = matmul_block_reference(x, w, b, r,
+                                     activation=activation)
+        rtol, atol = kernel_tols()
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=rtol, atol=atol)
+
+    def test_residual_grads_match_reference(self):
+        rng = np.random.RandomState(8)
+        x = jnp.asarray(rng.randn(6, 10), jnp.float32)
+        w = jnp.asarray(rng.randn(10, 12) * 0.2, jnp.float32)
+        b = jnp.asarray(rng.randn(12) * 0.1, jnp.float32)
+        r = jnp.asarray(rng.randn(6, 12) * 0.3, jnp.float32)
+
+        g_k = jax.grad(
+            lambda *p: jnp.sum(matmul_block(
+                *p, activation="tanh",
+                interpret=pallas_interpret()) ** 2),
+            argnums=(0, 1, 2, 3))(x, w, b, r)
+        g_r = jax.grad(
+            lambda *p: jnp.sum(matmul_block_reference(
+                *p, activation="tanh") ** 2),
+            argnums=(0, 1, 2, 3))(x, w, b, r)
+        rtol, atol = kernel_tols()
+        for name, ka, ra in zip(("dx", "dw", "db", "dresidual"),
+                                g_k, g_r):
+            np.testing.assert_allclose(
+                np.asarray(ka), np.asarray(ra), rtol=rtol, atol=atol,
+                err_msg=name,
+            )
+
+    def test_residual_free_path_unchanged(self):
+        """No residual -> the original kernel variant (bit-identical
+        to a pre-residual build): same output with and without the
+        residual argument explicitly None."""
+        rng = np.random.RandomState(9)
+        x = jnp.asarray(rng.randn(6, 10), jnp.float32)
+        w = jnp.asarray(rng.randn(10, 12) * 0.2, jnp.float32)
+        b = jnp.asarray(rng.randn(12) * 0.1, jnp.float32)
+        a = matmul_block(x, w, b, activation="relu",
+                         interpret=pallas_interpret())
+        c = matmul_block(x, w, b, None, activation="relu",
+                         interpret=pallas_interpret())
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
 
     def test_size_gate(self):
         assert matmul_block_ok(32, 64, 128, jnp.float32)
@@ -399,8 +586,11 @@ def test_kernels_compose_with_scan_remat_accum(monkeypatch):
     net_off, suffix_off = run("0")
     net_on, suffix_on = run("1")
     assert "scan" in suffix_on and "remat:full" in suffix_on
-    assert suffix_on.endswith("+convblock")
+    # default DL4J_TPU_TUNE=cached means tuning is active alongside
+    # the kernels: the suffix carries both parts
+    assert suffix_on.endswith("+convblock+tuned")
     assert "convblock" not in suffix_off
+    assert "tuned" not in suffix_off
     rtol, atol = kernel_tols()
     _assert_close_params(net_on, net_off, rtol, atol)
 

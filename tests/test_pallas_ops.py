@@ -300,9 +300,9 @@ class TestLstmSequenceKernel:
         assert not lstm_sequence_ok(16, 128, jnp.float32, 8)  # not 4n
         # odd batch with no fitting divisor block falls back
         assert lstm_sequence_ok(1024, 4096, jnp.bfloat16, 149)
-        from deeplearning4j_tpu.ops.lstm_cell import _seq_batch_block
+        from deeplearning4j_tpu.ops import tiling
 
-        bb = _seq_batch_block(149, 1024, 4096, 2)
+        bb = tiling.pick_lstm_batch_block(149, 1024, 4096, 2)
         assert bb is not None and 149 % bb == 0
 
     def test_layer_routes_through_sequence_kernel(self, monkeypatch):
